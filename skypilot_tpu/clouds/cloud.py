@@ -1,0 +1,145 @@
+"""Abstract Cloud interface.
+
+Reference parity: sky/clouds/cloud.py:115 (806 LoC) — feasibility, pricing,
+deploy variables, credentials, identity, status query, and the
+CloudImplementationFeatures capability declaration (:27-48) used by the
+optimizer/backend to pre-filter clouds per task.
+"""
+from __future__ import annotations
+
+import enum
+import typing
+from typing import Dict, Iterator, List, Optional, Tuple
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class CloudImplementationFeatures(enum.Enum):
+    """Capabilities a task may require; clouds declare what they cannot do
+    (reference: sky/clouds/cloud.py:27-48)."""
+    STOP = 'stop'
+    MULTI_SLICE = 'multi_slice'
+    AUTOSTOP = 'autostop'
+    SPOT_INSTANCE = 'spot_instance'
+    IMAGE_ID = 'image_id'
+    OPEN_PORTS = 'open_ports'
+    STORAGE_MOUNTING = 'storage_mounting'
+    HOST_CONTROLLERS = 'host_controllers'
+    CUSTOM_LABELS = 'custom_labels'
+
+
+class StatusVersion(enum.IntEnum):
+    """How cluster liveness is queried (reference ProvisionerVersion,
+    sky/clouds/cloud.py:67-81; there is no legacy Ray path here)."""
+    CLOUD_API = 1
+
+
+class Region:
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.zones: List['Zone'] = []
+
+    def set_zones(self, zones: List['Zone']) -> 'Region':
+        self.zones = zones
+        for z in self.zones:
+            z.region = self.name
+        return self
+
+    def __repr__(self) -> str:
+        return self.name
+
+
+class Zone:
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.region: Optional[str] = None
+
+    def __repr__(self) -> str:
+        return self.name
+
+
+class Cloud:
+    """Abstract cloud provider of TPU slices."""
+
+    NAME = 'abstract'
+    STATUS_VERSION = StatusVersion.CLOUD_API
+    OPEN_PORTS_VERSION = 1
+
+    # ---------------- capabilities ----------------
+    @classmethod
+    def unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[CloudImplementationFeatures, str]:
+        """Map of feature -> human reason, for features this cloud cannot
+        provide for these specific resources."""
+        raise NotImplementedError
+
+    @classmethod
+    def check_features_are_supported(
+            cls, resources: 'resources_lib.Resources',
+            requested_features) -> None:
+        unsupported = cls.unsupported_features_for_resources(resources)
+        bad = {f: r for f, r in unsupported.items()
+               if f in set(requested_features)}
+        if bad:
+            from skypilot_tpu import exceptions
+            table = '; '.join(f'{f.value}: {r}' for f, r in bad.items())
+            raise exceptions.NotSupportedError(
+                f'{cls.NAME} cannot satisfy: {table}')
+
+    # ---------------- offerings ----------------
+    @classmethod
+    def regions_with_offering(cls, accelerator: str, use_spot: bool,
+                              region: Optional[str],
+                              zone: Optional[str]) -> List[Region]:
+        raise NotImplementedError
+
+    @classmethod
+    def zones_provision_loop(
+            cls, *, region: str, accelerator: str,
+            use_spot: bool) -> Iterator[List[Zone]]:
+        """Yield zone batches in failover order within one region."""
+        raise NotImplementedError
+
+    # ---------------- pricing ----------------
+    @classmethod
+    def accelerator_cost(cls, accelerator: str, use_spot: bool,
+                         region: Optional[str],
+                         zone: Optional[str]) -> float:
+        raise NotImplementedError
+
+    @classmethod
+    def get_egress_cost(cls, num_gigabytes: float) -> float:
+        raise NotImplementedError
+
+    # ---------------- feasibility ----------------
+    @classmethod
+    def get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        """(candidates sorted by cost, fuzzy-match hints if none)."""
+        raise NotImplementedError
+
+    # ---------------- credentials / identity ----------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        raise NotImplementedError
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        raise NotImplementedError
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        """Files to ship to clusters so controllers can recurse
+        (reference: controllers launching clusters need cloud creds)."""
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return self.NAME
+
+    def __str__(self) -> str:
+        return self.NAME

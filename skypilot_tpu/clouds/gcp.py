@@ -1,0 +1,173 @@
+"""GCP: the primary TPU cloud.
+
+Reference parity: sky/clouds/gcp.py (1,135 LoC). The reference treats TPUs as
+an accelerator bolted onto GCE VMs ('TPU-VM' instance-type sentinel,
+gcp.py:232,562-614); here the TPU slice is the native unit and GCE hosts are
+an implementation detail recorded in the catalog.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import typing
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+_CREDENTIAL_FILES = [
+    '~/.config/gcloud/application_default_credentials.json',
+    '~/.config/gcloud/configurations/config_default',
+]
+
+# $/GB egress to internet; intra-GCP is treated as free in the optimizer's
+# egress model (both stages on GCP ⇒ 0), mirroring reference behavior.
+_EGRESS_COST_PER_GB = 0.12
+
+
+class GCP(cloud_lib.Cloud):
+
+    NAME = 'gcp'
+
+    @classmethod
+    def unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        unsupported: Dict[cloud_lib.CloudImplementationFeatures, str] = {}
+        tpu = resources.tpu
+        if resources.use_spot:
+            unsupported[cloud_lib.CloudImplementationFeatures.STOP] = (
+                'spot TPU slices cannot be stopped; they must be deleted '
+                'and recreated.')
+        elif tpu is not None and (tpu.is_pod or resources.num_slices > 1):
+            unsupported[cloud_lib.CloudImplementationFeatures.STOP] = (
+                'multi-host TPU pod slices cannot be stopped, only '
+                'deleted (TPU API limitation).')
+        return unsupported
+
+    # ---------------- offerings ----------------
+    @classmethod
+    def regions_with_offering(
+            cls, accelerator: str, use_spot: bool, region: Optional[str],
+            zone: Optional[str]) -> List[cloud_lib.Region]:
+        regions: List[cloud_lib.Region] = []
+        for rname, zones, _ in catalog.get_region_zones(accelerator,
+                                                        use_spot):
+            if region is not None and rname != region:
+                continue
+            zs = [cloud_lib.Zone(z) for z in zones
+                  if zone is None or z == zone]
+            if zs:
+                regions.append(cloud_lib.Region(rname).set_zones(zs))
+        return regions
+
+    @classmethod
+    def zones_provision_loop(
+            cls, *, region: str, accelerator: str,
+            use_spot: bool) -> Iterator[List[cloud_lib.Zone]]:
+        # TPU capacity is per-zone; try one zone at a time (reference: GCP
+        # yields single zones, sky/clouds/gcp.py zones_provision_loop).
+        for r in cls.regions_with_offering(accelerator, use_spot, region,
+                                           None):
+            for z in r.zones:
+                yield [z]
+
+    # ---------------- pricing ----------------
+    @classmethod
+    def accelerator_cost(cls, accelerator: str, use_spot: bool,
+                         region: Optional[str],
+                         zone: Optional[str]) -> float:
+        return catalog.get_hourly_cost(accelerator, use_spot, region, zone)
+
+    @classmethod
+    def get_egress_cost(cls, num_gigabytes: float) -> float:
+        return _EGRESS_COST_PER_GB * num_gigabytes
+
+    # ---------------- feasibility ----------------
+    @classmethod
+    def get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        if resources.cloud_name is not None and \
+                resources.cloud_name != cls.NAME:
+            return [], []
+        if resources.tpu is None:
+            # A TPU-native framework: a resources spec without an
+            # accelerator means "cheapest single-host dev slice".
+            default = resources.copy(cloud=cls.NAME,
+                                     accelerators='tpu-v5e-1')
+            return [default], []
+        acc = resources.tpu.name
+        if not catalog.accelerator_exists(acc):
+            # Fuzzy candidates: same generation, other sizes.
+            hints = sorted(
+                name for name in catalog.list_accelerators(
+                    name_filter=resources.tpu.generation))
+            return [], hints
+        offs = catalog.get_offerings(acc, resources.region, resources.zone,
+                                     resources.use_spot)
+        if not offs:
+            return [], [f'{acc} not offered in region={resources.region} '
+                        f'zone={resources.zone}']
+        return [resources.copy(cloud=cls.NAME)], []
+
+    # ---------------- credentials ----------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        try:
+            import google.auth  # pylint: disable=import-outside-toplevel
+            credentials, project = google.auth.default()
+            del credentials
+            if project is None:
+                return False, ('No default GCP project. Run `gcloud config '
+                               'set project <project-id>`.')
+            return True, None
+        except Exception as e:  # pylint: disable=broad-except
+            return False, (f'GCP credentials not found: {e}. Run `gcloud '
+                           'auth application-default login`.')
+
+    @classmethod
+    def get_project_id(cls) -> str:
+        env = os.environ.get('GOOGLE_CLOUD_PROJECT')
+        if env:
+            return env
+        from skypilot_tpu import sky_config
+        cfg = sky_config.get_nested(('gcp', 'project_id'), None)
+        if cfg:
+            return cfg
+        try:
+            import google.auth
+            _, project = google.auth.default()
+            if project:
+                return project
+        except Exception:  # pylint: disable=broad-except
+            pass
+        raise exceptions.CloudUserIdentityError(
+            'Could not determine GCP project id.')
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        try:
+            proc = subprocess.run(
+                ['gcloud', 'config', 'get-value', 'account'],
+                capture_output=True, text=True, timeout=10, check=False)
+            account = proc.stdout.strip()
+            if account:
+                return [f'{account}@{cls.get_project_id()}']
+        except Exception:  # pylint: disable=broad-except
+            pass
+        try:
+            return [cls.get_project_id()]
+        except exceptions.CloudUserIdentityError:
+            return None
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        return {
+            path: path for path in _CREDENTIAL_FILES
+            if os.path.exists(os.path.expanduser(path))
+        }

@@ -1,0 +1,116 @@
+"""Kubernetes (GKE) TPU cloud.
+
+Reference parity: sky/clouds/kubernetes.py + the GKE path in
+sky/provision/kubernetes/. GKE exposes TPU slices as node pools with
+`google.com/tpu` resources and `cloud.google.com/gke-tpu-accelerator` /
+`gke-tpu-topology` node selectors; a multi-host slice maps to a pod-per-host
+with a shared headless service for the JAX coordinator.
+
+Availability is cluster-local (whatever node pools exist), so feasibility
+defers to the configured context rather than a price catalog; cost is
+reported as the underlying GCP list price for parity in `cost-report`.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import typing
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class Kubernetes(cloud_lib.Cloud):
+
+    NAME = 'kubernetes'
+    _REGION = 'kubernetes'
+
+    @classmethod
+    def unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        del resources
+        return {
+            cloud_lib.CloudImplementationFeatures.STOP:
+                'pods are deleted, not stopped.',
+            cloud_lib.CloudImplementationFeatures.AUTOSTOP:
+                'use autodown instead of autostop on kubernetes.',
+            cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+                'spot preemption is managed by GKE node pools, not the '
+                'framework.',
+        }
+
+    @classmethod
+    def regions_with_offering(
+            cls, accelerator: str, use_spot: bool, region: Optional[str],
+            zone: Optional[str]) -> List[cloud_lib.Region]:
+        del accelerator, use_spot, zone
+        if region is not None and region != cls._REGION:
+            return []
+        r = cloud_lib.Region(cls._REGION)
+        r.set_zones([cloud_lib.Zone(cls._REGION)])
+        return [r]
+
+    @classmethod
+    def zones_provision_loop(
+            cls, *, region: str, accelerator: str,
+            use_spot: bool) -> Iterator[List[cloud_lib.Zone]]:
+        for r in cls.regions_with_offering(accelerator, use_spot, region,
+                                           None):
+            yield r.zones
+
+    @classmethod
+    def accelerator_cost(cls, accelerator: str, use_spot: bool,
+                         region: Optional[str],
+                         zone: Optional[str]) -> float:
+        del region, zone
+        # Report the GCP list price so cost accounting stays meaningful.
+        try:
+            return catalog.get_hourly_cost(accelerator, use_spot)
+        except Exception:  # pylint: disable=broad-except
+            return 0.0
+
+    @classmethod
+    def get_egress_cost(cls, num_gigabytes: float) -> float:
+        del num_gigabytes
+        return 0.0
+
+    @classmethod
+    def get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        if resources.cloud_name != cls.NAME:
+            # Opt-in only: kubernetes never competes in the optimizer unless
+            # named, because availability is cluster-local.
+            return [], []
+        if resources.tpu is None:
+            return [resources.copy(cloud=cls.NAME,
+                                   accelerators='tpu-v5e-1')], []
+        return [resources.copy(cloud=cls.NAME, region=cls._REGION)], []
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if shutil.which('kubectl') is None:
+            return False, 'kubectl not found on PATH.'
+        kubeconfig = os.path.expanduser(
+            os.environ.get('KUBECONFIG', '~/.kube/config'))
+        if not os.path.exists(kubeconfig):
+            return False, f'No kubeconfig at {kubeconfig}.'
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        from skypilot_tpu import sky_config
+        ctx = sky_config.get_nested(('kubernetes', 'context'), 'default')
+        return [f'kubernetes:{ctx}']
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        kubeconfig = '~/.kube/config'
+        if os.path.exists(os.path.expanduser(kubeconfig)):
+            return {kubeconfig: kubeconfig}
+        return {}

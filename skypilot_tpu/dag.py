@@ -1,0 +1,131 @@
+"""Task DAG with a thread-local `with Dag():` context.
+
+Reference parity: sky/dag.py (97 LoC; networkx DiGraph, `is_chain`,
+thread-local context at dag.py:71-97). Implemented here on plain adjacency
+dicts — the graphs are tiny and this keeps the core dependency-free.
+"""
+from __future__ import annotations
+
+import threading
+import typing
+from typing import Dict, List, Optional, Set
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.task import Task
+
+
+class Dag:
+    """A DAG of Tasks. Edges mean 'downstream consumes upstream outputs'."""
+
+    def __init__(self, name: Optional[str] = None) -> None:
+        self.name = name
+        self.tasks: List['Task'] = []
+        self._downstream: Dict['Task', List['Task']] = {}
+        self._upstream: Dict['Task', List['Task']] = {}
+
+    def add(self, task: 'Task') -> None:
+        if task not in self._downstream:
+            self.tasks.append(task)
+            self._downstream[task] = []
+            self._upstream[task] = []
+
+    def remove(self, task: 'Task') -> None:
+        self.tasks.remove(task)
+        for neighbors in (self._downstream, self._upstream):
+            neighbors.pop(task, None)
+            for lst in neighbors.values():
+                if task in lst:
+                    lst.remove(task)
+
+    def add_edge(self, op1: 'Task', op2: 'Task') -> None:
+        self.add(op1)
+        self.add(op2)
+        if op2 not in self._downstream[op1]:
+            self._downstream[op1].append(op2)
+            self._upstream[op2].append(op1)
+
+    def downstream(self, task: 'Task') -> List['Task']:
+        return list(self._downstream.get(task, []))
+
+    def upstream(self, task: 'Task') -> List['Task']:
+        return list(self._upstream.get(task, []))
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __enter__(self) -> 'Dag':
+        push_dag(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pop_dag()
+
+    def is_chain(self) -> bool:
+        """Linear pipeline check (drives DP vs general solver in the
+        optimizer; reference: sky/dag.py:53)."""
+        visited: Set['Task'] = set()
+        roots = [t for t in self.tasks if not self._upstream[t]]
+        if len(self.tasks) <= 1:
+            return True
+        if len(roots) != 1:
+            return False
+        node = roots[0]
+        while node is not None:
+            visited.add(node)
+            down = self._downstream[node]
+            if len(down) > 1 or len(self._upstream[node]) > 1:
+                return False
+            node = down[0] if down else None
+        return len(visited) == len(self.tasks)
+
+    def topological_order(self) -> List['Task']:
+        indeg = {t: len(self._upstream[t]) for t in self.tasks}
+        queue = [t for t in self.tasks if indeg[t] == 0]
+        order: List['Task'] = []
+        while queue:
+            node = queue.pop(0)
+            order.append(node)
+            for d in self._downstream[node]:
+                indeg[d] -= 1
+                if indeg[d] == 0:
+                    queue.append(d)
+        if len(order) != len(self.tasks):
+            raise ValueError('Cycle detected in task DAG.')
+        return order
+
+    def validate(self) -> None:
+        self.topological_order()
+
+    def __repr__(self) -> str:
+        return f'Dag({self.name}, {len(self.tasks)} tasks)'
+
+
+class _DagContext(threading.local):
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._stack: List[Dag] = []
+
+    def push(self, dag: Dag) -> None:
+        self._stack.append(dag)
+
+    def pop(self) -> Dag:
+        return self._stack.pop()
+
+    def current(self) -> Optional[Dag]:
+        return self._stack[-1] if self._stack else None
+
+
+_dag_context = _DagContext()
+
+
+def push_dag(dag: Dag) -> None:
+    _dag_context.push(dag)
+
+
+def pop_dag() -> Dag:
+    return _dag_context.pop()
+
+
+def get_current_dag() -> Optional[Dag]:
+    return _dag_context.current()

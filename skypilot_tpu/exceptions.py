@@ -1,0 +1,161 @@
+"""Typed exceptions for the framework.
+
+Reference parity: sky/exceptions.py (284 LoC). The key behavioral contract kept
+from the reference is that provisioning failures carry a ``failover_history``
+so managed jobs can distinguish pre-check failures from capacity failures
+(reference: sky/exceptions.py ResourcesUnavailableError).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """Catalog-feasible resources could not actually be provisioned.
+
+    ``failover_history`` records every error hit while walking the
+    zone/region failover list; an empty history means we failed before
+    talking to the cloud (precheck/validation), which managed-job recovery
+    treats differently from capacity stockouts.
+    """
+
+    def __init__(self, message: str,
+                 failover_history: Optional[List[Exception]] = None) -> None:
+        super().__init__(message)
+        self.failover_history: List[Exception] = failover_history or []
+
+    def with_failover_history(
+            self, history: List[Exception]) -> 'ResourcesUnavailableError':
+        self.failover_history = history
+        return self
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources do not match the existing cluster's resources."""
+
+
+class InvalidTopologyError(SkyTpuError):
+    """Unparseable or unsupported TPU accelerator/topology string."""
+
+
+class CommandError(SkyTpuError):
+    """A remote or local command failed."""
+
+    def __init__(self, returncode: int, command: str, error_msg: str = '',
+                 detailed_reason: str = '') -> None:
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        cmd = command if len(command) < 100 else command[:100] + '...'
+        super().__init__(f'Command {cmd} failed with return code '
+                         f'{returncode}.\n{error_msg}\n{detailed_reason}')
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster."""
+
+    def __init__(self, message: str, cluster_status=None, handle=None) -> None:
+        super().__init__(message)
+        self.cluster_status = cluster_status
+        self.handle = handle
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Cluster belongs to a different cloud identity."""
+
+
+class ClusterSetUpError(SkyTpuError):
+    """Runtime bootstrap (agent start, env setup) failed on the slice."""
+
+
+class CloudUserIdentityError(SkyTpuError):
+    """Failed to determine the active cloud identity."""
+
+
+class NotSupportedError(SkyTpuError):
+    """The requested operation is not supported (e.g. stopping a spot slice)."""
+
+
+class ProvisionPrechecksError(SkyTpuError):
+    """Failures before reaching the cloud (quota, credentials, validation).
+
+    Managed jobs do NOT retry these (reference:
+    sky/jobs/recovery_strategy.py distinguishes precheck vs capacity).
+    """
+
+    def __init__(self, reasons: List[Exception]) -> None:
+        super().__init__(str([str(r) for r in reasons]))
+        self.reasons = reasons
+
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    """Managed job exhausted its recovery budget."""
+
+
+class JobNotFoundError(SkyTpuError):
+    """No such job id in the agent's queue."""
+
+
+class StorageError(SkyTpuError):
+    """Storage layer failure."""
+
+
+class StorageSpecError(StorageError):
+    """Invalid storage spec (bad source, name, or mode)."""
+
+
+class StorageInitError(StorageError):
+    """Failed to initialize a store (create bucket, verify, ...)."""
+
+
+class StorageBucketCreateError(StorageInitError):
+    pass
+
+
+class StorageBucketGetError(StorageInitError):
+    pass
+
+
+class StorageBucketDeleteError(StorageError):
+    pass
+
+
+class StorageUploadError(StorageError):
+    pass
+
+
+class StorageModeError(StorageError):
+    pass
+
+
+class FetchClusterInfoError(SkyTpuError):
+    """Failed to query live instance info from the cloud."""
+
+    class Reason:
+        HEAD = 'HEAD'
+        WORKER = 'WORKER'
+
+    def __init__(self, reason: str = Reason.HEAD) -> None:
+        super().__init__(f'Failed to fetch {reason} node info.')
+        self.reason = reason
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    pass
+
+
+class PortDoesNotExistError(SkyTpuError):
+    pass
+
+
+class UserRequestRejectedByPolicy(SkyTpuError):
+    pass
+
+
+class NoCloudAccessError(SkyTpuError):
+    """No cloud is enabled/configured (run `check`)."""

@@ -1,0 +1,339 @@
+"""Client-side persistent state: `~/.skytpu/state.db`.
+
+Reference parity: sky/global_user_state.py (808 LoC) — `clusters` records
+with a pickled per-cluster handle, `cluster_history` usage intervals feeding
+`cost-report` (:446-503), `storage` records, `config` kv (enabled clouds,
+identity), and owner-identity checks (:504).
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import db_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.backends import backend as backend_lib
+
+_DB_PATH = os.environ.get('SKYTPU_STATE_DB', '~/.skytpu/state.db')
+
+
+def _create_table(cursor, conn):
+    del conn
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS clusters (
+            name TEXT PRIMARY KEY,
+            launched_at INTEGER,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT,
+            autostop INTEGER DEFAULT -1,
+            to_down INTEGER DEFAULT 0,
+            owner TEXT DEFAULT null,
+            metadata TEXT DEFAULT '{}',
+            cluster_hash TEXT DEFAULT null)""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS cluster_history (
+            cluster_hash TEXT,
+            name TEXT,
+            num_chips INTEGER,
+            requested_resources BLOB,
+            launched_resources BLOB,
+            usage_intervals BLOB,
+            PRIMARY KEY (cluster_hash))""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS storage (
+            name TEXT PRIMARY KEY,
+            launched_at INTEGER,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT)""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS config (
+            key TEXT PRIMARY KEY,
+            value TEXT)""")
+
+
+_db: Optional[db_utils.SQLiteConn] = None
+
+
+def _get_db() -> db_utils.SQLiteConn:
+    global _db
+    path = os.environ.get('SKYTPU_STATE_DB', '~/.skytpu/state.db')
+    if _db is None or _db.db_path != os.path.expanduser(path):
+        _db = db_utils.SQLiteConn(path, _create_table)
+    return _db
+
+
+# ---------------- config kv ----------------
+def _get_config(key: str) -> Optional[str]:
+    with _get_db().cursor() as cur:
+        row = cur.execute('SELECT value FROM config WHERE key = ?',
+                          (key,)).fetchone()
+    return row[0] if row else None
+
+
+def _set_config(key: str, value: str) -> None:
+    with _get_db().cursor() as cur:
+        cur.execute('INSERT OR REPLACE INTO config (key, value) '
+                    'VALUES (?, ?)', (key, value))
+
+
+def get_enabled_clouds() -> Optional[List[str]]:
+    raw = _get_config('enabled_clouds')
+    return json.loads(raw) if raw is not None else None
+
+
+def set_enabled_clouds(clouds: List[str]) -> None:
+    _set_config('enabled_clouds', json.dumps(clouds))
+
+
+def get_owner_identity() -> Optional[List[str]]:
+    raw = _get_config('owner_identity')
+    return json.loads(raw) if raw else None
+
+
+def set_owner_identity(identity: Optional[List[str]]) -> None:
+    if identity is not None:
+        _set_config('owner_identity', json.dumps(identity))
+
+
+# ---------------- clusters ----------------
+def add_or_update_cluster(cluster_name: str,
+                          cluster_handle: Any,
+                          requested_resources: Optional[set],
+                          ready: bool,
+                          is_launch: bool = True) -> None:
+    from skypilot_tpu import status_lib
+    status = status_lib.ClusterStatus.UP if ready else \
+        status_lib.ClusterStatus.INIT
+    now = int(time.time())
+    handle_blob = pickle.dumps(cluster_handle)
+    cluster_hash = _get_hash(cluster_name) or common_utils.get_usage_run_id()
+    usage_intervals = _get_usage_intervals(cluster_hash) or []
+    if is_launch and (not usage_intervals or
+                      usage_intervals[-1][1] is not None):
+        usage_intervals.append((now, None))
+    with _get_db().cursor() as cur:
+        cur.execute(
+            'INSERT OR REPLACE INTO clusters '
+            '(name, launched_at, handle, last_use, status, autostop, '
+            ' to_down, owner, metadata, cluster_hash) VALUES '
+            '(?, ?, ?, ?, ?, '
+            ' COALESCE((SELECT autostop FROM clusters WHERE name=?), -1), '
+            ' COALESCE((SELECT to_down FROM clusters WHERE name=?), 0), '
+            ' (SELECT owner FROM clusters WHERE name=?), '
+            ' COALESCE((SELECT metadata FROM clusters WHERE name=?), "{}"), '
+            ' ?)',
+            (cluster_name, now, handle_blob, _current_command(),
+             status.value, cluster_name, cluster_name, cluster_name,
+             cluster_name, cluster_hash))
+    num_chips = 0
+    launched = getattr(cluster_handle, 'launched_resources', None)
+    if launched is not None and launched.tpu is not None:
+        num_chips = launched.tpu.chips * launched.num_slices
+    with _get_db().cursor() as cur:
+        cur.execute(
+            'INSERT OR REPLACE INTO cluster_history '
+            '(cluster_hash, name, num_chips, requested_resources, '
+            ' launched_resources, usage_intervals) VALUES (?, ?, ?, ?, ?, ?)',
+            (cluster_hash, cluster_name, num_chips,
+             pickle.dumps(requested_resources), pickle.dumps(launched),
+             pickle.dumps(usage_intervals)))
+
+
+def _current_command() -> str:
+    import sys
+    return ' '.join(sys.argv)[:200]
+
+
+def _get_hash(cluster_name: str) -> Optional[str]:
+    with _get_db().cursor() as cur:
+        row = cur.execute('SELECT cluster_hash FROM clusters WHERE name = ?',
+                          (cluster_name,)).fetchone()
+    return row[0] if row else None
+
+
+def _get_usage_intervals(cluster_hash: Optional[str]):
+    if cluster_hash is None:
+        return None
+    with _get_db().cursor() as cur:
+        row = cur.execute(
+            'SELECT usage_intervals FROM cluster_history '
+            'WHERE cluster_hash = ?', (cluster_hash,)).fetchone()
+    return pickle.loads(row[0]) if row and row[0] else None
+
+
+def update_cluster_status(cluster_name: str, status) -> None:
+    with _get_db().cursor() as cur:
+        cur.execute('UPDATE clusters SET status = ? WHERE name = ?',
+                    (status.value, cluster_name))
+
+
+def update_last_use(cluster_name: str) -> None:
+    with _get_db().cursor() as cur:
+        cur.execute('UPDATE clusters SET last_use = ? WHERE name = ?',
+                    (_current_command(), cluster_name))
+
+
+def set_cluster_autostop(cluster_name: str, idle_minutes: int,
+                         to_down: bool) -> None:
+    with _get_db().cursor() as cur:
+        cur.execute(
+            'UPDATE clusters SET autostop = ?, to_down = ? WHERE name = ?',
+            (idle_minutes, int(to_down), cluster_name))
+
+
+def remove_cluster(cluster_name: str, terminate: bool) -> None:
+    """On stop: keep the record (status STOPPED, IPs cleared); on terminate:
+    drop it and close the usage interval (reference behavior)."""
+    from skypilot_tpu import status_lib
+    cluster_hash = _get_hash(cluster_name)
+    usage_intervals = _get_usage_intervals(cluster_hash)
+    if usage_intervals and usage_intervals[-1][1] is None:
+        start, _ = usage_intervals.pop()
+        usage_intervals.append((start, int(time.time())))
+        with _get_db().cursor() as cur:
+            cur.execute(
+                'UPDATE cluster_history SET usage_intervals = ? '
+                'WHERE cluster_hash = ?',
+                (pickle.dumps(usage_intervals), cluster_hash))
+    if terminate:
+        with _get_db().cursor() as cur:
+            cur.execute('DELETE FROM clusters WHERE name = ?',
+                        (cluster_name,))
+    else:
+        record = get_cluster_from_name(cluster_name)
+        if record is None:
+            return
+        handle = record['handle']
+        if handle is not None:
+            handle.stable_internal_external_ips = None
+        with _get_db().cursor() as cur:
+            cur.execute(
+                'UPDATE clusters SET handle = ?, status = ? WHERE name = ?',
+                (pickle.dumps(handle),
+                 status_lib.ClusterStatus.STOPPED.value, cluster_name))
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    from skypilot_tpu import status_lib
+    (name, launched_at, handle, last_use, status, autostop, to_down, owner,
+     metadata, cluster_hash) = row
+    return {
+        'name': name,
+        'launched_at': launched_at,
+        'handle': pickle.loads(handle) if handle else None,
+        'last_use': last_use,
+        'status': status_lib.ClusterStatus(status),
+        'autostop': autostop,
+        'to_down': bool(to_down),
+        'owner': json.loads(owner) if owner else None,
+        'metadata': json.loads(metadata or '{}'),
+        'cluster_hash': cluster_hash,
+    }
+
+
+_CLUSTER_COLS = ('name, launched_at, handle, last_use, status, autostop, '
+                 'to_down, owner, metadata, cluster_hash')
+
+
+def get_cluster_from_name(
+        cluster_name: Optional[str]) -> Optional[Dict[str, Any]]:
+    with _get_db().cursor() as cur:
+        row = cur.execute(
+            f'SELECT {_CLUSTER_COLS} FROM clusters WHERE name = ?',
+            (cluster_name,)).fetchone()
+    return _row_to_record(row) if row else None
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    with _get_db().cursor() as cur:
+        rows = cur.execute(
+            f'SELECT {_CLUSTER_COLS} FROM clusters '
+            'ORDER BY launched_at DESC').fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def get_cluster_names_start_with(starts_with: str) -> List[str]:
+    with _get_db().cursor() as cur:
+        rows = cur.execute('SELECT name FROM clusters WHERE name LIKE ?',
+                           (f'{starts_with}%',)).fetchall()
+    return [r[0] for r in rows]
+
+
+def set_cluster_owner(cluster_name: str,
+                      identity: Optional[List[str]]) -> None:
+    with _get_db().cursor() as cur:
+        cur.execute('UPDATE clusters SET owner = ? WHERE name = ?',
+                    (json.dumps(identity) if identity else None,
+                     cluster_name))
+
+
+def get_cluster_history() -> List[Dict[str, Any]]:
+    """Rows for cost-report: usage intervals × resources (reference:
+    global_user_state.py:446-503)."""
+    with _get_db().cursor() as cur:
+        rows = cur.execute(
+            'SELECT ch.cluster_hash, ch.name, ch.num_chips, '
+            '  ch.launched_resources, ch.usage_intervals, c.status '
+            'FROM cluster_history ch '
+            'LEFT OUTER JOIN clusters c ON ch.cluster_hash = '
+            'c.cluster_hash').fetchall()
+    out = []
+    for (cluster_hash, name, num_chips, launched, intervals, status) in rows:
+        from skypilot_tpu import status_lib
+        out.append({
+            'cluster_hash': cluster_hash,
+            'name': name,
+            'num_chips': num_chips,
+            'launched_resources':
+                pickle.loads(launched) if launched else None,
+            'usage_intervals':
+                pickle.loads(intervals) if intervals else [],
+            'status': status_lib.ClusterStatus(status) if status else None,
+        })
+    return out
+
+
+# ---------------- storage ----------------
+def add_or_update_storage(storage_name: str, storage_handle: Any,
+                          storage_status) -> None:
+    with _get_db().cursor() as cur:
+        cur.execute(
+            'INSERT OR REPLACE INTO storage '
+            '(name, launched_at, handle, last_use, status) '
+            'VALUES (?, ?, ?, ?, ?)',
+            (storage_name, int(time.time()), pickle.dumps(storage_handle),
+             _current_command(), storage_status.value))
+
+
+def remove_storage(storage_name: str) -> None:
+    with _get_db().cursor() as cur:
+        cur.execute('DELETE FROM storage WHERE name = ?', (storage_name,))
+
+
+def get_storage() -> List[Dict[str, Any]]:
+    from skypilot_tpu.data import storage as storage_lib
+    with _get_db().cursor() as cur:
+        rows = cur.execute('SELECT name, launched_at, handle, last_use, '
+                           'status FROM storage').fetchall()
+    return [{
+        'name': name,
+        'launched_at': launched_at,
+        'handle': pickle.loads(handle) if handle else None,
+        'last_use': last_use,
+        'status': storage_lib.StorageStatus(status),
+    } for name, launched_at, handle, last_use, status in rows]
+
+
+def get_storage_names_start_with(starts_with: str) -> List[str]:
+    with _get_db().cursor() as cur:
+        rows = cur.execute('SELECT name FROM storage WHERE name LIKE ?',
+                           (f'{starts_with}%',)).fetchall()
+    return [r[0] for r in rows]

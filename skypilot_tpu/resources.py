@@ -1,0 +1,412 @@
+"""Resources: an immutable, versioned resource filter resolved against the
+TPU catalog.
+
+Reference parity: sky/resources.py:30 (1,576 LoC) — cloud/region/zone/
+instance_type/accelerators/spot/disk/ports/labels with catalog validation
+(:719-988), `less_demanding_than` cluster-reuse check (:1085),
+`make_deploy_variables` (:1013), `get_cost` (:989), versioned pickle
+(_VERSION=19, :47).
+
+TPU-native differences:
+- ``accelerators`` is a pod-slice string (``tpu-v5p-64``); it resolves to a
+  :class:`~skypilot_tpu.topology.TpuSlice` carrying chips/hosts/topology, so
+  there is no separate instance_type to pick — the host shape is a property
+  of the generation (catalog columns host_vcpus/host_memory_gb).
+- ``num_slices`` is first-class for multislice (DCN megascale) jobs; the
+  reference's ``num_nodes`` counted VMs, here a "node" is a whole slice and
+  hosts-within-slice are an internal detail.
+- spot TPU pods cannot be stopped, only deleted (reference:
+  sky/clouds/gcp.py:184-190); that rule lives on TpuSlice.is_pod and is
+  enforced in Resources.supports_stop().
+"""
+from __future__ import annotations
+
+import dataclasses
+import textwrap
+import typing
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu import topology
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.clouds import cloud as cloud_lib
+
+_DEFAULT_DISK_SIZE_GB = 100
+
+
+class Resources:
+    """An immutable resource request; ``copy()`` to derive variants."""
+
+    # Bump when pickled fields change; __setstate__ migrates old handles
+    # (reference: sky/resources.py:47 _VERSION=19 with migration shims).
+    _VERSION = 1
+
+    def __init__(
+        self,
+        cloud: Optional[Union[str, 'cloud_lib.Cloud']] = None,
+        accelerators: Optional[Union[str, Dict[str, int]]] = None,
+        num_slices: int = 1,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        use_spot: Optional[bool] = None,
+        job_recovery: Optional[str] = None,
+        disk_size: Optional[int] = None,
+        image_id: Optional[str] = None,
+        ports: Optional[Union[int, str, List[Union[int, str]]]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        accelerator_args: Optional[Dict[str, Any]] = None,
+        cpus: Optional[Union[int, str]] = None,
+        memory: Optional[Union[int, str]] = None,
+        network_tier: Optional[str] = None,
+        _is_image_managed: Optional[bool] = None,
+    ) -> None:
+        self._version = self._VERSION
+        self._cloud_name: Optional[str] = None
+        if cloud is not None:
+            self._cloud_name = cloud if isinstance(cloud, str) else str(cloud)
+            self._cloud_name = self._cloud_name.lower()
+        self._use_spot_specified = use_spot is not None
+        self._use_spot = bool(use_spot) if use_spot is not None else False
+        self._job_recovery = job_recovery
+        self._disk_size = disk_size if disk_size is not None else \
+            _DEFAULT_DISK_SIZE_GB
+        self._image_id = image_id
+        self._labels = dict(labels) if labels else None
+        self._accelerator_args = dict(accelerator_args) \
+            if accelerator_args else None
+        self._cpus = str(cpus) if cpus is not None else None
+        self._memory = str(memory) if memory is not None else None
+        self._network_tier = network_tier
+        self._is_image_managed = _is_image_managed
+        if num_slices < 1:
+            raise ValueError(f'num_slices must be >= 1, got {num_slices}')
+        self._num_slices = num_slices
+
+        self._ports: Optional[List[str]] = None
+        if ports is not None:
+            if not isinstance(ports, list):
+                ports = [ports]
+            self._ports = [str(p) for p in ports]
+
+        # Resolve accelerator → TpuSlice.
+        self._tpu: Optional[topology.TpuSlice] = None
+        self._accelerators: Optional[str] = None
+        if accelerators is not None:
+            if isinstance(accelerators, dict):
+                if len(accelerators) != 1:
+                    raise ValueError(
+                        f'accelerators dict must have one entry, got '
+                        f'{accelerators}')
+                (name, count), = accelerators.items()
+                if count != 1:
+                    # 'tpu-v5e-8: 4' is ambiguous on TPU; slices scale via
+                    # the size suffix or num_slices.
+                    raise ValueError(
+                        'TPU accelerator counts scale via the size suffix '
+                        '(tpu-v5e-16) or num_slices, not a count.')
+                accelerators = name
+            topo = None
+            if self._accelerator_args:
+                topo = self._accelerator_args.get('topology')
+            self._tpu = topology.parse_accelerator(accelerators, topo)
+            self._accelerators = self._tpu.name
+        self._region = region
+        self._zone = zone
+        if region is not None or zone is not None:
+            self._region, self._zone = catalog.validate_region_zone(
+                region, zone)
+
+    # ---------------- properties ----------------
+    @property
+    def cloud_name(self) -> Optional[str]:
+        return self._cloud_name
+
+    @property
+    def cloud(self):
+        from skypilot_tpu.clouds import registry
+        if self._cloud_name is None:
+            return None
+        return registry.get(self._cloud_name)
+
+    @property
+    def accelerators(self) -> Optional[str]:
+        return self._accelerators
+
+    @property
+    def tpu(self) -> Optional[topology.TpuSlice]:
+        return self._tpu
+
+    @property
+    def num_slices(self) -> int:
+        return self._num_slices
+
+    @property
+    def num_hosts(self) -> int:
+        """Total SSH-able hosts across all slices (the rank-wiring unit)."""
+        per_slice = self._tpu.hosts if self._tpu is not None else 1
+        return per_slice * self._num_slices
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def use_spot_specified(self) -> bool:
+        return self._use_spot_specified
+
+    @property
+    def job_recovery(self) -> Optional[str]:
+        return self._job_recovery
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def ports(self) -> Optional[List[str]]:
+        return self._ports
+
+    @property
+    def labels(self) -> Optional[Dict[str, str]]:
+        return self._labels
+
+    @property
+    def accelerator_args(self) -> Optional[Dict[str, Any]]:
+        return self._accelerator_args
+
+    @property
+    def cpus(self) -> Optional[str]:
+        return self._cpus
+
+    @property
+    def memory(self) -> Optional[str]:
+        return self._memory
+
+    @property
+    def network_tier(self) -> Optional[str]:
+        return self._network_tier
+
+    # ---------------- behavior ----------------
+    def supports_stop(self) -> bool:
+        """Single-host TPU VMs can stop; pods and spot slices must be
+        deleted (reference: sky/clouds/gcp.py:184-190, resources.py:602)."""
+        if self._use_spot:
+            return False
+        if self._tpu is not None and (self._tpu.is_pod or
+                                      self._num_slices > 1):
+            return False
+        return True
+
+    def needs_cleanup_after_preemption(self) -> bool:
+        """Preempted spot TPU slices linger as wedged resources and must be
+        deleted before relaunch (reference: sky/resources.py:602,
+        jobs/controller.py:305-315)."""
+        return self._use_spot
+
+    def runtime_version(self) -> Optional[str]:
+        if self._accelerator_args and 'runtime_version' in \
+                self._accelerator_args:
+            return str(self._accelerator_args['runtime_version'])
+        if self._tpu is None:
+            return None
+        offs = catalog.get_offerings(self._tpu.name)
+        return offs[0].runtime_version if offs else None
+
+    def get_hourly_cost(self, region: Optional[str] = None,
+                        zone: Optional[str] = None) -> float:
+        """$/hr for the whole request (all slices)."""
+        if self._tpu is None:
+            return 0.0
+        unit = catalog.get_hourly_cost(self._tpu.name, self._use_spot,
+                                       region or self._region,
+                                       zone or self._zone)
+        return unit * self._num_slices
+
+    def get_cost(self, seconds: float) -> float:
+        return self.get_hourly_cost() * seconds / 3600.0
+
+    def is_launchable(self) -> bool:
+        """Fully pinned: cloud + accelerator resolved (region may float —
+        the failover engine picks zones)."""
+        return self._cloud_name is not None and self._tpu is not None
+
+    def assert_launchable(self) -> 'Resources':
+        assert self.is_launchable(), f'Resources not launchable: {self}'
+        return self
+
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """Can a cluster with `other` resources serve this request?
+        (cluster-reuse check; reference: sky/resources.py:1085)."""
+        if self._cloud_name is not None and \
+                self._cloud_name != other._cloud_name:
+            return False
+        if self._region is not None and self._region != other._region:
+            return False
+        if self._zone is not None and self._zone != other._zone:
+            return False
+        if self._use_spot_specified and self._use_spot != other._use_spot:
+            return False
+        if self._accelerators is not None:
+            if other._tpu is None:
+                return False
+            if self._tpu.generation != other._tpu.generation:
+                return False
+            if self._tpu.chips > other._tpu.chips:
+                return False
+        if self._num_slices > other._num_slices:
+            return False
+        return True
+
+    def copy(self, **override) -> 'Resources':
+        fields = dict(
+            cloud=self._cloud_name,
+            accelerators=self._accelerators,
+            num_slices=self._num_slices,
+            region=self._region,
+            zone=self._zone,
+            use_spot=self._use_spot if self._use_spot_specified else None,
+            job_recovery=self._job_recovery,
+            disk_size=self._disk_size,
+            image_id=self._image_id,
+            ports=self._ports,
+            labels=self._labels,
+            accelerator_args=self._accelerator_args,
+            cpus=self._cpus,
+            memory=self._memory,
+            network_tier=self._network_tier,
+        )
+        fields.update(override)
+        return Resources(**fields)
+
+    def make_deploy_variables(self, region: str, zone: str,
+                              cluster_name: str) -> Dict[str, Any]:
+        """Variables the provisioner needs to create this slice (reference:
+        sky/resources.py:1013 + sky/clouds/gcp.py:435-521 tpu deploy vars)."""
+        assert self._tpu is not None
+        return {
+            'cluster_name': cluster_name,
+            'accelerator_type': self._tpu.gcp_accelerator_type,
+            'topology': self._tpu.topology,
+            'chips': self._tpu.chips,
+            'hosts_per_slice': self._tpu.hosts,
+            'num_slices': self._num_slices,
+            'region': region,
+            'zone': zone,
+            'runtime_version': self.runtime_version(),
+            'use_spot': self._use_spot,
+            'disk_size_gb': self._disk_size,
+            'labels': self._labels or {},
+            'ports': self._ports or [],
+            'network_tier': self._network_tier or 'standard',
+        }
+
+    # ---------------- yaml ----------------
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]) -> 'Resources':
+        if config is None:
+            config = {}
+        config = dict(config)
+        # Accept the reference's `any_of`-less simple form only; unknown keys
+        # are an error (schema validation happens upstream in task loading).
+        known = {
+            'cloud', 'accelerators', 'num_slices', 'region', 'zone',
+            'use_spot', 'job_recovery', 'spot_recovery', 'disk_size',
+            'image_id', 'ports', 'labels', 'accelerator_args', 'cpus',
+            'memory', 'network_tier',
+        }
+        unknown = set(config) - known
+        if unknown:
+            raise ValueError(f'Unknown resources fields: {sorted(unknown)}')
+        if 'spot_recovery' in config:  # legacy alias from the reference
+            config.setdefault('job_recovery', config.pop('spot_recovery'))
+        return cls(
+            cloud=config.get('cloud'),
+            accelerators=config.get('accelerators'),
+            num_slices=config.get('num_slices', 1),
+            region=config.get('region'),
+            zone=config.get('zone'),
+            use_spot=config.get('use_spot'),
+            job_recovery=config.get('job_recovery'),
+            disk_size=config.get('disk_size'),
+            image_id=config.get('image_id'),
+            ports=config.get('ports'),
+            labels=config.get('labels'),
+            accelerator_args=config.get('accelerator_args'),
+            cpus=config.get('cpus'),
+            memory=config.get('memory'),
+            network_tier=config.get('network_tier'),
+        )
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+
+        def add(key, value, default=None):
+            if value is not None and value != default:
+                config[key] = value
+
+        add('cloud', self._cloud_name)
+        add('accelerators', self._accelerators)
+        add('num_slices', self._num_slices if self._num_slices != 1 else None)
+        add('region', self._region)
+        add('zone', self._zone)
+        if self._use_spot_specified:
+            config['use_spot'] = self._use_spot
+        add('job_recovery', self._job_recovery)
+        add('disk_size', self._disk_size, _DEFAULT_DISK_SIZE_GB)
+        add('image_id', self._image_id)
+        add('ports', self._ports)
+        add('labels', self._labels)
+        add('accelerator_args', self._accelerator_args)
+        add('cpus', self._cpus)
+        add('memory', self._memory)
+        add('network_tier', self._network_tier)
+        return config
+
+    # ---------------- pickle migration ----------------
+    def __getstate__(self):
+        return self.__dict__.copy()
+
+    def __setstate__(self, state):
+        version = state.get('_version', 0)
+        # Future migrations switch on `version` here, mirroring the
+        # reference's Resources.__setstate__ ladder.
+        del version
+        self.__dict__.update(state)
+
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud_name:
+            parts.append(self._cloud_name)
+        if self._accelerators:
+            acc = self._accelerators
+            if self._num_slices > 1:
+                acc += f'[x{self._num_slices}]'
+            parts.append(acc)
+        if self._use_spot:
+            parts.append('[spot]')
+        if self._region:
+            parts.append(self._region if not self._zone else self._zone)
+        return f'Resources({", ".join(parts) or "empty"})'
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()
+
+    def __hash__(self) -> int:
+        return hash(repr(sorted(self.to_yaml_config().items(),
+                                key=lambda kv: kv[0])))

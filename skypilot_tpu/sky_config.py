@@ -1,0 +1,86 @@
+"""Layered user config: `~/.skytpu/config.yaml`.
+
+Reference parity: sky/skypilot_config.py (232 LoC) — nested-key config loaded
+at import, overridable via env var (SKYTPU_CONFIG), validated against
+utils/schemas.CONFIG_SCHEMA. Precedence (highest first): task YAML > CLI
+flags > this file (applied by callers; this module only serves lookups).
+"""
+from __future__ import annotations
+
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import yaml
+
+from skypilot_tpu.utils import schemas
+
+CONFIG_PATH = '~/.skytpu/config.yaml'
+ENV_VAR_CONFIG = 'SKYTPU_CONFIG'
+
+_dict: Optional[Dict[str, Any]] = None
+_loaded_path: Optional[str] = None
+_lock = threading.Lock()
+
+
+def _load() -> None:
+    global _dict, _loaded_path
+    path = os.environ.get(ENV_VAR_CONFIG, CONFIG_PATH)
+    path = os.path.expanduser(path)
+    _loaded_path = path
+    if not os.path.exists(path):
+        _dict = None
+        return
+    with open(path) as f:
+        config = yaml.safe_load(f) or {}
+    schemas.validate_config(config)
+    _dict = config
+
+
+def _ensure_loaded() -> None:
+    with _lock:
+        if _loaded_path != os.path.expanduser(
+                os.environ.get(ENV_VAR_CONFIG, CONFIG_PATH)):
+            _load()
+        elif _dict is None and _loaded_path is None:
+            _load()
+
+
+def reload_config() -> None:
+    with _lock:
+        _load()
+
+
+def loaded() -> bool:
+    _ensure_loaded()
+    return _dict is not None
+
+
+def get_nested(keys: Iterable[str], default_value: Any) -> Any:
+    _ensure_loaded()
+    if _dict is None:
+        return default_value
+    node: Any = _dict
+    for k in keys:
+        if not isinstance(node, dict) or k not in node:
+            return default_value
+        node = node[k]
+    return node
+
+
+def set_nested(keys: Tuple[str, ...], value: Any) -> Dict[str, Any]:
+    """Return a copy of the config dict with keys set (does NOT write the
+    file — used to build controller-side configs)."""
+    _ensure_loaded()
+    config: Dict[str, Any] = copy.deepcopy(_dict) if _dict else {}
+    node = config
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+    node[keys[-1]] = value
+    return config
+
+
+def to_dict() -> Dict[str, Any]:
+    _ensure_loaded()
+    return copy.deepcopy(_dict) if _dict else {}

@@ -1,0 +1,26 @@
+"""Cluster/slice status enums (reference parity: sky/status_lib.py)."""
+from __future__ import annotations
+
+import enum
+
+import colorama
+
+
+class ClusterStatus(enum.Enum):
+    """Lifecycle of a slice-cluster as reconciled between local state and
+    the cloud (reference: sky/status_lib.py ClusterStatus)."""
+    INIT = 'INIT'          # provisioning, partial, or unknown-health
+    UP = 'UP'              # all hosts live + agent healthy
+    STOPPED = 'STOPPED'    # single-host slice stopped (pods cannot stop)
+
+    def colored_str(self) -> str:
+        color = {
+            ClusterStatus.INIT: colorama.Fore.BLUE,
+            ClusterStatus.UP: colorama.Fore.GREEN,
+            ClusterStatus.STOPPED: colorama.Fore.YELLOW,
+        }[self]
+        return f'{color}{self.value}{colorama.Style.RESET_ALL}'
+
+
+class StatusVersion(enum.IntEnum):
+    CLOUD_API = 1

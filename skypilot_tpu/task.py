@@ -1,0 +1,341 @@
+"""Task: the user-facing unit of work (`resources` + `setup` + `run`).
+
+Reference parity: sky/task.py:171 (1,194 LoC) — YAML⇄object round trip
+(from_yaml_config at task.py:347), env `${VAR}` substitution (:73),
+file_mounts/storage_mounts (:707,812), service spec attach (:674), `>>` DAG
+edges (:1159), per-rank CommandGen (:32-34).
+
+TPU-native differences: `num_nodes` means *slices* (each slice is multi-host
+internally — the host fan-out is the framework's job, not the user's), and
+the run command is launched identically on every host of every slice with
+the JAX coordinator env pre-wired (no torchrun/NCCL plumbing).
+"""
+from __future__ import annotations
+
+import os
+import re
+import typing
+from typing import Any, Callable, Dict, List, Optional, Set, Union
+
+import yaml
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.utils import schemas
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.data import storage as storage_lib
+    from skypilot_tpu.serve import service_spec as service_spec_lib
+
+# Per-rank command generator: (slice_rank, host_rank, num_slices,
+# hosts_per_slice) -> shell command. Reference analogue: CommandGen
+# (sky/task.py:32-34) keyed on (node_rank, ip_list).
+CommandGen = Callable[[int, int, int, int], Optional[str]]
+CommandOrCommandGen = Union[str, CommandGen]
+
+_VALID_NAME_REGEX = r'[a-zA-Z0-9]+(?:[._-]{1,2}[a-zA-Z0-9]+)*'
+_VALID_NAME_PAT = re.compile(f'^{_VALID_NAME_REGEX}$')
+
+_RUN_FN_CHECK_FAIL_MSG = (
+    'run command generator must take (slice_rank, host_rank, num_slices, '
+    'hosts_per_slice) and return a shell command string or None.')
+
+
+def _is_valid_name(name: Optional[str]) -> bool:
+    if name is None:
+        return True
+    return bool(_VALID_NAME_PAT.match(name))
+
+
+def _substitute_env_vars(text: str, envs: Dict[str, str]) -> str:
+    """${VAR} substitution in YAML string fields (reference: task.py:73)."""
+
+    def repl(m: 're.Match') -> str:
+        var = m.group(1) or m.group(2)
+        return envs.get(var, m.group(0))
+
+    return re.sub(r'\$\{(\w+)\}|\$(\w+)\b', repl, text)
+
+
+class Task:
+    """A coarse-grained unit of work: optional setup + a run command,
+    executed on every host of `num_nodes` TPU slices."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: Optional[CommandOrCommandGen] = None,
+        envs: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        # Internal only:
+        docker_image: Optional[str] = None,
+        event_callback: Optional[str] = None,
+    ) -> None:
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self.docker_image = docker_image
+        self.event_callback = event_callback
+        self._envs = dict(envs) if envs else {}
+        self.num_nodes = num_nodes if num_nodes is not None else 1
+
+        self.inputs: Optional[str] = None
+        self.outputs: Optional[str] = None
+        self.estimated_inputs_size_gigabytes: Optional[float] = None
+        self.estimated_outputs_size_gigabytes: Optional[float] = None
+        # seconds; used by the optimizer's TIME objective.
+        self.time_estimator_func: Optional[
+            Callable[['resources_lib.Resources'], float]] = None
+
+        # file_mounts: {remote: local_or_cloud_uri}
+        self.file_mounts: Optional[Dict[str, str]] = None
+        # storage_mounts: {remote_mount_path: Storage}
+        self.storage_mounts: Dict[str, 'storage_lib.Storage'] = {}
+        self.storage_plans: Dict['storage_lib.Storage', Any] = {}
+
+        self._resources: Set[resources_lib.Resources] = {
+            resources_lib.Resources()
+        }
+        self.service: Optional['service_spec_lib.ServiceSpec'] = None
+
+        self._validate()
+
+        dag = dag_lib.get_current_dag()
+        if dag is not None:
+            dag.add(self)
+
+    def _validate(self) -> None:
+        if not _is_valid_name(self.name):
+            raise ValueError(
+                f'Invalid task name {self.name!r}. Name must match '
+                f'{_VALID_NAME_REGEX}')
+        if self.run is not None and not isinstance(self.run, str) and \
+                not callable(self.run):
+            raise ValueError(_RUN_FN_CHECK_FAIL_MSG)
+        if self.num_nodes < 1:
+            raise ValueError(f'num_nodes must be >= 1, got {self.num_nodes}')
+        if self.workdir is not None:
+            expanded = os.path.expanduser(self.workdir)
+            if not os.path.isdir(expanded):
+                raise ValueError(f'workdir {self.workdir!r} is not a '
+                                 'directory.')
+
+    # ---------------- envs ----------------
+    @property
+    def envs(self) -> Dict[str, str]:
+        return self._envs
+
+    def update_envs(
+            self, envs: Union[None, Dict[str, str],
+                              List[Any]]) -> 'Task':
+        if envs is None:
+            return self
+        if isinstance(envs, list):
+            envs = dict(envs)
+        for k, v in envs.items():
+            if not isinstance(k, str) or not re.match(r'^[A-Za-z_]\w*$', k):
+                raise ValueError(f'Invalid env var name {k!r}')
+            self._envs[k] = str(v) if v is not None else ''
+        return self
+
+    # ---------------- resources ----------------
+    def set_resources(
+        self, resources: Union[resources_lib.Resources,
+                               Set[resources_lib.Resources],
+                               List[resources_lib.Resources]]
+    ) -> 'Task':
+        if isinstance(resources, resources_lib.Resources):
+            resources = {resources}
+        self._resources = set(resources)
+        return self
+
+    @property
+    def resources(self) -> Set[resources_lib.Resources]:
+        return self._resources
+
+    def best_resources(self) -> Optional[resources_lib.Resources]:
+        """Optimizer writes its pick here (reference: task.best_resources)."""
+        return getattr(self, '_best_resources', None)
+
+    def set_best_resources(self, r: resources_lib.Resources) -> None:
+        self._best_resources = r
+
+    # ---------------- storage / files ----------------
+    def set_file_mounts(self, file_mounts: Optional[Dict[str, str]]) -> 'Task':
+        self.file_mounts = dict(file_mounts) if file_mounts else None
+        return self
+
+    def update_file_mounts(self, file_mounts: Dict[str, str]) -> 'Task':
+        if self.file_mounts is None:
+            self.file_mounts = {}
+        self.file_mounts.update(file_mounts)
+        return self
+
+    def set_storage_mounts(self, storage_mounts) -> 'Task':
+        self.storage_mounts = dict(storage_mounts) if storage_mounts else {}
+        return self
+
+    def update_storage_mounts(self, storage_mounts) -> 'Task':
+        self.storage_mounts.update(storage_mounts or {})
+        return self
+
+    # ---------------- service ----------------
+    def set_service(self, service) -> 'Task':
+        self.service = service
+        return self
+
+    # ---------------- time estimation ----------------
+    def set_time_estimator(
+            self, func: Callable[['resources_lib.Resources'],
+                                 float]) -> 'Task':
+        self.time_estimator_func = func
+        return self
+
+    def estimate_runtime(self, resources: 'resources_lib.Resources') -> float:
+        if self.time_estimator_func is None:
+            # 1 hour default, like the reference's unknown-runtime stance.
+            return 3600.0
+        return self.time_estimator_func(resources)
+
+    # ---------------- yaml ----------------
+    @classmethod
+    def from_yaml_config(cls,
+                         config: Dict[str, Any],
+                         env_overrides: Optional[Dict[str,
+                                                      str]] = None) -> 'Task':
+        schemas.validate_task(config)
+        config = dict(config)
+        envs = dict(config.get('envs') or {})
+        envs = {k: ('' if v is None else str(v)) for k, v in envs.items()}
+        if env_overrides:
+            envs.update(env_overrides)
+        missing = [k for k, v in envs.items() if v == '']
+        if missing:
+            raise ValueError(
+                f'Environment variable(s) {missing} need values. Pass '
+                f'--env {missing[0]}=... or set a default in the YAML.')
+
+        def sub(value):
+            if isinstance(value, str):
+                return _substitute_env_vars(value, envs)
+            if isinstance(value, dict):
+                return {k: sub(v) for k, v in value.items()}
+            if isinstance(value, list):
+                return [sub(v) for v in value]
+            return value
+
+        for key in ('workdir', 'setup', 'run', 'file_mounts', 'name'):
+            if key in config:
+                config[key] = sub(config[key])
+
+        task = cls(
+            name=config.get('name'),
+            setup=config.get('setup'),
+            run=config.get('run'),
+            envs=envs,
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes'),
+            event_callback=config.get('event_callback'),
+        )
+        # Resources (single dict; `any_of` lists map to a Resources set).
+        res_config = config.get('resources') or {}
+        if isinstance(res_config, dict) and 'any_of' in res_config:
+            task.set_resources({
+                resources_lib.Resources.from_yaml_config(rc)
+                for rc in res_config['any_of']
+            })
+        else:
+            task.set_resources(
+                resources_lib.Resources.from_yaml_config(res_config))
+
+        file_mounts = config.get('file_mounts')
+        storage_configs: Dict[str, Dict[str, Any]] = {}
+        if file_mounts:
+            plain: Dict[str, str] = {}
+            for dst, src in file_mounts.items():
+                if isinstance(src, dict):
+                    storage_configs[dst] = src  # inline storage spec
+                else:
+                    plain[dst] = src
+            if plain:
+                task.set_file_mounts(plain)
+        if storage_configs:
+            from skypilot_tpu.data import storage as storage_lib
+            mounts = {}
+            for dst, sconf in storage_configs.items():
+                mounts[dst] = storage_lib.Storage.from_yaml_config(sconf)
+            task.set_storage_mounts(mounts)
+
+        if config.get('service') is not None:
+            from skypilot_tpu.serve import service_spec as service_spec_lib
+            task.set_service(
+                service_spec_lib.ServiceSpec.from_yaml_config(
+                    config['service']))
+
+        if config.get('inputs') is not None:
+            (task.inputs, task.estimated_inputs_size_gigabytes), = \
+                config['inputs'].items()
+        if config.get('outputs') is not None:
+            (task.outputs, task.estimated_outputs_size_gigabytes), = \
+                config['outputs'].items()
+        return task
+
+    @classmethod
+    def from_yaml(cls, yaml_path: str,
+                  env_overrides: Optional[Dict[str, str]] = None) -> 'Task':
+        with open(os.path.expanduser(yaml_path), 'r') as f:
+            config = yaml.safe_load(f)
+        if isinstance(config, str):
+            raise ValueError('YAML loaded as a string — invalid task file.')
+        if config is None:
+            config = {}
+        return cls.from_yaml_config(config, env_overrides)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+
+        def add_if(key, value):
+            if value is not None and value != {} and value != []:
+                config[key] = value
+
+        add_if('name', self.name)
+        resources = list(self._resources)
+        if len(resources) == 1:
+            add_if('resources', resources[0].to_yaml_config())
+        else:
+            config['resources'] = {
+                'any_of': [r.to_yaml_config() for r in resources]
+            }
+        if self.num_nodes != 1:
+            config['num_nodes'] = self.num_nodes
+        add_if('envs', self._envs or None)
+        add_if('workdir', self.workdir)
+        add_if('setup', self.setup)
+        add_if('run', self.run if isinstance(self.run, str) else None)
+        file_mounts: Dict[str, Any] = dict(self.file_mounts or {})
+        for dst, storage in self.storage_mounts.items():
+            file_mounts[dst] = storage.to_yaml_config()
+        add_if('file_mounts', file_mounts or None)
+        if self.service is not None:
+            add_if('service', self.service.to_yaml_config())
+        return config
+
+    # ---------------- dag sugar ----------------
+    def __rshift__(self, other: 'Task') -> 'Task':
+        dag = dag_lib.get_current_dag()
+        if dag is None:
+            raise RuntimeError('`task1 >> task2` requires an active '
+                               '`with Dag():` context.')
+        dag.add_edge(self, other)
+        return other
+
+    def __repr__(self) -> str:
+        label = self.name or 'unnamed'
+        if isinstance(self.run, str):
+            run = self.run.strip().splitlines()[0][:30]
+            return f'Task({label}: {run}...)'
+        return f'Task({label})'

@@ -1,0 +1,162 @@
+"""Small shared helpers: payload encoding, ids, retries, user identity.
+
+Reference parity: sky/utils/common_utils.py. The `encode_payload` /
+`decode_payload` pair is the framework's remote-result contract: every
+codegen run over SSH prints exactly one payload line that the client parses
+back (reference idiom: sky/skylet/job_lib.py:355-380).
+"""
+from __future__ import annotations
+
+import functools
+import getpass
+import hashlib
+import json
+import os
+import random
+import re
+import socket
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+_PAYLOAD_PREFIX = '<skytpu-payload>'
+_PAYLOAD_SUFFIX = '</skytpu-payload>'
+
+_USER_HASH_FILE = os.path.expanduser('~/.skytpu/user_hash')
+USER_HASH_LENGTH = 8
+
+_run_id: Optional[str] = None
+
+
+def encode_payload(payload: Any) -> str:
+    return f'{_PAYLOAD_PREFIX}{json.dumps(payload)}{_PAYLOAD_SUFFIX}'
+
+
+def decode_payload(text: str) -> Any:
+    m = re.search(re.escape(_PAYLOAD_PREFIX) + r'(.*?)' +
+                  re.escape(_PAYLOAD_SUFFIX), text, flags=re.DOTALL)
+    if m is None:
+        raise ValueError(f'No payload found in: {text[-1000:]!r}')
+    return json.loads(m.group(1))
+
+
+def get_user_hash() -> str:
+    """Stable per-user id; mixed into default cluster names."""
+    env = os.environ.get('SKYTPU_USER_HASH')
+    if env:
+        return env[:USER_HASH_LENGTH]
+    if os.path.exists(_USER_HASH_FILE):
+        with open(_USER_HASH_FILE) as f:
+            value = f.read().strip()
+        if value:
+            return value[:USER_HASH_LENGTH]
+    value = hashlib.md5(
+        f'{getpass.getuser()}+{socket.gethostname()}+{uuid.getnode()}'.encode(
+        )).hexdigest()[:USER_HASH_LENGTH]
+    os.makedirs(os.path.dirname(_USER_HASH_FILE), exist_ok=True)
+    with open(_USER_HASH_FILE, 'w') as f:
+        f.write(value)
+    return value
+
+
+def get_usage_run_id() -> str:
+    global _run_id
+    if _run_id is None:
+        _run_id = str(uuid.uuid4())
+    return _run_id
+
+
+def get_cleaned_username() -> str:
+    return re.sub(r'[^a-z0-9-]', '', getpass.getuser().lower())[:20] or 'user'
+
+
+def generate_cluster_name() -> str:
+    return f'stpu-{uuid.uuid4().hex[:4]}-{get_cleaned_username()}'
+
+
+def make_cluster_name_on_cloud(cluster_name: str,
+                               max_length: int = 35) -> str:
+    """Cloud-safe, globally-unique-ish name (reference:
+    common_utils.make_cluster_name_on_cloud)."""
+    suffix = get_user_hash()[:4]
+    safe = re.sub(r'[^a-z0-9-]', '-', cluster_name.lower()).strip('-')
+    if len(safe) + 5 > max_length:
+        head = safe[:max_length - 10]
+        digest = hashlib.md5(cluster_name.encode()).hexdigest()[:4]
+        safe = f'{head}-{digest}'
+    return f'{safe}-{suffix}'
+
+
+def get_global_job_id(run_timestamp: str, cluster_name: str,
+                      job_id: str) -> str:
+    """Stable task id that survives managed-job recoveries (reference:
+    SKYPILOT_TASK_ID contract, skylet/constants.py:64-71)."""
+    return f'{run_timestamp}_{cluster_name}_{job_id}'
+
+
+def retry(fn: Optional[Callable] = None, *, max_retries: int = 3,
+          initial_backoff: float = 1.0, max_backoff: float = 30.0,
+          exceptions_to_retry=(Exception,)) -> Callable:
+    """Exponential backoff with jitter."""
+
+    def decorator(func: Callable) -> Callable:
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            backoff = initial_backoff
+            for attempt in range(max_retries + 1):
+                try:
+                    return func(*args, **kwargs)
+                except exceptions_to_retry:
+                    if attempt == max_retries:
+                        raise
+                    time.sleep(backoff * (1 + random.random() * 0.3))
+                    backoff = min(backoff * 2, max_backoff)
+
+        return wrapper
+
+    if fn is not None:
+        return decorator(fn)
+    return decorator
+
+
+def read_yaml(path: str):
+    import yaml
+    with open(os.path.expanduser(path)) as f:
+        return yaml.safe_load(f)
+
+
+def dump_yaml(path: str, config) -> None:
+    import yaml
+    os.makedirs(os.path.dirname(os.path.expanduser(path)) or '.',
+                exist_ok=True)
+    with open(os.path.expanduser(path), 'w') as f:
+        yaml.safe_dump(config, f, default_flow_style=False,
+                       sort_keys=False)
+
+
+def format_float(x: float, precision: int = 2) -> str:
+    if x >= 1000:
+        return f'{x:,.0f}'
+    return f'{x:.{precision}f}'
+
+
+def readable_time_duration(seconds: Optional[float],
+                           absolute: bool = False) -> str:
+    if seconds is None:
+        return '-'
+    seconds = int(seconds)
+    if seconds < 60:
+        return f'{seconds}s'
+    mins, secs = divmod(seconds, 60)
+    if mins < 60:
+        return f'{mins}m {secs}s' if absolute else f'{mins}m'
+    hours, mins = divmod(mins, 60)
+    if hours < 24:
+        return f'{hours}h {mins}m'
+    days, hours = divmod(hours, 24)
+    return f'{days}d {hours}h'
+
+
+def class_fullname(cls) -> str:
+    return f'{cls.__module__}.{cls.__name__}'

@@ -1,0 +1,48 @@
+"""Tiny sqlite helper shared by client state, agent job queue, and
+controller state (reference parity: sky/utils/db_utils.py)."""
+from __future__ import annotations
+
+import contextlib
+import os
+import sqlite3
+import threading
+from typing import Any, Callable, Optional
+
+
+class SQLiteConn(threading.local):
+    """Thread-local sqlite connection with one-time schema creation."""
+
+    def __init__(self, db_path: str,
+                 create_table: Callable[[sqlite3.Cursor, sqlite3.Connection],
+                                        None]) -> None:
+        super().__init__()
+        self.db_path = os.path.expanduser(db_path)
+        os.makedirs(os.path.dirname(self.db_path) or '.', exist_ok=True)
+        self.conn = sqlite3.connect(self.db_path, timeout=10)
+        cursor = self.conn.cursor()
+        try:
+            create_table(cursor, self.conn)
+            self.conn.commit()
+        finally:
+            cursor.close()
+
+    @contextlib.contextmanager
+    def cursor(self):
+        cursor = self.conn.cursor()
+        try:
+            yield cursor
+            self.conn.commit()
+        finally:
+            cursor.close()
+
+
+def add_column_if_not_exists(cursor: sqlite3.Cursor, table: str, column: str,
+                             decl: str,
+                             default: Optional[Any] = None) -> None:
+    """Forward-compatible schema migration."""
+    cols = [row[1] for row in
+            cursor.execute(f'PRAGMA table_info({table})').fetchall()]
+    if column not in cols:
+        cursor.execute(f'ALTER TABLE {table} ADD COLUMN {column} {decl}')
+        if default is not None:
+            cursor.execute(f'UPDATE {table} SET {column} = ?', (default,))

@@ -1,0 +1,196 @@
+"""JSON-schema validation for task YAML / config / service spec.
+
+Reference parity: sky/utils/schemas.py (914 LoC). The schemas are TPU-native:
+`resources.accelerators` is a slice string, `num_slices` replaces node
+counting, and `service` matches skypilot_tpu/serve/service_spec.py.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jsonschema
+
+
+def _case_insensitive_enum(values):
+    return {'type': 'string', 'case_insensitive_enum': values}
+
+
+RESOURCES_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'cloud': {'type': 'string'},
+        'accelerators': {
+            'anyOf': [{'type': 'string'},
+                      {'type': 'object', 'maxProperties': 1}]
+        },
+        'num_slices': {'type': 'integer', 'minimum': 1},
+        'region': {'type': 'string'},
+        'zone': {'type': 'string'},
+        'use_spot': {'type': 'boolean'},
+        'job_recovery': {'type': 'string'},
+        'spot_recovery': {'type': 'string'},
+        'disk_size': {'type': 'integer', 'minimum': 1},
+        'image_id': {'type': 'string'},
+        'ports': {
+            'anyOf': [{'type': 'integer'}, {'type': 'string'},
+                      {'type': 'array',
+                       'items': {'anyOf': [{'type': 'integer'},
+                                           {'type': 'string'}]}}]
+        },
+        'labels': {'type': 'object',
+                   'additionalProperties': {'type': 'string'}},
+        'accelerator_args': {'type': 'object'},
+        'cpus': {'anyOf': [{'type': 'integer'}, {'type': 'string'}]},
+        'memory': {'anyOf': [{'type': 'integer'}, {'type': 'string'}]},
+        'network_tier': {'type': 'string'},
+        'any_of': {'type': 'array'},
+    },
+}
+
+SERVICE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'required': ['readiness_probe'],
+    'properties': {
+        'readiness_probe': {
+            'anyOf': [
+                {'type': 'string'},
+                {
+                    'type': 'object',
+                    'additionalProperties': False,
+                    'required': ['path'],
+                    'properties': {
+                        'path': {'type': 'string'},
+                        'initial_delay_seconds': {'type': 'number'},
+                        'post_data': {
+                            'anyOf': [{'type': 'string'},
+                                      {'type': 'object'}]
+                        },
+                        'headers': {'type': 'object'},
+                        'timeout_seconds': {'type': 'number'},
+                    },
+                },
+            ]
+        },
+        'replica_policy': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'min_replicas': {'type': 'integer', 'minimum': 0},
+                'max_replicas': {'type': 'integer', 'minimum': 0},
+                'target_qps_per_replica': {'type': 'number',
+                                           'exclusiveMinimum': 0},
+                'upscale_delay_seconds': {'type': 'number'},
+                'downscale_delay_seconds': {'type': 'number'},
+                'base_ondemand_fallback_replicas': {'type': 'integer',
+                                                    'minimum': 0},
+                'dynamic_ondemand_fallback': {'type': 'boolean'},
+            },
+        },
+        'replicas': {'type': 'integer', 'minimum': 1},
+    },
+}
+
+TASK_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': 'string'},
+        'workdir': {'type': 'string'},
+        'event_callback': {'type': 'string'},
+        'num_nodes': {'type': 'integer', 'minimum': 1},
+        'resources': RESOURCES_SCHEMA,
+        'envs': {
+            'type': 'object',
+            'patternProperties': {'^[A-Za-z_][A-Za-z0-9_]*$': {
+                'anyOf': [{'type': 'string'}, {'type': 'number'},
+                          {'type': 'null'}]}},
+            'additionalProperties': False,
+        },
+        'setup': {'type': 'string'},
+        'run': {'type': 'string'},
+        'file_mounts': {'type': 'object'},
+        'inputs': {'type': 'object', 'maxProperties': 1},
+        'outputs': {'type': 'object', 'maxProperties': 1},
+        'service': SERVICE_SCHEMA,
+    },
+}
+
+CONFIG_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'jobs': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'controller': {
+                    'type': 'object',
+                    'properties': {'resources': RESOURCES_SCHEMA},
+                },
+            },
+        },
+        'serve': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'controller': {
+                    'type': 'object',
+                    'properties': {'resources': RESOURCES_SCHEMA},
+                },
+            },
+        },
+        'gcp': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'project_id': {'type': 'string'},
+                'service_account': {'type': 'string'},
+                'use_queued_resources': {'type': 'boolean'},
+                'reserved': {'type': 'boolean'},
+                'labels': {'type': 'object'},
+            },
+        },
+        'kubernetes': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'context': {'type': 'string'},
+                'namespace': {'type': 'string'},
+            },
+        },
+        'allowed_clouds': {'type': 'array', 'items': {'type': 'string'}},
+        'usage': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {'enabled': {'type': 'boolean'},
+                           'endpoint': {'type': 'string'}},
+        },
+    },
+}
+
+
+def _validate(config: Dict[str, Any], schema: Dict[str, Any],
+              what: str) -> None:
+    try:
+        jsonschema.validate(config, schema)
+    except jsonschema.ValidationError as e:
+        path = '.'.join(str(p) for p in e.absolute_path) or '<root>'
+        raise ValueError(f'Invalid {what} at {path}: {e.message}') from None
+
+
+def validate_task(config: Dict[str, Any]) -> None:
+    _validate(config, TASK_SCHEMA, 'task YAML')
+
+
+def validate_resources(config: Dict[str, Any]) -> None:
+    _validate(config, RESOURCES_SCHEMA, 'resources')
+
+
+def validate_service(config: Dict[str, Any]) -> None:
+    _validate(config, SERVICE_SCHEMA, 'service spec')
+
+
+def validate_config(config: Dict[str, Any]) -> None:
+    _validate(config, CONFIG_SCHEMA, 'config file')

@@ -1,0 +1,39 @@
+"""Test harness.
+
+- Simulates an 8-device TPU-shaped mesh on CPU via
+  ``--xla_force_host_platform_device_count`` (the reference has no way to
+  test multi-node without real clouds — SURVEY §4.5; we close that gap).
+- Isolates all on-disk state (~/.skytpu) per test.
+- Stubs the enabled-cloud list so optimizer dryruns never touch credentials
+  (the reference's monkeypatch trick, tests/common.py:11).
+"""
+import os
+
+# Must be set before jax ever initializes.
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate_state(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_STATE_DB', str(tmp_path / 'state.db'))
+    monkeypatch.setenv('SKYTPU_USER_HASH', 'testhash')
+    monkeypatch.setenv('SKYTPU_CONFIG', str(tmp_path / 'config.yaml'))
+    monkeypatch.setenv('SKYTPU_HOME', str(tmp_path / 'skytpu_home'))
+    # Reset the global-state singleton so each test gets its own db.
+    import skypilot_tpu.global_user_state as gus
+    gus._db = None  # pylint: disable=protected-access
+    yield
+
+
+@pytest.fixture
+def enable_clouds():
+    """Mark gcp+kubernetes as enabled without touching credentials."""
+    from skypilot_tpu import global_user_state
+    global_user_state.set_enabled_clouds(['gcp', 'kubernetes'])
+    yield ['gcp', 'kubernetes']

@@ -1,0 +1,109 @@
+"""Optimizer dryrun tests with stubbed enabled clouds — zero cloud calls
+(the reference's key trick: tests/test_optimizer_dryruns.py + monkeypatched
+clouds, tests/common.py:11)."""
+import pytest
+
+from skypilot_tpu import (Dag, OptimizeTarget, Resources, Task, exceptions,
+                          optimize)
+
+
+def _single_task_dag(resources):
+    with Dag() as dag:
+        task = Task(name='t', run='python train.py')
+        task.set_resources(resources)
+    return dag, task
+
+
+def test_picks_cheapest_region(enable_clouds):
+    dag, task = _single_task_dag(Resources(accelerators='tpu-v5e-16'))
+    optimize(dag, quiet=True)
+    best = task.best_resources()
+    assert best.cloud_name == 'gcp'
+    assert best.accelerators == 'tpu-v5e-16'
+    # us regions are cheapest in the catalog.
+    assert best.region.startswith('us-')
+
+
+def test_spot_respected(enable_clouds):
+    dag, task = _single_task_dag(
+        Resources(accelerators='tpu-v5p-16', use_spot=True))
+    optimize(dag, quiet=True)
+    best = task.best_resources()
+    assert best.use_spot
+    od_cost = Resources(cloud='gcp',
+                        accelerators='tpu-v5p-16').get_hourly_cost()
+    assert best.get_hourly_cost(best.region) < od_cost
+
+
+def test_any_of_picks_cheaper_accelerator(enable_clouds):
+    dag, task = _single_task_dag({
+        Resources(accelerators='tpu-v5e-8'),
+        Resources(accelerators='tpu-v5p-8'),
+    })
+    optimize(dag, quiet=True)
+    # v5e-8 ($1.20*8) beats v5p-8 ($4.20*4... = $16.8 vs $9.6) → v5e.
+    assert task.best_resources().accelerators == 'tpu-v5e-8'
+
+
+def test_infeasible_raises_with_hint(enable_clouds):
+    with pytest.raises(exceptions.SkyTpuError):
+        dag, _ = _single_task_dag(
+            Resources(accelerators='tpu-v5e-8', region='us-east5'))
+        # v5e not offered in us-east5? it is (us-east5-b). Use a v4 region
+        # mismatch instead.
+        dag2, _ = _single_task_dag(
+            Resources(accelerators='tpu-v4-8', region='europe-west4'))
+        optimize(dag2, quiet=True)
+
+
+def test_no_cloud_enabled_raises():
+    from skypilot_tpu import global_user_state
+    global_user_state.set_enabled_clouds([])
+    dag, _ = _single_task_dag(Resources(accelerators='tpu-v5e-8'))
+    with pytest.raises(exceptions.NoCloudAccessError):
+        optimize(dag, quiet=True)
+
+
+def test_chain_dag_dp(enable_clouds):
+    with Dag() as dag:
+        train = Task(name='train', run='python train.py')
+        train.set_resources(Resources(accelerators='tpu-v5p-16'))
+        evaltask = Task(name='eval', run='python eval.py')
+        evaltask.set_resources(Resources(accelerators='tpu-v5e-8'))
+        train >> evaltask
+    optimize(dag, quiet=True)
+    assert train.best_resources().accelerators == 'tpu-v5p-16'
+    assert evaltask.best_resources().accelerators == 'tpu-v5e-8'
+
+
+def test_general_dag(enable_clouds):
+    with Dag() as dag:
+        a = Task(name='a', run='true')
+        b = Task(name='b', run='true')
+        c = Task(name='c', run='true')
+        for t in (a, b, c):
+            t.set_resources(Resources(accelerators='tpu-v5e-8'))
+        a >> c
+        b >> c
+    optimize(dag, quiet=True)
+    for t in (a, b, c):
+        assert t.best_resources() is not None
+
+
+def test_time_objective_prefers_bigger_slice(enable_clouds):
+    def runtime_by_chips(res):
+        # Perfect scaling: more chips, less time.
+        return 3600.0 * 64 / (res.tpu.chips * res.num_slices)
+
+    with Dag() as dag:
+        task = Task(name='t', run='python train.py')
+        task.set_resources({
+            Resources(accelerators='tpu-v5e-8'),
+            Resources(accelerators='tpu-v5e-64'),
+        })
+        task.set_time_estimator(runtime_by_chips)
+    optimize(dag, minimize=OptimizeTarget.TIME, quiet=True)
+    assert task.best_resources().accelerators == 'tpu-v5e-64'
+    # COST objective: equal $/chip-hr → same cost; DP must still resolve.
+    optimize(dag, minimize=OptimizeTarget.COST, quiet=True)
+    assert task.best_resources() is not None

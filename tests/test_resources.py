@@ -1,0 +1,87 @@
+"""Resources tests (reference analogue: tests/unit_tests/test_resources.py)."""
+import pickle
+
+import pytest
+
+from skypilot_tpu import Resources
+
+
+def test_basic_tpu_resources():
+    r = Resources(cloud='gcp', accelerators='tpu-v5e-16')
+    assert r.accelerators == 'tpu-v5e-16'
+    assert r.tpu.chips == 16
+    assert r.num_hosts == 2
+    assert r.is_launchable()
+
+
+def test_num_slices_multiplies_hosts_and_cost():
+    r1 = Resources(cloud='gcp', accelerators='tpu-v5e-16')
+    r2 = Resources(cloud='gcp', accelerators='tpu-v5e-16', num_slices=4)
+    assert r2.num_hosts == 8
+    assert abs(r2.get_hourly_cost() - 4 * r1.get_hourly_cost()) < 1e-6
+
+
+def test_stop_rules():
+    assert Resources(accelerators='tpu-v5e-1').supports_stop()
+    assert not Resources(accelerators='tpu-v5e-16').supports_stop()  # pod
+    assert not Resources(accelerators='tpu-v5e-1',
+                         use_spot=True).supports_stop()
+    assert not Resources(accelerators='tpu-v5e-1',
+                         num_slices=2).supports_stop()
+
+
+def test_less_demanding_than():
+    small = Resources(accelerators='tpu-v5e-8')
+    big = Resources(cloud='gcp', accelerators='tpu-v5e-16')
+    assert small.less_demanding_than(big)
+    assert not big.less_demanding_than(small)
+    other_gen = Resources(accelerators='tpu-v5p-16')
+    assert not other_gen.less_demanding_than(big)
+    spot = Resources(accelerators='tpu-v5e-8', use_spot=True)
+    assert not spot.less_demanding_than(big)
+
+
+def test_yaml_round_trip():
+    r = Resources(cloud='gcp', accelerators='tpu-v5p-32', use_spot=True,
+                  region='us-east5', disk_size=200,
+                  labels={'team': 'ml'}, num_slices=2)
+    config = r.to_yaml_config()
+    r2 = Resources.from_yaml_config(config)
+    assert r == r2
+    assert r2.use_spot and r2.region == 'us-east5'
+    assert r2.num_slices == 2
+
+
+def test_region_zone_validation():
+    with pytest.raises(ValueError):
+        Resources(accelerators='tpu-v5e-8', region='mars-central1')
+    with pytest.raises(ValueError):
+        Resources(accelerators='tpu-v5e-8', zone='us-central1-zzz')
+    r = Resources(accelerators='tpu-v5e-8', zone='us-central1-a')
+    assert r.region == 'us-central1'
+
+
+def test_accelerator_count_rejected():
+    with pytest.raises(ValueError):
+        Resources(accelerators={'tpu-v5e-8': 4})
+
+
+def test_spot_cheaper():
+    od = Resources(cloud='gcp', accelerators='tpu-v5e-8')
+    spot = Resources(cloud='gcp', accelerators='tpu-v5e-8', use_spot=True)
+    assert spot.get_hourly_cost() < od.get_hourly_cost()
+
+
+def test_pickle_round_trip():
+    r = Resources(cloud='gcp', accelerators='tpu-v5p-64', use_spot=True)
+    r2 = pickle.loads(pickle.dumps(r))
+    assert r == r2 and r2.tpu.hosts == 8
+
+
+def test_deploy_variables():
+    r = Resources(cloud='gcp', accelerators='tpu-v5e-16', use_spot=True)
+    v = r.make_deploy_variables('us-central1', 'us-central1-a', 'c1')
+    assert v['accelerator_type'] == 'v5litepod-16'
+    assert v['hosts_per_slice'] == 2
+    assert v['use_spot'] is True
+    assert v['runtime_version'] == 'v2-alpha-tpuv5-lite'

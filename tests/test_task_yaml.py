@@ -1,0 +1,135 @@
+"""Task YAML parsing tests (reference analogue: tests/test_yaml_parser.py)."""
+import textwrap
+
+import pytest
+import yaml
+
+from skypilot_tpu import Dag, Task
+
+
+def _task_from_yaml_str(text, env_overrides=None):
+    return Task.from_yaml_config(yaml.safe_load(textwrap.dedent(text)),
+                                 env_overrides)
+
+
+def test_minimal():
+    task = _task_from_yaml_str("""\
+        name: mnist
+        resources:
+          accelerators: tpu-v5e-1
+        run: python train.py
+        """)
+    assert task.name == 'mnist'
+    assert task.run == 'python train.py'
+    (res,) = task.resources
+    assert res.accelerators == 'tpu-v5e-1'
+
+
+def test_env_substitution():
+    task = _task_from_yaml_str("""\
+        envs:
+          MODEL: llama3-8b
+          BUCKET: gs://my-bucket
+        run: |
+          python train.py --model ${MODEL} --out $BUCKET/ckpt
+        """)
+    assert '--model llama3-8b' in task.run
+    assert 'gs://my-bucket/ckpt' in task.run
+
+
+def test_env_override_and_missing():
+    with pytest.raises(ValueError, match='need values'):
+        _task_from_yaml_str("""\
+            envs:
+              TOKEN:
+            run: echo $TOKEN
+            """)
+    task = _task_from_yaml_str("""\
+        envs:
+          TOKEN:
+        run: echo ${TOKEN}
+        """, env_overrides={'TOKEN': 'abc'})
+    assert task.envs['TOKEN'] == 'abc'
+    assert 'echo abc' in task.run
+
+
+def test_num_nodes_means_slices():
+    task = _task_from_yaml_str("""\
+        num_nodes: 2
+        resources:
+          accelerators: tpu-v5e-16
+        run: python train.py
+        """)
+    assert task.num_nodes == 2
+
+
+def test_resources_any_of():
+    task = _task_from_yaml_str("""\
+        resources:
+          any_of:
+            - accelerators: tpu-v5e-8
+            - accelerators: tpu-v5p-8
+        run: python train.py
+        """)
+    accs = sorted(r.accelerators for r in task.resources)
+    assert accs == ['tpu-v5e-8', 'tpu-v5p-8']
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(ValueError, match='Invalid task YAML'):
+        _task_from_yaml_str("""\
+            nonexistent_field: 1
+            run: echo hi
+            """)
+
+
+def test_round_trip():
+    task = _task_from_yaml_str("""\
+        name: t1
+        num_nodes: 2
+        resources:
+          accelerators: tpu-v5p-16
+          use_spot: true
+        envs:
+          A: b
+        setup: pip install -e .
+        run: python main.py
+        """)
+    config = task.to_yaml_config()
+    task2 = Task.from_yaml_config(config)
+    assert task2.name == 't1'
+    assert task2.num_nodes == 2
+    assert task2.setup == 'pip install -e .'
+    (res,) = task2.resources
+    assert res.use_spot
+
+
+def test_dag_chaining():
+    with Dag() as dag:
+        a = Task(name='train', run='python train.py')
+        b = Task(name='eval', run='python eval.py')
+        a >> b
+    assert len(dag) == 2
+    assert dag.is_chain()
+    assert dag.downstream(a) == [b]
+
+
+def test_dag_not_chain():
+    with Dag() as dag:
+        a = Task(name='a', run='true')
+        b = Task(name='b', run='true')
+        c = Task(name='c', run='true')
+        a >> c
+        b >> c
+    assert not dag.is_chain()
+    order = dag.topological_order()
+    assert order.index(c) == 2
+
+
+def test_per_rank_command_gen():
+    def gen(slice_rank, host_rank, num_slices, hosts_per_slice):
+        del num_slices, hosts_per_slice
+        return f'echo {slice_rank}-{host_rank}'
+
+    task = Task(name='t', run=gen)
+    assert callable(task.run)

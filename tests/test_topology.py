@@ -1,0 +1,73 @@
+"""Topology parsing tests (reference analogue: TPU cases in
+tests/test_optimizer_dryruns.py:134,147 and clouds/utils/gcp_utils tests)."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import topology
+
+
+def test_parse_v5p_64():
+    sl = topology.parse_accelerator('tpu-v5p-64')
+    assert sl.generation == 'v5p'
+    assert sl.chips == 32          # v5p sizes count TensorCores
+    assert sl.hosts == 8           # 4 chips per host
+    assert sl.is_pod
+    assert sl.gcp_accelerator_type == 'v5p-64'
+    assert sl.mesh_shape_hint() == (2, 4, 4)
+
+
+def test_parse_v5e_aliases():
+    for spelling in ('tpu-v5e-16', 'v5e-16', 'tpu-v5litepod-16',
+                     'v5litepod-16'):
+        sl = topology.parse_accelerator(spelling)
+        assert sl.generation == 'v5e'
+        assert sl.chips == 16
+        assert sl.hosts == 2
+        assert sl.name == 'tpu-v5e-16'
+        assert sl.gcp_accelerator_type == 'v5litepod-16'
+
+
+def test_parse_single_host():
+    sl = topology.parse_accelerator('tpu-v5e-1')
+    assert sl.chips == 1 and sl.hosts == 1 and not sl.is_pod
+    sl = topology.parse_accelerator('tpu-v2-8')
+    assert sl.chips == 4 and sl.hosts == 1    # 8 cores = 4 chips
+    sl = topology.parse_accelerator('tpu-v6e-8')
+    assert sl.chips == 8 and sl.hosts == 1
+
+
+def test_pod_vs_single_host_stop_rules():
+    assert topology.parse_accelerator('v5p-8').hosts == 1
+    assert not topology.parse_accelerator('v5p-8').is_pod
+    assert topology.parse_accelerator('v5p-16').is_pod
+
+
+def test_custom_topology():
+    sl = topology.parse_accelerator('tpu-v5p-64', topology='4x4x2')
+    assert sl.topology == '4x4x2'
+    with pytest.raises(exceptions.InvalidTopologyError):
+        topology.parse_accelerator('tpu-v5p-64', topology='4x4x4')
+
+
+def test_invalid():
+    with pytest.raises(exceptions.InvalidTopologyError):
+        topology.parse_accelerator('tpu-v9-8')
+    with pytest.raises(exceptions.InvalidTopologyError):
+        topology.parse_accelerator('a100-8')
+    with pytest.raises(exceptions.InvalidTopologyError):
+        topology.parse_accelerator('tpu-v5p-7')   # odd core count
+    with pytest.raises(exceptions.InvalidTopologyError):
+        topology.parse_accelerator('tpu-v5e-999999')
+
+
+def test_flops_and_hbm():
+    sl = topology.parse_accelerator('tpu-v5p-64')
+    assert sl.bf16_tflops == 32 * 459.0
+    assert sl.hbm_gb == 32 * 95.0
+
+
+def test_list_slice_sizes():
+    sizes = topology.list_slice_sizes('v5e')
+    assert 1 in sizes and 8 in sizes and 16 in sizes and 256 in sizes
+    sizes_p = topology.list_slice_sizes('v5p')
+    assert 8 in sizes_p and 16 in sizes_p   # core counts
