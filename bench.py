@@ -1,0 +1,90 @@
+"""Benchmark: flagship-model training throughput on the local TPU chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+- Model: llama3-1b (the flagship Llama-3-style architecture at a size that
+  trains on a single 16 GB v5e chip; same code path as the 8B/70B configs).
+- Measures steady-state step time of the full jitted train step (fwd + bwd +
+  adamw) on synthetic data, reports tokens/sec/chip.
+- vs_baseline = achieved MFU ÷ 0.45, the north-star MFU bar from
+  BASELINE.md (the reference publishes no throughput numbers of its own —
+  SURVEY §6 — so the MFU target is the tracking metric).
+
+Param dtype is bf16 here: fp32 master weights + Adam moments for a ~1B
+model would exceed a single v5e's HBM; throughput/MFU are unaffected.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='llama3-1b')
+    parser.add_argument('--steps', type=int, default=10)
+    parser.add_argument('--warmup', type=int, default=2)
+    parser.add_argument('--batch', type=int, default=8)
+    parser.add_argument('--seq', type=int, default=1024)
+    parser.add_argument('--quick', action='store_true',
+                        help='tiny model, few steps (smoke)')
+    args = parser.parse_args()
+
+    import jax
+
+    from skypilot_tpu.models import get_config
+    from skypilot_tpu.parallel import build_mesh, infer_mesh_config
+    from skypilot_tpu.train import (TrainConfig, create_sharded_state,
+                                    make_train_step, synthetic_batch)
+    from skypilot_tpu.train import metrics as metrics_lib
+
+    devices = jax.devices()
+    n = len(devices)
+    on_tpu = devices[0].platform == 'tpu'
+    if args.quick or not on_tpu:
+        model_name = 'test-tiny'
+        batch, seq, steps = 8, 128, 4
+    else:
+        model_name, batch, seq, steps = (args.model, args.batch, args.seq,
+                                         args.steps)
+    cfg = get_config(model_name, param_dtype='bfloat16')
+
+    mesh = build_mesh(infer_mesh_config(n))  # fsdp over all local chips
+    rng = jax.random.PRNGKey(0)
+    state, shardings = create_sharded_state(
+        cfg, mesh, rng, TrainConfig(warmup_steps=2, total_steps=1000))
+    step_fn = make_train_step(cfg, mesh, shardings)
+    # Cycle a few distinct batches so the loss stays an honest LM loss
+    # instead of memorizing one batch.
+    batches = [
+        synthetic_batch(jax.random.PRNGKey(i), batch, seq, cfg.vocab_size)
+        for i in range(4)
+    ]
+
+    timer = metrics_lib.StepTimer(warmup_steps=args.warmup)
+    loss = None
+    with mesh:
+        for i in range(steps + args.warmup):
+            timer.start()
+            state, m = step_fn(state, batches[i % len(batches)])
+            loss = float(m['loss'])  # sync: forces the step to finish
+            timer.stop()
+
+    step_time = timer.mean_step_time()
+    tps = metrics_lib.tokens_per_sec(batch, seq, step_time) / n
+    mfu = metrics_lib.mfu(cfg, batch, seq, step_time, num_chips=n)
+    print(f'model={cfg.name} chips={n} batch={batch} seq={seq} '
+          f'steps={steps} step_time={step_time*1e3:.1f}ms '
+          f'loss={loss:.3f} MFU={mfu*100:.1f}%', file=sys.stderr)
+    print(json.dumps({
+        'metric': f'{cfg.name} train tokens/sec/chip',
+        'value': round(tps, 1),
+        'unit': 'tokens/s/chip',
+        'vs_baseline': round(mfu / 0.45, 4),
+    }))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

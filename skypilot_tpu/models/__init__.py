@@ -1,0 +1,5 @@
+from skypilot_tpu.models.configs import (ModelConfig, get_config,
+                                         list_configs)
+from skypilot_tpu.models.transformer import Transformer
+
+__all__ = ['ModelConfig', 'Transformer', 'get_config', 'list_configs']

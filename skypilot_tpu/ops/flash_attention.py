@@ -1,0 +1,202 @@
+"""Fused causal attention for TPU (pallas), with an XLA reference path.
+
+This is one of the "hot ops" the framework owns natively (the reference
+framework delegates all compute to the engines it launches — vLLM/torch —
+per SURVEY §2.9; this framework ships its own model stack, so attention is
+in-tree).
+
+Design (per the pallas TPU playbook):
+- Online-softmax tiling: the (S,S) score matrix never materializes in HBM.
+  Grid = (batch*heads, S/block_q); K/V rows for one (batch, head) stay
+  resident in VMEM while q-blocks stream through the MXU.
+- Causal blocks are *skipped*, not masked: the k-loop upper bound is
+  derived from the q-block index, so the kernel does ~half the FLOPs of
+  dense attention.
+- fp32 accumulation, bf16 inputs (MXU-native).
+- Backward is a recompute VJP through the reference implementation: the
+  memory win (no S×S tensor saved for bwd) is kept, while XLA fuses the
+  recomputed backward well. A dedicated bwd kernel is a later optimization.
+
+GQA is handled by folding: kv heads are repeated to match q heads before
+the kernel (cheap relative to attention FLOPs at the sizes we run).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         causal: bool, sm_scale: float) -> jax.Array:
+    """Plain XLA attention; fp32 softmax. Shapes: (B, S, H, D)."""
+    logits = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits * sm_scale
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), s_k - s_q)
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum('bhqk,bkhd->bqhd', probs, v)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool,
+                block_q: int, block_k: int, seq_len: int, head_dim: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
+
+    num_kb = seq_len // block_k
+    if causal:
+        # Process every k-block containing keys ≤ the last query of this
+        # q-block: ceil((qi+1)*block_q / block_k).
+        hi = ((qi + 1) * block_q + block_k - 1) // block_k
+        hi = jnp.minimum(hi, num_kb)
+    else:
+        hi = num_kb
+
+    def body(kb, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(
+            jnp.float32)                                  # (bk, d)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(q, k_blk,
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, -1e30)
+        m_cur = jnp.max(s, axis=-1)                       # (bq,)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])                   # (bq, bk)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    init = (jnp.zeros((block_q, head_dim), jnp.float32),
+            jnp.full((block_q,), -jnp.inf, jnp.float32),
+            jnp.zeros((block_q,), jnp.float32))
+    acc, _, l = jax.lax.fori_loop(0, hi, body, init)
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _pallas_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
+                    sm_scale: float, block_q: int, block_k: int,
+                    interpret: bool) -> jax.Array:
+    """q,k,v: (BH, S, D) — pre-folded batch*heads, kv already repeated."""
+    bh, seq_len, head_dim = q.shape
+    grid = (bh, seq_len // block_q)
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               seq_len=seq_len, head_dim=head_dim)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim),
+                               lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_len, head_dim), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    b, s, h, d = q.shape
+    n_rep = h // k.shape[2]
+    kr = _repeat_kv(k, n_rep)
+    vr = _repeat_kv(v, n_rep)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = kr.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vf = vr.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    out = _pallas_forward(qf, kf, vf, causal, sm_scale, block_q, block_k,
+                          interpret)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
+    del block_q, block_k, interpret
+    q, k, v = residuals
+
+    def ref(q_, k_, v_):
+        n_rep = q_.shape[2] // k_.shape[2]
+        return _reference_attention(q_, _repeat_kv(k_, n_rep),
+                                    _repeat_kv(v_, n_rep), causal, sm_scale)
+
+    # Recompute-based backward: no S×S residual was saved by the kernel.
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array,
+                    k: jax.Array,
+                    v: jax.Array,
+                    *,
+                    causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    impl: str = 'auto') -> jax.Array:
+    """Multi-head attention with GQA support.
+
+    Args:
+      q: (batch, seq, num_heads, head_dim)
+      k/v: (batch, seq, num_kv_heads, head_dim); num_heads must be a
+        multiple of num_kv_heads.
+      impl: 'pallas' | 'xla' | 'auto' (pallas on TPU when shapes tile,
+        xla otherwise).
+    """
+    b, s, h, d = q.shape
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    if h % k.shape[2]:
+        raise ValueError(f'num_heads {h} not divisible by kv heads '
+                         f'{k.shape[2]}')
+    if impl == 'auto':
+        on_tpu = any(dev.platform == 'tpu' for dev in jax.devices())
+        tiles = (s % block_q == 0 and s % block_k == 0 and
+                 d in (64, 128, 256))
+        impl = 'pallas' if (on_tpu and tiles) else 'xla'
+    if impl == 'xla':
+        n_rep = h // k.shape[2]
+        return _reference_attention(q, _repeat_kv(k, n_rep),
+                                    _repeat_kv(v, n_rep), causal, sm_scale)
+    if impl in ('pallas', 'pallas_interpret'):
+        if s % block_q or s % block_k:
+            raise ValueError(f'seq {s} must tile by block_q={block_q}, '
+                             f'block_k={block_k}')
+        return _flash(q, k, v, causal, sm_scale, block_q, block_k,
+                      impl == 'pallas_interpret')
+    raise ValueError(f'Unknown impl {impl!r}')

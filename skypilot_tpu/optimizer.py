@@ -114,8 +114,7 @@ def _fill_in_launchable_resources(
                         candidates.append(cand.copy(region=r.name))
         candidates = [
             c for c in candidates
-            if not any(b.less_demanding_than(c) and
-                       c.less_demanding_than(b) for b in blocked)
+            if not any(c.should_be_blocked_by(b) for b in blocked)
         ]
         if not candidates:
             hint_msg = ''

@@ -1,0 +1,77 @@
+"""Logical-axis sharding rules: the single place where model dimensions are
+mapped to mesh axes.
+
+MaxText-style (the reference framework's TPU counterpart) but reduced to the
+axes this framework uses. Model code annotates arrays with *logical* names
+('batch', 'seq', 'embed', ...); these rules translate them to the physical
+mesh axes from parallel/mesh.py. Changing a parallelism strategy is a rule
+change, not a model change.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# (logical name, physical mesh axis/axes or None=replicated)
+LOGICAL_AXIS_RULES: List[Tuple[str, object]] = [
+    # Activations.
+    ('batch', ('dp', 'fsdp')),      # data parallel shards the batch
+    ('seq', 'sp'),                  # sequence/context parallelism
+    ('act_embed', 'tp'),            # activation feature dim under TP
+    ('act_heads', 'tp'),
+    # Weights.
+    ('embed', 'fsdp'),              # ZeRO-3 style weight sharding
+    ('heads', 'tp'),                # attention heads under TP
+    ('kv_heads', 'tp'),
+    ('qkv_dim', None),
+    ('mlp', 'tp'),                  # MLP hidden under TP
+    ('vocab', 'tp'),                # embedding/unembedding vocab dim
+    ('expert', 'ep'),               # MoE experts under expert parallelism
+    ('layers', 'pp'),               # stacked layer dim under pipeline
+    (None, None),
+]
+
+
+def logical_axis_rules() -> List[Tuple[str, object]]:
+    return list(LOGICAL_AXIS_RULES)
+
+
+def spec_for(*logical_axes: Optional[str]) -> PartitionSpec:
+    """PartitionSpec for a tuple of logical axis names."""
+    rules = dict((k, v) for k, v in LOGICAL_AXIS_RULES if k is not None)
+    parts = []
+    for name in logical_axes:
+        if name is None:
+            parts.append(None)
+        else:
+            parts.append(rules.get(name))
+    return PartitionSpec(*parts)
+
+
+def sharding_for(mesh: Mesh,
+                 *logical_axes: Optional[str]) -> NamedSharding:
+    return NamedSharding(mesh, spec_for(*logical_axes))
+
+
+def constrain(x: jax.Array, *logical_axes: Optional[str]) -> jax.Array:
+    """with_sharding_constraint by logical names; no-op outside a mesh."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec_for(*logical_axes))
+    except (ValueError, RuntimeError):
+        # Not under a mesh context (e.g. pure single-device eval).
+        return x
+
+
+def with_logical(x, *names: Optional[str]):
+    """flax param metadata wrapper (nn.with_logical_partitioning sugar)."""
+    return nn.with_logical_partitioning(x, names)
+
+
+def shard_params_sharding(mesh: Mesh, abstract_params):
+    """NamedShardings for a flax param pytree with logical metadata."""
+    logical_specs = nn.get_partition_spec(abstract_params)
+    return nn.logical_to_mesh_sharding(logical_specs, mesh,
+                                       logical_axis_rules())

@@ -114,7 +114,10 @@ class Resources:
             self._accelerators = self._tpu.name
         self._region = region
         self._zone = zone
-        if region is not None or zone is not None:
+        # Catalog regions are GCP's; kubernetes uses cluster-local
+        # pseudo-regions that the catalog does not know.
+        if (region is not None or zone is not None) and \
+                self._cloud_name != 'kubernetes':
             self._region, self._zone = catalog.validate_region_zone(
                 region, zone)
 
@@ -268,6 +271,25 @@ class Resources:
             if self._tpu.chips > other._tpu.chips:
                 return False
         if self._num_slices > other._num_slices:
+            return False
+        return True
+
+    def should_be_blocked_by(self, blocked: 'Resources') -> bool:
+        """One-way wildcard match: a blocked entry with unset fields blocks
+        every candidate matching its set fields (failover blocklists;
+        reference: sky/resources.py should_be_blocked_by)."""
+        if blocked._cloud_name is not None and \
+                blocked._cloud_name != self._cloud_name:
+            return False
+        if blocked._region is not None and blocked._region != self._region:
+            return False
+        if blocked._zone is not None and blocked._zone != self._zone:
+            return False
+        if blocked._accelerators is not None and \
+                blocked._accelerators != self._accelerators:
+            return False
+        if blocked._use_spot_specified and \
+                blocked._use_spot != self._use_spot:
             return False
         return True
 

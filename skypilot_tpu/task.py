@@ -210,10 +210,14 @@ class Task:
         schemas.validate_task(config)
         config = dict(config)
         envs = dict(config.get('envs') or {})
+        # Only a null YAML value marks a required env; '' is a legitimate
+        # explicit empty value.
+        required = {k for k, v in envs.items() if v is None}
         envs = {k: ('' if v is None else str(v)) for k, v in envs.items()}
         if env_overrides:
             envs.update(env_overrides)
-        missing = [k for k, v in envs.items() if v == '']
+            required -= set(env_overrides)
+        missing = sorted(required)
         if missing:
             raise ValueError(
                 f'Environment variable(s) {missing} need values. Pass '

@@ -1,0 +1,10 @@
+from skypilot_tpu.train.trainer import (TrainConfig, TrainState,
+                                        create_sharded_state,
+                                        cross_entropy_loss, make_optimizer,
+                                        make_train_step, synthetic_batch)
+
+__all__ = [
+    'TrainConfig', 'TrainState', 'create_sharded_state',
+    'cross_entropy_loss', 'make_optimizer', 'make_train_step',
+    'synthetic_batch',
+]

@@ -1,0 +1,72 @@
+"""Step-time → tokens/sec → MFU accounting.
+
+MFU = achieved matmul FLOPs/s ÷ peak bf16 FLOPs/s of the slice, using the
+standard 6·N-active + attention-term FLOPs/token model
+(ModelConfig.flops_per_token). Chip peak numbers come from
+topology.GENERATIONS so the same math works on any generation.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional
+
+import jax
+
+from skypilot_tpu import topology
+from skypilot_tpu.models.configs import ModelConfig
+
+
+def detect_chip_peak_tflops() -> float:
+    """Peak bf16 TFLOPs of one local device, by device-kind sniffing; falls
+    back to v5e if unknown (CPU test runs report vs-v5e numbers)."""
+    dev = jax.devices()[0]
+    kind = getattr(dev, 'device_kind', '').lower()
+    squashed = kind.replace(' ', '')
+    # 'v5 lite' must check before bare 'v5'-prefixed generations.
+    if 'lite' in squashed:
+        return topology.GENERATIONS['v5e'].bf16_tflops_per_chip
+    for gen in topology.GENERATIONS.values():
+        for alias in gen.aliases + (gen.name,):
+            if alias in squashed:
+                return gen.bf16_tflops_per_chip
+    return topology.GENERATIONS['v5e'].bf16_tflops_per_chip
+
+
+@dataclasses.dataclass
+class StepTimer:
+    """Wall-clock per-step measurement with warmup discard."""
+    warmup_steps: int = 2
+    times: List[float] = dataclasses.field(default_factory=list)
+    _t0: Optional[float] = None
+    _count: int = 0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> None:
+        assert self._t0 is not None
+        dt = time.perf_counter() - self._t0
+        self._count += 1
+        if self._count > self.warmup_steps:
+            self.times.append(dt)
+
+    def mean_step_time(self) -> float:
+        assert self.times, 'no timed steps (all warmup?)'
+        return sum(self.times) / len(self.times)
+
+
+def tokens_per_sec(batch_size: int, seq_len: int,
+                   step_time_s: float) -> float:
+    return batch_size * seq_len / step_time_s
+
+
+def mfu(cfg: ModelConfig, batch_size: int, seq_len: int, step_time_s: float,
+        num_chips: int, peak_tflops_per_chip: Optional[float] = None
+        ) -> float:
+    if peak_tflops_per_chip is None:
+        peak_tflops_per_chip = detect_chip_peak_tflops()
+    achieved = (cfg.flops_per_token(seq_len) * batch_size * seq_len /
+                step_time_s)
+    peak = peak_tflops_per_chip * 1e12 * num_chips
+    return achieved / peak

@@ -9,12 +9,26 @@
 """
 import os
 
-# Must be set before jax ever initializes.
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Must be set before jax backends initialize. Force CPU even when the
+# environment routes jax at a real TPU (tests are hermetic; the real chip is
+# for bench.py only). Note: an environment sitecustomize may have pinned
+# jax_platforms via the config API at interpreter start, so setting the env
+# var alone is not enough — override through jax.config and drop any
+# already-initialized backends.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+if _xb.backends_are_initialized():
+    from jax.extend.backend import clear_backends
+    clear_backends()
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,71 @@
+"""Regression tests for review findings."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import yaml
+
+from skypilot_tpu import Resources, Task
+from skypilot_tpu.ops.flash_attention import flash_attention
+
+
+def test_flash_attention_block_q_smaller_than_block_k():
+    """block_q < block_k must not skip the diagonal blocks."""
+    rng = jax.random.PRNGKey(0)
+    b, s, h, d = 1, 256, 2, 64
+    q = jax.random.normal(rng, (b, s, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d))
+    ref = flash_attention(q, k, v, impl='xla')
+    pal = flash_attention(q, k, v, impl='pallas_interpret', block_q=64,
+                          block_k=128)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(pal), atol=2e-3,
+                               rtol=2e-3)
+    assert float(jnp.abs(pal).sum()) > 0
+
+
+def test_kubernetes_region_accepted():
+    r = Resources(cloud='kubernetes', accelerators='tpu-v5e-8')
+    from skypilot_tpu.clouds import registry
+    feasible, _ = registry.get('kubernetes') \
+        .get_feasible_launchable_resources(r)
+    assert feasible and feasible[0].region == 'kubernetes'
+
+
+def test_blocked_resources_wildcard(enable_clouds):
+    from skypilot_tpu import Dag, exceptions, optimize
+    import pytest
+    with Dag() as dag:
+        t = Task(run='true')
+        t.set_resources(Resources(accelerators='tpu-v5e-8'))
+    # Wildcard block of the whole gcp cloud must filter every candidate.
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        optimize(dag, blocked_resources=[Resources(cloud='gcp')],
+                 quiet=True)
+    # Blocking one zone leaves the others.
+    with Dag() as dag2:
+        t2 = Task(run='true')
+        t2.set_resources(Resources(accelerators='tpu-v5e-8'))
+    optimize(dag2, blocked_resources=[
+        Resources(cloud='gcp', zone='us-central1-a')
+    ], quiet=True)
+    assert t2.best_resources() is not None
+
+
+def test_empty_env_value_allowed():
+    task = Task.from_yaml_config(yaml.safe_load("""
+envs:
+  WANDB_MODE: ''
+run: echo ok
+"""))
+    assert task.envs['WANDB_MODE'] == ''
+
+
+def test_param_tree_stable_across_remat():
+    from skypilot_tpu.models import Transformer, get_config
+    toks = jnp.ones((1, 16), jnp.int32)
+    trees = []
+    for remat in (True, False):
+        cfg = get_config('test-tiny', scan_layers=False, remat=remat)
+        params = Transformer(cfg).init(jax.random.PRNGKey(0), toks)['params']
+        trees.append(sorted(params.keys()))
+    assert trees[0] == trees[1]
