@@ -1,0 +1,43 @@
+"""Fake cloud: the hermetic-test provider.
+
+The reference cannot test its launch path without real clouds (SURVEY §4.5);
+this cloud + provision/fake close that gap. It shares GCP's catalog-driven
+feasibility/pricing (same offerings, same zones) but provisions into the
+file-backed fake state, with hosts at 127.0.0.1 so command runners execute
+locally. Enabled only when tests opt in via global_user_state.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.clouds import gcp
+
+# Guard: without this, `check()` would auto-enable the fake for real users
+# (its credentials always "work") and the optimizer could route production
+# launches into the fake state file.
+ENABLE_ENV = 'SKYTPU_ENABLE_FAKE_CLOUD'
+
+
+class Fake(gcp.GCP):
+
+    NAME = 'fake'
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if os.environ.get(ENABLE_ENV, '') not in ('1', 'true'):
+            return False, (f'fake cloud is test-only; set {ENABLE_ENV}=1 '
+                           'to enable.')
+        return True, None
+
+    @classmethod
+    def get_project_id(cls) -> str:
+        return 'fake-project'
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        return ['fake-user@fake-project']
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        return {}

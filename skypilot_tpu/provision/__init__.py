@@ -1,0 +1,52 @@
+"""Functional provision API with name-based cloud dispatch.
+
+Reference parity: sky/provision/__init__.py:29-197 (_route_to_cloud_impl).
+Each cloud module exposes the same flat functions; the dispatcher routes on
+provider name so backends never import cloud SDKs directly.
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable
+
+from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
+                                           InstanceStatus, ProvisionConfig,
+                                           ProvisionRecord, SliceInfo)
+
+_PROVIDERS = {
+    'gcp': 'skypilot_tpu.provision.gcp',
+    'kubernetes': 'skypilot_tpu.provision.kubernetes',
+    'fake': 'skypilot_tpu.provision.fake',
+}
+
+
+def _route(fname: str) -> Callable[..., Any]:
+
+    def impl(provider_name: str, *args, **kwargs):
+        key = provider_name.lower()
+        if key not in _PROVIDERS:
+            raise ValueError(f'Unknown provider {provider_name!r}; '
+                             f'known: {sorted(_PROVIDERS)}')
+        module = importlib.import_module(_PROVIDERS[key])
+        fn = getattr(module, fname)
+        return fn(*args, **kwargs)
+
+    impl.__name__ = fname
+    return impl
+
+
+run_instances = _route('run_instances')
+wait_instances = _route('wait_instances')
+stop_instances = _route('stop_instances')
+terminate_instances = _route('terminate_instances')
+query_instances = _route('query_instances')
+get_cluster_info = _route('get_cluster_info')
+open_ports = _route('open_ports')
+cleanup_ports = _route('cleanup_ports')
+
+__all__ = [
+    'ClusterInfo', 'HostInfo', 'InstanceStatus', 'ProvisionConfig',
+    'ProvisionRecord', 'SliceInfo', 'cleanup_ports', 'get_cluster_info',
+    'open_ports', 'query_instances', 'run_instances', 'stop_instances',
+    'terminate_instances', 'wait_instances',
+]

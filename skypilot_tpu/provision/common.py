@@ -1,0 +1,145 @@
+"""Shared dataclasses for the provision layer.
+
+Reference parity: sky/provision/common.py — ProvisionConfig/ProvisionRecord/
+InstanceInfo/ClusterInfo shapes, reshaped for TPU: one "instance" is one TPU
+slice (a gang of hosts), not one VM. Every host in a slice is SSH-able; the
+head host is host 0 of slice 0 (it runs the agent and the JAX coordinator).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, List, Optional
+
+
+class InstanceStatus(enum.Enum):
+    """Lifecycle of one TPU slice as reported by the cloud."""
+    PENDING = 'PENDING'        # creating / queued-resource not yet ACTIVE
+    RUNNING = 'RUNNING'
+    STOPPED = 'STOPPED'        # single-host non-spot only
+    STOPPING = 'STOPPING'
+    PREEMPTED = 'PREEMPTED'    # spot reclaimed; resource is wedged, delete it
+    TERMINATED = 'TERMINATED'
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Everything a cloud impl needs to create a cluster's slices.
+
+    Built from Resources.make_deploy_variables() plus cluster identity
+    (reference analogue: the rendered cluster YAML handed to the node
+    provider, sky/backends/backend_utils.py:751).
+    """
+    cluster_name: str
+    accelerator: str              # canonical, e.g. 'tpu-v5p-64'
+    accelerator_type: str         # cloud API form, e.g. 'v5p-64'
+    topology: str                 # e.g. '2x2x4'
+    num_slices: int
+    hosts_per_slice: int
+    runtime_version: Optional[str]
+    use_spot: bool
+    disk_size_gb: int
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    ports: List[str] = dataclasses.field(default_factory=list)
+    authorized_key: Optional[str] = None   # ssh public key to inject
+    user_data: Optional[str] = None        # startup script
+    network_tier: str = 'standard'
+    # Cloud-specific extras (GCP project, reserved capacity, ...).
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Result of run_instances: where the slices actually landed
+    (reference: sky/provision/common.py ProvisionRecord)."""
+    provider_name: str
+    cluster_name: str
+    region: str
+    zone: Optional[str]
+    resumed_instance_ids: List[str]
+    created_instance_ids: List[str]
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.created_instance_ids or
+                instance_id in self.resumed_instance_ids)
+
+
+@dataclasses.dataclass
+class HostInfo:
+    """One SSH-able host (TPU worker VM) inside a slice."""
+    host_id: int                   # worker index within the slice
+    internal_ip: Optional[str]
+    external_ip: Optional[str]
+    ssh_port: int = 22
+
+
+@dataclasses.dataclass
+class SliceInfo:
+    """One provisioned TPU slice (the gang unit)."""
+    instance_id: str               # cloud resource name
+    slice_index: int               # 0..num_slices-1 within the cluster
+    status: InstanceStatus
+    hosts: List[HostInfo]
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Live view of a cluster's slices, returned by get_cluster_info
+    (reference: sky/provision/common.py ClusterInfo; num_ips_per_node>1 for
+    TPU pods at sky/backends/cloud_vm_ray_backend.py:2485-2493 becomes the
+    explicit SliceInfo.hosts list here)."""
+    provider_name: str
+    cluster_name: str
+    region: str
+    zone: Optional[str]
+    slices: List[SliceInfo]
+    ssh_user: str = 'skytpu'
+    docker_user: Optional[str] = None
+
+    @property
+    def head_slice(self) -> Optional[SliceInfo]:
+        for s in self.slices:
+            if s.slice_index == 0:
+                return s
+        return None
+
+    @property
+    def head_host(self) -> Optional[HostInfo]:
+        s = self.head_slice
+        if s is None or not s.hosts:
+            return None
+        return s.hosts[0]
+
+    def all_hosts(self) -> List['HostRef']:
+        """Flat (slice, host) enumeration in global-rank order — the rank
+        wiring contract (reference's SKYPILOT_NODE_RANK sorted-IP scheme at
+        sky/backends/cloud_vm_ray_backend.py:482-506 is replaced by this
+        deterministic enumeration)."""
+        out = []
+        for s in sorted(self.slices, key=lambda s: s.slice_index):
+            for h in s.hosts:
+                out.append(HostRef(s.slice_index, h.host_id, h, s.instance_id))
+        return out
+
+    def ips_per_slice(self) -> List[List[str]]:
+        return [[h.internal_ip or '' for h in s.hosts]
+                for s in sorted(self.slices, key=lambda s: s.slice_index)]
+
+
+@dataclasses.dataclass
+class HostRef:
+    slice_index: int
+    host_id: int
+    host: HostInfo
+    instance_id: str
+
+    @property
+    def global_rank(self) -> int:
+        # Filled properly by callers that know hosts_per_slice; kept simple
+        # here because ClusterInfo.all_hosts() returns in rank order.
+        return -1

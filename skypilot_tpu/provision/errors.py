@@ -1,0 +1,156 @@
+"""TPU provisioning error taxonomy → failover decisions.
+
+The reference parses cloud error strings ad hoc inside the backend
+(FailoverCloudErrorHandlerV1/V2, sky/backends/cloud_vm_ray_backend.py:697-1120;
+the GCP branch decoding TPU quota/capacity/preempted-during-creation errors at
+:933-1060). TPU stockouts are the *common case*, not the exception, so here the
+taxonomy is a first-class module: every provisioning failure is classified into
+a scope that tells the failover engine exactly how much to blocklist.
+"""
+from __future__ import annotations
+
+import enum
+import re
+from typing import Optional
+
+
+class BlockScope(enum.Enum):
+    """How much of the search space one error eliminates."""
+    ZONE = 'zone'          # capacity stockout: try the next zone
+    REGION = 'region'      # regional quota / API disabled there: next region
+    CLOUD = 'cloud'        # account-wide quota, unsupported feature
+    PRECHECK = 'precheck'  # auth/config/validation: retrying cannot help
+
+
+class ProvisionerError(Exception):
+    """Raised by cloud impls; carries the classification."""
+
+    def __init__(self, message: str, scope: BlockScope,
+                 retryable_in_place: bool = False) -> None:
+        super().__init__(message)
+        self.scope = scope
+        # Transient API hiccups (5xx/rate limit) may be retried in the same
+        # zone before blocking it.
+        self.retryable_in_place = retryable_in_place
+
+
+class CapacityError(ProvisionerError):
+    """No TPU capacity in the zone right now (the normal case)."""
+
+    def __init__(self, message: str) -> None:
+        super().__init__(message, BlockScope.ZONE)
+
+
+class QuotaExceededError(ProvisionerError):
+    """Project quota for this accelerator/region exhausted."""
+
+    def __init__(self, message: str, scope: BlockScope = BlockScope.REGION
+                 ) -> None:
+        super().__init__(message, scope)
+
+
+class PreemptedDuringCreationError(ProvisionerError):
+    """Spot slice was reclaimed before it ever became ACTIVE (reference:
+    GCP error code 3 handling, sky/backends/cloud_vm_ray_backend.py:997)."""
+
+    def __init__(self, message: str) -> None:
+        super().__init__(message, BlockScope.ZONE)
+
+
+class PrecheckError(ProvisionerError):
+    """Credentials/permissions/validation — fail fast, do not failover."""
+
+    def __init__(self, message: str) -> None:
+        super().__init__(message, BlockScope.PRECHECK)
+
+
+class TransientApiError(ProvisionerError):
+    """Cloud API 5xx / rate limit; retry in place with backoff."""
+
+    def __init__(self, message: str) -> None:
+        super().__init__(message, BlockScope.ZONE, retryable_in_place=True)
+
+
+# Message fragments observed from tpu.googleapis.com / queued resources,
+# mirroring (and extending) the reference's GCP handler table at
+# sky/backends/cloud_vm_ray_backend.py:933-1060.
+_CAPACITY_PATTERNS = (
+    r'there is no more capacity',
+    r'not enough resources available',
+    r'insufficient capacity',
+    r'resource_exhausted',
+    r'stockout',
+    r'does not have enough resources available to fulfill the request',
+    r'the zone .* does not currently have sufficient capacity',
+)
+_QUOTA_PATTERNS = (
+    r'quota exceeded',
+    r'exceeded quota',
+    r'quota .* exceeded',
+    r'quota limit .* reached',
+)
+_PRECHECK_PATTERNS = (
+    r'permission denied',
+    r'permission_denied',
+    r'unauthenticated',
+    r'credentials',
+    r'has not enabled',
+    r'api .* not enabled',
+    r'invalid argument',
+    r'invalid_argument',
+    r'not found: projects/',
+    r'runtime version .* not found',
+    r'unsupported topology',
+)
+_TRANSIENT_PATTERNS = (
+    r'internal error',
+    r'service unavailable',
+    r'deadline exceeded',
+    r'rate limit',
+    r'too many requests',
+    r'connection reset',
+    r'timed out',
+)
+
+
+def _matches(text: str, patterns) -> bool:
+    return any(re.search(p, text) for p in patterns)
+
+
+def classify(exc: Exception,
+             http_status: Optional[int] = None) -> ProvisionerError:
+    """Map an arbitrary provisioning exception to the taxonomy.
+
+    Already-classified errors pass through; everything else is classified by
+    HTTP status first, then message fingerprints, defaulting to a
+    zone-scoped block (the conservative choice: keep walking zones).
+    """
+    if isinstance(exc, ProvisionerError):
+        return exc
+    text = str(exc).lower()
+    if http_status is not None:
+        if http_status in (401, 403):
+            return PrecheckError(str(exc))
+        if http_status == 429:
+            # TPU stockouts surface as 429 RESOURCE_EXHAUSTED; only treat as
+            # transient rate-limiting when no capacity/quota fingerprint.
+            if _matches(text, _QUOTA_PATTERNS):
+                return QuotaExceededError(str(exc))
+            if _matches(text, _CAPACITY_PATTERNS):
+                return CapacityError(str(exc))
+            return TransientApiError(str(exc))
+        if http_status == 400:
+            return PrecheckError(str(exc))
+        if http_status >= 500:
+            return TransientApiError(str(exc))
+    if _matches(text, _CAPACITY_PATTERNS):
+        return CapacityError(str(exc))
+    if _matches(text, _QUOTA_PATTERNS):
+        return QuotaExceededError(str(exc))
+    if _matches(text, _PRECHECK_PATTERNS):
+        return PrecheckError(str(exc))
+    if _matches(text, _TRANSIENT_PATTERNS):
+        return TransientApiError(str(exc))
+    if 'preempted' in text:
+        return PreemptedDuringCreationError(str(exc))
+    return ProvisionerError(str(exc), BlockScope.ZONE)

@@ -1,0 +1,23 @@
+"""In-memory/file-backed fake TPU cloud for hermetic tests.
+
+The reference has no fake cloud — its launch path is only testable against
+real clouds (SURVEY.md §4.5 calls this out as the gap to close). This fake
+implements the full functional provision API with injectable capacity and
+failure modes, so gang provisioning, failover, preemption recovery, and
+status reconciliation are all testable without network.
+"""
+from skypilot_tpu.provision.fake.instance import (cleanup_ports,
+                                                  get_cluster_info,
+                                                  open_ports,
+                                                  query_instances,
+                                                  run_instances,
+                                                  stop_instances,
+                                                  terminate_instances,
+                                                  wait_instances)
+from skypilot_tpu.provision.fake.state import FakeCloudState
+
+__all__ = [
+    'FakeCloudState', 'cleanup_ports', 'get_cluster_info', 'open_ports',
+    'query_instances', 'run_instances', 'stop_instances',
+    'terminate_instances', 'wait_instances',
+]

@@ -1,0 +1,216 @@
+"""Fake-cloud implementation of the functional provision API.
+
+Mirrors the GCP TPU impl's semantics exactly (stockouts, quota, spot
+preemption, pods-cannot-stop) so the failover engine and backends exercise
+the same code paths they would against tpu.googleapis.com. Hosts report
+127.0.0.1 so command runners can execute locally in end-to-end tests.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import errors
+from skypilot_tpu.provision.fake.state import FakeCloudState
+
+PROVIDER_NAME = 'fake'
+
+
+def _check_failure(state: Dict[str, Any], zone: str) -> None:
+    mode = state['fail'].get(zone)
+    if mode is None:
+        return
+    if mode == 'capacity':
+        raise errors.CapacityError(
+            f'The zone {zone!r} does not currently have sufficient capacity.')
+    if mode == 'quota':
+        raise errors.QuotaExceededError(f'Quota exceeded in {zone}.')
+    if mode == 'precheck':
+        raise errors.PrecheckError(f'Permission denied in {zone}.')
+    if isinstance(mode, dict) and 'transient' in mode:
+        if mode['transient'] > 0:
+            mode['transient'] -= 1
+            raise errors.TransientApiError(f'Service unavailable in {zone}.')
+        state['fail'].pop(zone, None)
+        return
+    if mode == 'preempt_during_creation':
+        return  # handled after creation below
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    assert zone is not None, 'fake cloud is zonal'
+    chips_per_slice = _chips(config)
+    state_handle = FakeCloudState()
+    with state_handle._locked() as state:  # pylint: disable=protected-access
+        _check_failure(state, zone)
+
+        existing = state['clusters'].get(cluster_name)
+        created, resumed = [], []
+        if existing is not None:
+            # Reuse/resume path (reference: run_instances is idempotent and
+            # resumes stopped nodes, sky/provision/gcp/instance.py).
+            for s in existing['slices']:
+                if s['status'] == 'STOPPED':
+                    s['status'] = 'RUNNING'
+                    resumed.append(s['instance_id'])
+                elif s['status'] == 'PREEMPTED':
+                    raise errors.ProvisionerError(
+                        f'Cluster {cluster_name} has a preempted slice; '
+                        f'it must be terminated before relaunch.',
+                        errors.BlockScope.PRECHECK)
+            return common.ProvisionRecord(PROVIDER_NAME, cluster_name,
+                                          existing['region'],
+                                          existing['zone'], resumed, [])
+
+        need = chips_per_slice * config.num_slices
+        cap = state['capacity'].get(zone)
+        if cap is not None and cap < need:
+            raise errors.CapacityError(
+                f'There is no more capacity in the zone {zone!r} '
+                f'(need {need} chips, {cap} left).')
+        if cap is not None:
+            state['capacity'][zone] = cap - need
+
+        slices = []
+        for i in range(config.num_slices):
+            instance_id = f'{cluster_name}-slice-{i}'
+            hosts = [{
+                'host_id': h,
+                'internal_ip': '127.0.0.1',
+                'external_ip': '127.0.0.1',
+                'ssh_port': 22,
+            } for h in range(config.hosts_per_slice)]
+            slices.append({
+                'instance_id': instance_id,
+                'slice_index': i,
+                'status': 'RUNNING',
+                'hosts': hosts,
+                'chips': chips_per_slice,
+            })
+            created.append(instance_id)
+        state['clusters'][cluster_name] = {
+            'region': region,
+            'zone': zone,
+            'accelerator': config.accelerator,
+            'spot': config.use_spot,
+            'labels': dict(config.labels),
+            'slices': slices,
+        }
+        if state['fail'].get(zone) == 'preempt_during_creation':
+            for s in slices:
+                s['status'] = 'PREEMPTED'
+            raise errors.PreemptedDuringCreationError(
+                f'Slice preempted during creation in {zone}.')
+    return common.ProvisionRecord(PROVIDER_NAME, cluster_name, region, zone,
+                                  [], created)
+
+
+def _chips(config: common.ProvisionConfig) -> int:
+    # accelerator_type is 'v5p-64' style; suffix counts cores for
+    # core-counting generations but capacity accounting in the fake just
+    # uses the suffix as-is.
+    try:
+        return int(config.accelerator_type.rsplit('-', 1)[1])
+    except (IndexError, ValueError):
+        return 1
+
+
+def wait_instances(region: str, cluster_name: str,
+                   state_filter: Optional[common.InstanceStatus]) -> None:
+    del region, cluster_name, state_filter  # fake transitions are immediate
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    del provider_config, worker_only
+    handle = FakeCloudState()
+    with handle._locked() as state:  # pylint: disable=protected-access
+        cluster = state['clusters'].get(cluster_name)
+        if cluster is None:
+            return
+        if cluster['spot']:
+            raise errors.ProvisionerError(
+                'Spot TPU slices cannot be stopped, only deleted.',
+                errors.BlockScope.PRECHECK)
+        for s in cluster['slices']:
+            if s['status'] == 'RUNNING':
+                s['status'] = 'STOPPED'
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    del provider_config, worker_only
+    handle = FakeCloudState()
+    with handle._locked() as state:  # pylint: disable=protected-access
+        cluster = state['clusters'].pop(cluster_name, None)
+        if cluster is None:
+            return
+        zone = cluster['zone']
+        cap = state['capacity'].get(zone)
+        if cap is not None:
+            # Chips return to the pool on delete (even for preempted slices
+            # — the wedged resource holds no real capacity).
+            total = sum(s['chips'] for s in cluster['slices'])
+            state['capacity'][zone] = cap + total
+
+
+def query_instances(
+    cluster_name: str,
+    provider_config: Optional[Dict[str, Any]] = None,
+    non_terminated_only: bool = True,
+) -> Dict[str, common.InstanceStatus]:
+    del provider_config
+    state = FakeCloudState().read()
+    cluster = state['clusters'].get(cluster_name)
+    if cluster is None:
+        return {}
+    out = {}
+    for s in cluster['slices']:
+        status = common.InstanceStatus(s['status'])
+        if non_terminated_only and status == common.InstanceStatus.TERMINATED:
+            continue
+        out[s['instance_id']] = status
+    return out
+
+
+def get_cluster_info(
+        region: str, cluster_name: str,
+        provider_config: Optional[Dict[str, Any]] = None
+) -> common.ClusterInfo:
+    del provider_config
+    state = FakeCloudState().read()
+    cluster = state['clusters'].get(cluster_name)
+    if cluster is None:
+        raise errors.ProvisionerError(f'No cluster {cluster_name}.',
+                                      errors.BlockScope.PRECHECK)
+    slices = []
+    for s in cluster['slices']:
+        hosts = [common.HostInfo(h['host_id'], h['internal_ip'],
+                                 h['external_ip'], h['ssh_port'])
+                 for h in s['hosts']]
+        slices.append(common.SliceInfo(s['instance_id'], s['slice_index'],
+                                       common.InstanceStatus(s['status']),
+                                       hosts, dict(cluster['labels'])))
+    return common.ClusterInfo(PROVIDER_NAME, cluster_name, cluster['region'],
+                              cluster['zone'], slices)
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del provider_config
+    handle = FakeCloudState()
+    with handle._locked() as state:  # pylint: disable=protected-access
+        state['ports'].setdefault(cluster_name, [])
+        state['ports'][cluster_name] = sorted(
+            set(state['ports'][cluster_name]) | set(ports))
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del provider_config
+    handle = FakeCloudState()
+    with handle._locked() as state:  # pylint: disable=protected-access
+        state['ports'].pop(cluster_name, None)

@@ -1,0 +1,101 @@
+"""Shared mutable state of the fake cloud.
+
+JSON-file-backed with an exclusive lock so controller subprocesses (managed
+jobs, serve) observe the same world as the test process. Path comes from
+``SKYTPU_FAKE_CLOUD_STATE`` or defaults under ``~/.skytpu/``.
+"""
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import json
+import os
+from typing import Any, Dict, Iterator, Optional
+
+
+def _state_path() -> str:
+    path = os.environ.get('SKYTPU_FAKE_CLOUD_STATE')
+    if path:
+        return path
+    return os.path.expanduser('~/.skytpu/fake_cloud.json')
+
+
+_EMPTY: Dict[str, Any] = {
+    # zone -> remaining chips (absent = unlimited)
+    'capacity': {},
+    # zone -> failure mode: 'capacity' | 'quota' | 'precheck' |
+    #         'preempt_during_creation' | {'transient': N}
+    'fail': {},
+    # cluster_name -> {region, zone, accelerator, spot, slices: [...]}
+    'clusters': {},
+    # recorded open_ports calls (for assertions)
+    'ports': {},
+}
+
+
+class FakeCloudState:
+    """Handle over the fake cloud's persisted state."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.path = path or _state_path()
+
+    @contextlib.contextmanager
+    def _locked(self) -> Iterator[Dict[str, Any]]:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        lock_path = self.path + '.lock'
+        with open(lock_path, 'w', encoding='utf-8') as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(self.path):
+                    with open(self.path, 'r', encoding='utf-8') as f:
+                        state = json.load(f)
+                else:
+                    state = json.loads(json.dumps(_EMPTY))
+                try:
+                    yield state
+                finally:
+                    # Persist even when the body raises: failure modes mutate
+                    # state *and* raise (transient counters decrement,
+                    # preempt-during-creation leaves a wedged slice behind),
+                    # exactly like a real cloud.
+                    tmp = self.path + '.tmp'
+                    with open(tmp, 'w', encoding='utf-8') as f:
+                        json.dump(state, f, indent=1)
+                    os.replace(tmp, self.path)
+            finally:
+                fcntl.flock(lock_f, fcntl.LOCK_UN)
+
+    # ---------------- test hooks ----------------
+    def reset(self) -> None:
+        with self._locked() as state:
+            state.clear()
+            state.update(json.loads(json.dumps(_EMPTY)))
+
+    def set_zone_capacity(self, zone: str, chips: Optional[int]) -> None:
+        with self._locked() as state:
+            if chips is None:
+                state['capacity'].pop(zone, None)
+            else:
+                state['capacity'][zone] = chips
+
+    def set_zone_failure(self, zone: str, mode: Optional[Any]) -> None:
+        with self._locked() as state:
+            if mode is None:
+                state['fail'].pop(zone, None)
+            else:
+                state['fail'][zone] = mode
+
+    def preempt(self, cluster_name: str, slice_index: int = 0) -> None:
+        """Simulate spot reclamation of one slice (the smoke tests' manual
+        `terminate-instances` trick, reference tests/test_smoke.py:888-950,
+        made a first-class hook)."""
+        with self._locked() as state:
+            cluster = state['clusters'].get(cluster_name)
+            assert cluster is not None, f'no cluster {cluster_name}'
+            for s in cluster['slices']:
+                if s['slice_index'] == slice_index:
+                    s['status'] = 'PREEMPTED'
+
+    def read(self) -> Dict[str, Any]:
+        with self._locked() as state:
+            return json.loads(json.dumps(state))

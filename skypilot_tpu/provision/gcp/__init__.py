@@ -1,0 +1,20 @@
+"""GCP TPU-VM provisioner (tpu.googleapis.com v2 + queued resources).
+
+Reference parity: sky/provision/gcp/ (3,725 LoC), specifically
+GCPTPUVMInstance at sky/provision/gcp/instance_utils.py:1185-1650. Here the
+TPU path is the *only* path — no GCE VM branch — and multislice + queued
+resources are first-class.
+"""
+from skypilot_tpu.provision.gcp.instance import (cleanup_ports,
+                                                 get_cluster_info,
+                                                 open_ports, query_instances,
+                                                 run_instances,
+                                                 stop_instances,
+                                                 terminate_instances,
+                                                 wait_instances)
+
+__all__ = [
+    'cleanup_ports', 'get_cluster_info', 'open_ports', 'query_instances',
+    'run_instances', 'stop_instances', 'terminate_instances',
+    'wait_instances',
+]

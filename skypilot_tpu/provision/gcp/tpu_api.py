@@ -1,0 +1,200 @@
+"""Minimal REST client for tpu.googleapis.com (v2).
+
+The reference talks to this API through discovery documents + gcloud
+fallbacks (sky/provision/gcp/instance_utils.py:1185-1650 GCPTPUVMInstance,
+:1689 legacy gcloud path). Here it is a direct, dependency-light REST client
+with an **injectable transport**: production uses google-auth'd urllib,
+tests inject a fake transport — no SDK, no discovery cache.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu.provision import errors
+
+API_ROOT = 'https://tpu.googleapis.com/v2'
+
+# transport(method, url, body_dict_or_None) -> (status_code, body_dict)
+Transport = Callable[[str, str, Optional[Dict[str, Any]]],
+                     'tuple[int, Dict[str, Any]]']
+
+_transport_override: Optional[Transport] = None
+
+
+def set_transport_override(transport: Optional[Transport]) -> None:
+    """Test hook: route all TPU API calls through a fake."""
+    global _transport_override
+    _transport_override = transport
+
+
+_cached_creds = None
+
+
+def _get_token() -> str:
+    """ADC credentials, cached module-wide and refreshed only on expiry —
+    the operation-polling loop must not hit the token endpoint every 2s."""
+    global _cached_creds
+    try:
+        import google.auth  # type: ignore
+        import google.auth.transport.requests  # type: ignore
+    except ImportError as e:
+        raise errors.PrecheckError(
+            'google-auth is required for real GCP provisioning; '
+            f'credentials unavailable: {e}') from e
+    if _cached_creds is None:
+        _cached_creds, _ = google.auth.default(
+            scopes=['https://www.googleapis.com/auth/cloud-platform'])
+    if not _cached_creds.valid:
+        _cached_creds.refresh(google.auth.transport.requests.Request())
+    return _cached_creds.token
+
+
+def _default_transport(method: str, url: str,
+                       body: Optional[Dict[str, Any]]):
+    """urllib + Application Default Credentials (no cloud SDK import cost
+    until first use — the reference's lazy-adaptor principle,
+    sky/adaptors/common.py:7)."""
+    token = _get_token()
+    import urllib.error
+    import urllib.request
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={'Authorization': f'Bearer {token}',
+                 'Content-Type': 'application/json'})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            payload = resp.read().decode() or '{}'
+            return resp.status, json.loads(payload)
+    except urllib.error.HTTPError as e:
+        payload = e.read().decode() or '{}'
+        try:
+            return e.code, json.loads(payload)
+        except json.JSONDecodeError:
+            return e.code, {'error': {'message': payload}}
+
+
+class TpuClient:
+    """Thin typed wrapper over the nodes + queuedResources endpoints."""
+
+    def __init__(self, project: str,
+                 transport: Optional[Transport] = None) -> None:
+        self.project = project
+        self._transport = (transport or _transport_override or
+                           _default_transport)
+
+    # ---------------- plumbing ----------------
+    def _call(self, method: str, path: str,
+              body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        url = f'{API_ROOT}/{path}'
+        status, payload = self._transport(method, url, body)
+        if status >= 400:
+            message = payload.get('error', {}).get('message', str(payload))
+            raise errors.classify(Exception(message), http_status=status)
+        return payload
+
+    def _wait_operation(self, op: Dict[str, Any],
+                        timeout: float = 1800.0) -> Dict[str, Any]:
+        name = op.get('name')
+        deadline = time.time() + timeout
+        while not op.get('done'):
+            if time.time() > deadline:
+                raise errors.TransientApiError(
+                    f'Operation {name} timed out after {timeout}s.')
+            time.sleep(2.0)
+            op = self._call('GET', name)
+        if 'error' in op:
+            message = op['error'].get('message', str(op['error']))
+            raise errors.classify(Exception(message))
+        return op.get('response', {})
+
+    def _parent(self, zone: str) -> str:
+        return f'projects/{self.project}/locations/{zone}'
+
+    # ---------------- nodes ----------------
+    def create_node(self, zone: str, node_id: str,
+                    node: Dict[str, Any], wait: bool = True) -> Dict[str, Any]:
+        op = self._call('POST', f'{self._parent(zone)}/nodes?nodeId={node_id}',
+                        node)
+        return self._wait_operation(op) if wait else op
+
+    def get_node(self, zone: str, node_id: str) -> Dict[str, Any]:
+        return self._call('GET', f'{self._parent(zone)}/nodes/{node_id}')
+
+    def list_nodes(self, zone: str) -> list:
+        out = self._call('GET', f'{self._parent(zone)}/nodes')
+        return out.get('nodes', [])
+
+    def delete_node(self, zone: str, node_id: str, wait: bool = True) -> None:
+        op = self._call('DELETE', f'{self._parent(zone)}/nodes/{node_id}')
+        if wait:
+            self._wait_operation(op)
+
+    def stop_node(self, zone: str, node_id: str, wait: bool = True) -> None:
+        op = self._call('POST', f'{self._parent(zone)}/nodes/{node_id}:stop',
+                        {})
+        if wait:
+            self._wait_operation(op)
+
+    def start_node(self, zone: str, node_id: str, wait: bool = True) -> None:
+        op = self._call('POST', f'{self._parent(zone)}/nodes/{node_id}:start',
+                        {})
+        if wait:
+            self._wait_operation(op)
+
+    # ---------------- queued resources (v5e/v5p/v6e) ----------------
+    def create_queued_resource(self, zone: str, qr_id: str,
+                               body: Dict[str, Any]) -> Dict[str, Any]:
+        return self._call(
+            'POST',
+            f'{self._parent(zone)}/queuedResources?queuedResourceId={qr_id}',
+            body)
+
+    def get_queued_resource(self, zone: str, qr_id: str) -> Dict[str, Any]:
+        return self._call('GET',
+                          f'{self._parent(zone)}/queuedResources/{qr_id}')
+
+    def delete_queued_resource(self, zone: str, qr_id: str,
+                               force: bool = True) -> None:
+        force_arg = '?force=true' if force else ''
+        op = self._call(
+            'DELETE',
+            f'{self._parent(zone)}/queuedResources/{qr_id}{force_arg}')
+        self._wait_operation(op)
+
+    def wait_queued_resource(self, zone: str, qr_id: str,
+                             timeout: float = 1800.0) -> Dict[str, Any]:
+        """Poll until ACTIVE, raising the classified error on FAILED /
+        SUSPENDED (TPU stockouts surface here as a state, not an HTTP
+        error)."""
+        deadline = time.time() + timeout
+        while True:
+            qr = self.get_queued_resource(zone, qr_id)
+            state = qr.get('state', {}).get('state', 'UNKNOWN')
+            if state == 'ACTIVE':
+                return qr
+            if state in ('FAILED', 'SUSPENDED'):
+                detail = json.dumps(qr.get('state', {}))
+                # Delete the dead QR so a later retry of this zone can
+                # recreate it (a lingering FAILED QR makes the nodeId 409
+                # forever and holds quota).
+                try:
+                    self.delete_queued_resource(zone, qr_id)
+                except errors.ProvisionerError:
+                    pass
+                raise errors.classify(
+                    Exception(f'Queued resource {qr_id} entered {state}: '
+                              f'{detail}'))
+            if time.time() > deadline:
+                # Still WAITING_FOR_RESOURCES at the deadline: treat as a
+                # zone stockout so failover proceeds, and clean up the QR.
+                try:
+                    self.delete_queued_resource(zone, qr_id)
+                except errors.ProvisionerError:
+                    pass
+                raise errors.CapacityError(
+                    f'Queued resource {qr_id} stuck in {state} for '
+                    f'{timeout}s; treating as stockout.')
+            time.sleep(5.0)

@@ -1,0 +1,175 @@
+"""The failover engine: walk candidates × regions × zones until a slice lands.
+
+Reference parity: RetryingVmProvisioner (sky/backends/
+cloud_vm_ray_backend.py:1121-2060) — `provision_with_retries` walks the
+optimizer's candidate list on ResourcesUnavailableError (:1911), `_retry_zones`
+walks zones within a region (:1291), and FailoverCloudErrorHandler parses
+errors into blocked-resource sets (:697-1120). Here the error taxonomy lives
+in provision/errors.py and each error carries its own BlockScope, so the
+engine is a clean loop instead of string-parsing in the backend.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import provision
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import errors
+
+logger = logging.getLogger(__name__)
+
+_IN_PLACE_RETRIES = 3
+_IN_PLACE_BACKOFF_S = 2.0
+
+
+@dataclasses.dataclass
+class ProvisionResult:
+    resources: 'resources_lib.Resources'   # pinned to the landed region/zone
+    record: provision_common.ProvisionRecord
+    cluster_info: provision_common.ClusterInfo
+
+
+class FailoverEngine:
+    """Stateless walk over the candidate space with error-driven blocklists."""
+
+    def __init__(self, sleep_between_attempts: float = 0.0) -> None:
+        self._blocked: List['resources_lib.Resources'] = []
+        self._sleep = sleep_between_attempts
+
+    def _is_blocked(self, candidate: 'resources_lib.Resources') -> bool:
+        return any(candidate.should_be_blocked_by(b) for b in self._blocked)
+
+    def _block(self, candidate: 'resources_lib.Resources',
+               scope: errors.BlockScope) -> None:
+        if scope == errors.BlockScope.ZONE:
+            self._blocked.append(candidate)
+        elif scope == errors.BlockScope.REGION:
+            self._blocked.append(candidate.copy(zone=None))
+        elif scope == errors.BlockScope.CLOUD:
+            self._blocked.append(candidate.copy(zone=None, region=None))
+
+    def _zone_candidates(
+        self, to_provision: 'resources_lib.Resources'
+    ) -> List[Tuple[str, str]]:
+        """(region, zone) pairs in failover order: cheapest region first,
+        honoring any pinned region/zone (reference: _yield_zones,
+        sky/backends/cloud_vm_ray_backend.py:1165)."""
+        if to_provision.zone is not None:
+            return [(to_provision.region, to_provision.zone)]
+        pairs = []
+        for region, zones, _ in catalog.get_region_zones(
+                to_provision.accelerators, to_provision.use_spot):
+            if (to_provision.region is not None and
+                    region != to_provision.region):
+                continue
+            for zone in zones:
+                pairs.append((region, zone))
+        return pairs
+
+    def _provision_one_zone(
+        self, provider: str, region: str, zone: str, cluster_name: str,
+        config: provision_common.ProvisionConfig
+    ) -> Tuple[provision_common.ProvisionRecord,
+               provision_common.ClusterInfo]:
+        attempt = 0
+        while True:
+            try:
+                record = provision.run_instances(provider, region, zone,
+                                                 cluster_name, config)
+                info = provision.get_cluster_info(
+                    provider, region, cluster_name,
+                    provider_config=dict(config.provider_config, zone=zone))
+                return record, info
+            except errors.ProvisionerError as e:
+                if e.retryable_in_place and attempt < _IN_PLACE_RETRIES:
+                    attempt += 1
+                    time.sleep(_IN_PLACE_BACKOFF_S * attempt)
+                    continue
+                raise
+
+    def provision_with_retries(
+        self,
+        cluster_name: str,
+        candidates: List['resources_lib.Resources'],
+        authorized_key: Optional[str] = None,
+        provider_config_extra: Optional[dict] = None,
+    ) -> ProvisionResult:
+        """Try every candidate across its regions/zones; raise
+        ResourcesUnavailableError carrying the full failover history when
+        the space is exhausted."""
+        history: List[Exception] = []
+        for to_provision in candidates:
+            provider = to_provision.cloud_name or 'gcp'
+            for region, zone in self._zone_candidates(to_provision):
+                attempt_res = to_provision.copy(region=region, zone=zone)
+                if self._is_blocked(attempt_res):
+                    continue
+                deploy = to_provision.make_deploy_variables(
+                    region, zone, cluster_name)
+                config = provision_common.ProvisionConfig(
+                    cluster_name=cluster_name,
+                    accelerator=to_provision.accelerators,
+                    accelerator_type=deploy['accelerator_type'],
+                    topology=deploy['topology'],
+                    num_slices=to_provision.num_slices,
+                    hosts_per_slice=deploy['hosts_per_slice'],
+                    runtime_version=deploy['runtime_version'],
+                    use_spot=to_provision.use_spot,
+                    disk_size_gb=to_provision.disk_size,
+                    labels=deploy['labels'],
+                    ports=deploy['ports'],
+                    authorized_key=authorized_key,
+                    provider_config=dict(provider_config_extra or {}),
+                )
+                logger.info('Provisioning %s as %s in %s/%s', cluster_name,
+                            to_provision.accelerators, region, zone)
+                try:
+                    record, info = self._provision_one_zone(
+                        provider, region, zone, cluster_name, config)
+                    return ProvisionResult(attempt_res, record, info)
+                except errors.ProvisionerError as e:
+                    history.append(e)
+                    if e.scope == errors.BlockScope.PRECHECK:
+                        # A precheck failure is per-cloud (bad k8s config
+                        # says nothing about GCP creds): block this cloud
+                        # and move to the next candidate instead of
+                        # aborting the whole walk.
+                        logger.info('  ...precheck failed on %s: %s',
+                                    provider, e)
+                        self._block(attempt_res, errors.BlockScope.CLOUD)
+                        break
+                    logger.info('  ...failed (%s-scoped): %s', e.scope.value,
+                                e)
+                    self._block(attempt_res, e.scope)
+                    # Gang semantics are all-or-nothing: a failed attempt may
+                    # have partially created slices (e.g. slice 0 landed,
+                    # slice 1 hit the stockout) or left a wedged preempted
+                    # node (reference: GCP error code 3 handling,
+                    # cloud_vm_ray_backend.py:997). Always tear down before
+                    # the next zone.
+                    try:
+                        provision.terminate_instances(
+                            provider, cluster_name,
+                            provider_config=dict(config.provider_config,
+                                                 zone=zone))
+                    except Exception:  # pylint: disable=broad-except
+                        logger.warning(
+                            'Cleanup of failed attempt %s in %s failed; a '
+                            'partial resource may linger.', cluster_name,
+                            zone)
+                    if self._sleep:
+                        time.sleep(self._sleep)
+        if history and all(
+                isinstance(e, errors.ProvisionerError) and
+                e.scope == errors.BlockScope.PRECHECK for e in history):
+            raise exceptions.ProvisionPrechecksError(history)
+        raise exceptions.ResourcesUnavailableError(
+            f'Failed to provision {cluster_name!r}: exhausted all candidate '
+            f'resources/regions/zones ({len(history)} attempts).',
+            failover_history=history)

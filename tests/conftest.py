@@ -39,6 +39,8 @@ def _isolate_state(tmp_path, monkeypatch):
     monkeypatch.setenv('SKYTPU_USER_HASH', 'testhash')
     monkeypatch.setenv('SKYTPU_CONFIG', str(tmp_path / 'config.yaml'))
     monkeypatch.setenv('SKYTPU_HOME', str(tmp_path / 'skytpu_home'))
+    monkeypatch.setenv('SKYTPU_FAKE_CLOUD_STATE',
+                       str(tmp_path / 'fake_cloud.json'))
     # Reset the global-state singleton so each test gets its own db.
     import skypilot_tpu.global_user_state as gus
     gus._db = None  # pylint: disable=protected-access

@@ -1,0 +1,278 @@
+"""Provision layer: fake cloud semantics, error taxonomy, failover engine,
+and the GCP TPU REST client against an injected fake transport.
+
+These are the hermetic launch-path tests the reference lacks (its failover
+engine at sky/backends/cloud_vm_ray_backend.py:1121-2060 is only exercised
+by real-cloud smoke tests).
+"""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import errors
+from skypilot_tpu.provision.fake import FakeCloudState
+from skypilot_tpu.provision.gcp import tpu_api
+from skypilot_tpu.provision.provisioner import FailoverEngine
+from skypilot_tpu.resources import Resources
+
+
+def _config(name='c', acc='tpu-v5e-8', slices=1, hosts=1, spot=False):
+    return common.ProvisionConfig(
+        cluster_name=name, accelerator=acc,
+        accelerator_type=acc.replace('tpu-', ''), topology='2x4',
+        num_slices=slices, hosts_per_slice=hosts,
+        runtime_version='v2-alpha-tpuv5-lite', use_spot=spot,
+        disk_size_gb=100)
+
+
+class TestFakeCloud:
+
+    def test_provision_and_query(self):
+        rec = provision.run_instances('fake', 'us-central1', 'us-central1-a',
+                                      'c1', _config(slices=2, hosts=2))
+        assert len(rec.created_instance_ids) == 2
+        statuses = provision.query_instances('fake', 'c1')
+        assert all(s == common.InstanceStatus.RUNNING
+                   for s in statuses.values())
+        info = provision.get_cluster_info('fake', 'us-central1', 'c1')
+        assert len(info.slices) == 2
+        assert info.slices[0].num_hosts == 2
+        # Rank-ordered flat host enumeration.
+        refs = info.all_hosts()
+        assert [(r.slice_index, r.host_id) for r in refs] == \
+            [(0, 0), (0, 1), (1, 0), (1, 1)]
+
+    def test_idempotent_rerun_resumes_stopped(self):
+        provision.run_instances('fake', 'us-central1', 'us-central1-a', 'c1',
+                                _config())
+        provision.stop_instances('fake', 'c1')
+        statuses = provision.query_instances('fake', 'c1')
+        assert list(statuses.values()) == [common.InstanceStatus.STOPPED]
+        rec = provision.run_instances('fake', 'us-central1', 'us-central1-a',
+                                      'c1', _config())
+        assert rec.resumed_instance_ids == ['c1-slice-0']
+        statuses = provision.query_instances('fake', 'c1')
+        assert list(statuses.values()) == [common.InstanceStatus.RUNNING]
+
+    def test_spot_cannot_stop(self):
+        provision.run_instances('fake', 'us-central1', 'us-central1-a', 'c1',
+                                _config(spot=True))
+        with pytest.raises(errors.ProvisionerError):
+            provision.stop_instances('fake', 'c1')
+
+    def test_capacity_accounting(self):
+        state = FakeCloudState()
+        state.set_zone_capacity('us-central1-a', 8)
+        provision.run_instances('fake', 'us-central1', 'us-central1-a', 'c1',
+                                _config(acc='tpu-v5e-8'))
+        with pytest.raises(errors.CapacityError):
+            provision.run_instances('fake', 'us-central1', 'us-central1-a',
+                                    'c2', _config(acc='tpu-v5e-8'))
+        provision.terminate_instances('fake', 'c1')
+        # Chips freed on delete.
+        provision.run_instances('fake', 'us-central1', 'us-central1-a', 'c2',
+                                _config(acc='tpu-v5e-8'))
+
+    def test_preemption_hook(self):
+        provision.run_instances('fake', 'us-central1', 'us-central1-a', 'c1',
+                                _config(spot=True, slices=2))
+        FakeCloudState().preempt('c1', slice_index=1)
+        statuses = provision.query_instances('fake', 'c1')
+        assert statuses['c1-slice-1'] == common.InstanceStatus.PREEMPTED
+        assert statuses['c1-slice-0'] == common.InstanceStatus.RUNNING
+
+
+class TestErrorTaxonomy:
+
+    def test_classify_capacity(self):
+        e = errors.classify(Exception(
+            'There is no more capacity in the zone us-central2-b'))
+        assert isinstance(e, errors.CapacityError)
+        assert e.scope == errors.BlockScope.ZONE
+
+    def test_classify_quota(self):
+        e = errors.classify(Exception('Quota exceeded for TPUV5sPodPerProject'))
+        assert e.scope == errors.BlockScope.REGION
+
+    def test_classify_precheck_by_status(self):
+        e = errors.classify(Exception('nope'), http_status=403)
+        assert e.scope == errors.BlockScope.PRECHECK
+
+    def test_classify_transient(self):
+        e = errors.classify(Exception('x'), http_status=503)
+        assert e.retryable_in_place
+
+    def test_passthrough(self):
+        orig = errors.CapacityError('x')
+        assert errors.classify(orig) is orig
+
+
+class TestFailoverEngine:
+
+    def _resources(self, **kw):
+        kw.setdefault('cloud', 'fake')
+        kw.setdefault('accelerators', 'tpu-v5e-8')
+        return Resources(**kw)
+
+    def test_lands_in_first_zone(self):
+        result = FailoverEngine().provision_with_retries(
+            'c1', [self._resources()])
+        assert result.resources.zone is not None
+        assert result.cluster_info.head_host is not None
+
+    def test_zone_failover_on_stockout(self):
+        # tpu-v2-8 offers two zones in us-central1 (b, f); block the first.
+        res = self._resources(accelerators='tpu-v2-8', region='us-central1')
+        state = FakeCloudState()
+        state.set_zone_failure('us-central1-b', 'capacity')
+        result = FailoverEngine().provision_with_retries('c1', [res])
+        assert result.resources.zone == 'us-central1-f'
+
+    def test_region_failover_on_quota(self):
+        res = self._resources()
+        from skypilot_tpu import catalog
+        pairs = catalog.get_region_zones('tpu-v5e-8', False)
+        first_region, first_zones, _ = pairs[0]
+        state = FakeCloudState()
+        for z in first_zones:
+            state.set_zone_failure(z, 'quota')
+        result = FailoverEngine().provision_with_retries('c1', [res])
+        assert result.resources.region != first_region
+
+    def test_exhaustion_carries_history(self):
+        res = self._resources()
+        state = FakeCloudState()
+        from skypilot_tpu import catalog
+        for _, zones, _ in catalog.get_region_zones('tpu-v5e-8', False):
+            for z in zones:
+                state.set_zone_failure(z, 'capacity')
+        with pytest.raises(exceptions.ResourcesUnavailableError) as exc:
+            FailoverEngine().provision_with_retries('c1', [res])
+        assert len(exc.value.failover_history) > 0
+        assert all(isinstance(e, errors.CapacityError)
+                   for e in exc.value.failover_history)
+
+    def test_precheck_raises_immediately(self):
+        res = self._resources(zone='us-west4-a')
+        FakeCloudState().set_zone_failure('us-west4-a', 'precheck')
+        with pytest.raises(exceptions.ProvisionPrechecksError):
+            FailoverEngine().provision_with_retries('c1', [res])
+
+    def test_transient_retried_in_place(self):
+        res = self._resources(zone='us-west4-a')
+        FakeCloudState().set_zone_failure('us-west4-a', {'transient': 2})
+        engine = FailoverEngine()
+        engine._sleep = 0.0  # pylint: disable=protected-access
+        import skypilot_tpu.provision.provisioner as prov_mod
+        orig = prov_mod._IN_PLACE_BACKOFF_S
+        prov_mod._IN_PLACE_BACKOFF_S = 0.0
+        try:
+            result = engine.provision_with_retries('c1', [res])
+        finally:
+            prov_mod._IN_PLACE_BACKOFF_S = orig
+        assert result.resources.zone == 'us-west4-a'
+
+    def test_preempted_during_creation_cleans_up_and_moves_on(self):
+        res = self._resources(accelerators='tpu-v2-8', region='us-central1',
+                              use_spot=True)
+        FakeCloudState().set_zone_failure('us-central1-b',
+                                          'preempt_during_creation')
+        result = FailoverEngine().provision_with_retries('c1', [res])
+        assert result.resources.zone == 'us-central1-f'
+        # The wedged slice in zone b was terminated (cluster record replaced
+        # by the successful attempt in zone f).
+        info = provision.get_cluster_info('fake', 'us-central1', 'c1')
+        assert info.zone == 'us-central1-f'
+
+    def test_candidate_list_walk(self):
+        """Second candidate (different accelerator) used when the first is
+        fully stocked out."""
+        from skypilot_tpu import catalog
+        state = FakeCloudState()
+        for _, zones, _ in catalog.get_region_zones('tpu-v5p-8', False):
+            for z in zones:
+                state.set_zone_failure(z, 'capacity')
+        c1 = self._resources(accelerators='tpu-v5p-8')
+        c2 = self._resources(accelerators='tpu-v5e-8')
+        result = FailoverEngine().provision_with_retries('c1', [c1, c2])
+        assert result.resources.accelerators == 'tpu-v5e-8'
+
+
+class TestGcpTpuClient:
+    """Drive the real GCP impl through a fake transport."""
+
+    def _fake_transport(self, log):
+        nodes = {}
+
+        def transport(method, url, body):
+            log.append((method, url))
+            if method == 'POST' and '/nodes?nodeId=' in url:
+                node_id = url.rsplit('nodeId=', 1)[1]
+                zone = url.split('/locations/')[1].split('/')[0]
+                nodes[node_id] = dict(
+                    body, name=f'projects/p/locations/{zone}/nodes/{node_id}',
+                    state='READY',
+                    networkEndpoints=[{
+                        'ipAddress': '10.0.0.1',
+                        'accessConfig': {'externalIp': '34.0.0.1'}
+                    }])
+                return 200, {'name': f'op/{node_id}', 'done': True,
+                             'response': {}}
+            if method == 'GET' and url.endswith('/nodes'):
+                return 200, {'nodes': list(nodes.values())}
+            if method == 'DELETE' and '/nodes/' in url:
+                node_id = url.rsplit('/', 1)[1]
+                nodes.pop(node_id, None)
+                return 200, {'name': 'op/del', 'done': True, 'response': {}}
+            if method == 'DELETE' and '/queuedResources/' in url:
+                return 404, {'error': {'message': 'not found: projects/x'}}
+            return 404, {'error': {'message': f'not found: projects/ {url}'}}
+
+        return transport
+
+    def test_create_list_info_delete(self):
+        log = []
+        tpu_api.set_transport_override(self._fake_transport(log))
+        try:
+            cfg = _config(name='g1', slices=2)
+            cfg.provider_config['project'] = 'p'
+            rec = provision.run_instances('gcp', 'us-central2',
+                                          'us-central2-b', 'g1', cfg)
+            assert rec.created_instance_ids == ['g1-0', 'g1-1']
+            info = provision.get_cluster_info(
+                'gcp', 'us-central2', 'g1',
+                provider_config={'project': 'p', 'zone': 'us-central2-b'})
+            assert len(info.slices) == 2
+            assert info.head_host.external_ip == '34.0.0.1'
+            provision.terminate_instances(
+                'gcp', 'g1',
+                provider_config={'project': 'p', 'zone': 'us-central2-b'})
+            statuses = provision.query_instances(
+                'gcp', 'g1',
+                provider_config={'project': 'p', 'zone': 'us-central2-b'})
+            assert not statuses
+        finally:
+            tpu_api.set_transport_override(None)
+
+    def test_stockout_classified(self):
+
+        def transport(method, url, body):
+            del method, body
+            if '/nodes?nodeId=' in url:
+                return 429, {'error': {'message':
+                             'There is no more capacity in the zone'}}
+            if url.endswith('/nodes'):
+                return 200, {'nodes': []}
+            return 404, {'error': {'message': 'not found: projects/x'}}
+
+        tpu_api.set_transport_override(transport)
+        try:
+            cfg = _config(name='g1')
+            cfg.provider_config['project'] = 'p'
+            with pytest.raises(errors.ProvisionerError) as e:
+                provision.run_instances('gcp', 'us-central2',
+                                        'us-central2-b', 'g1', cfg)
+            assert e.value.scope in (errors.BlockScope.ZONE,)
+        finally:
+            tpu_api.set_transport_override(None)
