@@ -1,0 +1,11 @@
+"""On-cluster runtime: job queue, gang driver, log streaming, autostop.
+
+Reference parity: sky/skylet/ (6,538 LoC) minus Ray — see each module's
+docstring for the mapping. The agent runs on host 0 of slice 0; jobs fan
+out to all hosts via the gang driver.
+"""
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.agent import log_lib
+
+__all__ = ['constants', 'job_lib', 'log_lib']

@@ -1,0 +1,118 @@
+"""The host agent daemon: job scheduling, status reconciliation, autostop.
+
+Reference parity: sky/skylet/skylet.py (20s tick over SkyletEvents,
+events.py:30-291). No Ray underneath: the agent ticks a scheduler step
+(launch pending gang drivers), reconciles dead drivers, and enforces
+autostop by calling the provisioner against its own cluster.
+
+Runs on host 0 of slice 0 ("head"), started detached by the backend's
+runtime bootstrap (reference analogue: start_skylet_on_head_node,
+sky/provision/instance_setup.py:407).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+from skypilot_tpu.agent import autostop_lib
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+
+logger = logging.getLogger(__name__)
+
+
+class JobSchedulerEvent:
+    """Launch pending jobs; reconcile dead drivers (reference:
+    JobSchedulerEvent + job status reconciliation, events.py:62)."""
+    interval = constants.AGENT_TICK_SECONDS
+
+    def step(self) -> None:
+        job_lib.update_job_statuses()
+        job_lib.schedule_step()
+
+
+class AutostopEvent:
+    """Stop/down the cluster from the inside when idle (reference:
+    AutostopEvent, events.py:90-291)."""
+    interval = 60
+
+    def __init__(self, cluster_name: str, provider: str,
+                 provider_config: dict) -> None:
+        self.cluster_name = cluster_name
+        self.provider = provider
+        self.provider_config = provider_config
+
+    def step(self) -> None:
+        cfg = autostop_lib.get_autostop_config()
+        if not cfg.enabled:
+            return
+        if not job_lib.is_cluster_idle():
+            autostop_lib.set_last_active_time_to_now()
+            return
+        idle_since = max(autostop_lib.get_last_active_time(),
+                         job_lib.last_activity_time(), cfg.set_at)
+        idle_minutes = (time.time() - idle_since) / 60.0
+        if idle_minutes < cfg.idle_minutes:
+            return
+        logger.info('Idle for %.1f min >= %d: autostop (down=%s).',
+                    idle_minutes, cfg.idle_minutes, cfg.down)
+        from skypilot_tpu import provision
+        if cfg.down:
+            provision.terminate_instances(
+                self.provider, self.cluster_name,
+                provider_config=self.provider_config)
+        else:
+            provision.stop_instances(self.provider, self.cluster_name,
+                                     provider_config=self.provider_config)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--cluster-name', required=True)
+    parser.add_argument('--provider', default='gcp')
+    parser.add_argument('--provider-config', default='{}',
+                        help='JSON provider config (project, zone, ...)')
+    parser.add_argument('--tick', type=float,
+                        default=constants.AGENT_TICK_SECONDS)
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(levelname)s %(name)s: %(message)s')
+    home = constants.agent_home()
+    os.makedirs(home, exist_ok=True)
+    with open(os.path.join(home, 'agent.pid'), 'w', encoding='utf-8') as f:
+        f.write(str(os.getpid()))
+
+    events = [
+        JobSchedulerEvent(),
+        AutostopEvent(args.cluster_name, args.provider,
+                      json.loads(args.provider_config)),
+    ]
+    last_run = {id(e): 0.0 for e in events}
+    logger.info('Agent up for cluster %s (home=%s).', args.cluster_name,
+                home)
+    while True:
+        now = time.time()
+        for event in events:
+            if now - last_run[id(event)] >= event.interval:
+                last_run[id(event)] = now
+                try:
+                    event.step()
+                except Exception:  # pylint: disable=broad-except
+                    logger.exception('Event %s failed.',
+                                     type(event).__name__)
+        # Heartbeat for liveness probing (the backend's
+        # wait-until-agent-ready reads this).
+        with open(os.path.join(home, 'agent.heartbeat'), 'w',
+                  encoding='utf-8') as f:
+            f.write(str(now))
+        time.sleep(args.tick)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

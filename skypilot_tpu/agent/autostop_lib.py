@@ -1,0 +1,78 @@
+"""Autostop: the cluster stops/tears itself down from the inside when idle.
+
+Reference parity: sky/skylet/autostop_lib.py (config + last-active time in a
+sqlite kv) and AutostopEvent (sky/skylet/events.py:90-291, which stops the
+cluster via the provisioner from inside the VM). TPU twist: pod slices and
+spot slices cannot stop — `down` is the only autostop action for them
+(enforced upstream by Resources.supports_stop()).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import sqlite3
+import time
+from typing import Optional
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.utils import db_utils
+
+
+def _create_table(cursor: sqlite3.Cursor, conn: sqlite3.Connection) -> None:
+    del conn
+    cursor.execute(
+        'CREATE TABLE IF NOT EXISTS kv (key TEXT PRIMARY KEY, value TEXT)')
+
+
+_dbs = {}
+
+
+def _get_db() -> db_utils.SQLiteConn:
+    path = constants.config_db_path()
+    if path not in _dbs:
+        _dbs[path] = db_utils.SQLiteConn(path, _create_table)
+    return _dbs[path]
+
+
+def _get(key: str) -> Optional[str]:
+    with _get_db().cursor() as c:
+        row = c.execute('SELECT value FROM kv WHERE key = ?',
+                        (key,)).fetchone()
+    return row[0] if row else None
+
+
+def _set(key: str, value: str) -> None:
+    with _get_db().cursor() as c:
+        c.execute('INSERT OR REPLACE INTO kv (key, value) VALUES (?, ?)',
+                  (key, value))
+
+
+@dataclasses.dataclass
+class AutostopConfig:
+    enabled: bool
+    idle_minutes: int
+    down: bool          # True: delete the slice; False: stop (if possible)
+    set_at: float
+
+
+def set_autostop(idle_minutes: int, down: bool) -> None:
+    """idle_minutes < 0 disables (reference CLI contract)."""
+    cfg = AutostopConfig(idle_minutes >= 0, max(idle_minutes, 0), down,
+                         time.time())
+    _set('autostop', json.dumps(dataclasses.asdict(cfg)))
+
+
+def get_autostop_config() -> AutostopConfig:
+    raw = _get('autostop')
+    if raw is None:
+        return AutostopConfig(False, 0, False, 0.0)
+    return AutostopConfig(**json.loads(raw))
+
+
+def set_last_active_time_to_now() -> None:
+    _set('last_active', str(time.time()))
+
+
+def get_last_active_time() -> float:
+    raw = _get('last_active')
+    return float(raw) if raw else 0.0

@@ -1,0 +1,128 @@
+"""RPC-by-codegen: the client runs `python -u -c <snippet>` on the head host
+over a CommandRunner and parses one encoded payload line back.
+
+Reference parity: the JobLibCodeGen / AutostopCodeGen idiom
+(sky/skylet/job_lib.py:803-935, sky/skylet/autostop_lib.py:105) — there is
+deliberately no client<->cluster RPC server; SSH is the only transport, so
+clusters need zero open ports beyond 22 (SURVEY §1: control crosses the
+machine boundary exactly one way).
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Any, List, Optional
+
+from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import common_utils
+
+_PREFIX = (
+    'from skypilot_tpu.agent import job_lib, autostop_lib; '
+    'from skypilot_tpu.utils import common_utils; ')
+
+
+def _build(code: List[str]) -> str:
+    body = _PREFIX + '; '.join(code)
+    return f'python3 -u -c {shlex.quote(body)}'
+
+
+class JobCodeGen:
+    """Each method returns a bash command string for the head host."""
+
+    @staticmethod
+    def add_job(job_name: str, username: Optional[str], run_timestamp: str,
+                resources_str: str) -> str:
+        return _build([
+            f'job_id = job_lib.add_job({job_name!r}, {username!r}, '
+            f'{run_timestamp!r}, {resources_str!r})',
+            'print(common_utils.encode_payload(job_id))',
+        ])
+
+    @staticmethod
+    def queue_job(job_id: int, spec_json: str) -> str:
+        return _build([
+            'import json',
+            f'job_lib.queue_job({job_id}, json.loads({spec_json!r}))',
+            'print(common_utils.encode_payload("ok"))',
+        ])
+
+    @staticmethod
+    def get_job_queue(username: Optional[str], all_jobs: bool) -> str:
+        return _build([
+            'import json',
+            f'records = job_lib.get_job_queue({username!r}, {all_jobs})',
+            'payload = [dict(r, status=r["status"].value, spec=None) '
+            'for r in records]',
+            'print(common_utils.encode_payload(payload))',
+        ])
+
+    @staticmethod
+    def get_job_status(job_id: int) -> str:
+        return _build([
+            f'status = job_lib.get_status({job_id})',
+            'print(common_utils.encode_payload('
+            'status.value if status else None))',
+        ])
+
+    @staticmethod
+    def cancel_jobs(job_ids: Optional[List[int]], cancel_all: bool) -> str:
+        return _build([
+            f'cancelled = job_lib.cancel_jobs({job_ids!r}, {cancel_all})',
+            'print(common_utils.encode_payload(cancelled))',
+        ])
+
+    @staticmethod
+    def fail_all_inflight_jobs() -> str:
+        return _build([
+            'job_lib.fail_all_inflight_jobs()',
+            'print(common_utils.encode_payload("ok"))',
+        ])
+
+    @staticmethod
+    def tail_logs(job_id: Optional[int], follow: bool) -> str:
+        """Streams (does not payload-encode) — run with stream_logs=True."""
+        code = [
+            'import os, sys',
+            'from skypilot_tpu.agent import log_lib, constants',
+            (f'job_id = {job_id}' if job_id is not None else
+             'job_id = job_lib.get_latest_job_id()'),
+            'rec = job_lib.get_record(job_id) if job_id else None',
+            ('sys.exit(print("No such job.") or 1) '
+             'if rec is None else None'),
+            'log_dir = constants.job_log_dir(rec["run_timestamp"])',
+            ('log_lib.tail_logs(os.path.join(log_dir, "run.log"), '
+             f'follow={follow}, job_is_running=lambda: '
+             'not job_lib.get_status(job_id).is_terminal())'),
+        ]
+        return _build(code)
+
+    @staticmethod
+    def get_log_dir(job_id: Optional[int]) -> str:
+        return _build([
+            (f'job_id = {job_id}' if job_id is not None else
+             'job_id = job_lib.get_latest_job_id()'),
+            'print(common_utils.encode_payload(job_lib.log_dir_for(job_id) '
+            'if job_id else None))',
+        ])
+
+
+class AutostopCodeGen:
+
+    @staticmethod
+    def set_autostop(idle_minutes: int, down: bool) -> str:
+        return _build([
+            f'autostop_lib.set_autostop({idle_minutes}, {down})',
+            'print(common_utils.encode_payload("ok"))',
+        ])
+
+
+def run_on_head(runner: 'runner_lib.CommandRunner', code: str,
+                stream_logs: bool = False) -> Any:
+    """Execute a codegen command and decode its payload (or stream)."""
+    if stream_logs:
+        rc = runner.run(code, stream_logs=True)
+        return rc
+    rc, stdout, stderr = runner.run(code, require_outputs=True)
+    if rc != 0:
+        from skypilot_tpu import exceptions
+        raise exceptions.CommandError(rc, code[:200], stderr)
+    return common_utils.decode_payload(stdout)

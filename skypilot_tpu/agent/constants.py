@@ -1,0 +1,68 @@
+"""On-cluster constants: paths, ports, env-var names.
+
+Reference parity: sky/skylet/constants.py (ray port 6380, SKYPILOT_NODE_*
+env names, runtime venv). The Ray-specific knobs disappear; in their place
+is the JAX/TPU rank-wiring contract exported to every user process.
+"""
+from __future__ import annotations
+
+import os
+
+AGENT_TICK_SECONDS = 5
+AGENT_PORT = 46580           # reserved for a future HTTP fast-path
+JAX_COORDINATOR_PORT = 8476  # jax.distributed default
+MEGASCALE_PORT = 8081
+
+# All agent state lives under this root (jobs.db, logs/, config.db). The
+# env override is what lets fake-cloud "hosts" on one machine each get
+# their own isolated root.
+def agent_home() -> str:
+    return os.path.expanduser(os.environ.get('SKYTPU_HOME', '~/.skytpu'))
+
+
+def jobs_db_path() -> str:
+    return os.path.join(agent_home(), 'jobs.db')
+
+
+def config_db_path() -> str:
+    return os.path.join(agent_home(), 'config.db')
+
+
+def logs_dir() -> str:
+    return os.path.join(agent_home(), 'sky_logs')
+
+
+def job_log_dir(run_timestamp: str) -> str:
+    return os.path.join(logs_dir(), run_timestamp)
+
+
+# ---------------- rank-wiring env contract ----------------
+# Exported to every rank of every job (replacing the reference's
+# SKYPILOT_NODE_RANK/NODE_IPS/NUM_NODES/NUM_GPUS_PER_NODE exports at
+# sky/backends/cloud_vm_ray_backend.py:570-637).
+ENV_TASK_ID = 'SKYTPU_TASK_ID'
+ENV_JOB_ID = 'SKYTPU_JOB_ID'
+ENV_NUM_SLICES = 'SKYTPU_NUM_SLICES'
+ENV_SLICE_INDEX = 'SKYTPU_SLICE_INDEX'
+ENV_NUM_NODES = 'SKYTPU_NUM_NODES'          # total hosts across slices
+ENV_NODE_RANK = 'SKYTPU_NODE_RANK'          # global host rank
+ENV_HOST_INDEX = 'SKYTPU_HOST_INDEX'        # host index within its slice
+ENV_NODE_IPS = 'SKYTPU_NODE_IPS'            # newline-separated, rank order
+ENV_CHIPS_PER_HOST = 'SKYTPU_CHIPS_PER_HOST'
+ENV_ACCELERATOR = 'SKYTPU_ACCELERATOR'
+
+# JAX distributed bootstrap (single slice, and CPU-simulated meshes in
+# tests): jax.distributed.initialize() reads these.
+ENV_JAX_COORDINATOR = 'JAX_COORDINATOR_ADDRESS'
+ENV_JAX_NUM_PROCESSES = 'JAX_NUM_PROCESSES'
+ENV_JAX_PROCESS_ID = 'JAX_PROCESS_ID'
+
+# Multislice (DCN) megascale wiring: libtpu reads these on real TPU pods.
+ENV_MEGASCALE_COORDINATOR = 'MEGASCALE_COORDINATOR_ADDRESS'
+ENV_MEGASCALE_NUM_SLICES = 'MEGASCALE_NUM_SLICES'
+ENV_MEGASCALE_SLICE_ID = 'MEGASCALE_SLICE_ID'
+ENV_MEGASCALE_PORT = 'MEGASCALE_PORT'
+
+# Marker injected into every job process's env so cancellation can kill the
+# whole gang by pattern (`pkill -f`), replacing Ray's task cancellation.
+ENV_JOB_MARKER = 'SKYTPU_JOB_MARKER'

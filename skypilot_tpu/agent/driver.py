@@ -1,0 +1,247 @@
+"""The gang driver: run one job's command on every host of every slice.
+
+This replaces the reference's generated Ray driver program (RayCodeGen,
+sky/backends/cloud_vm_ray_backend.py:211-678): placement-group gang
+scheduling becomes "the slice already exists" (provisioning *is* the gang),
+setup tasks become parallel per-host setup commands, per-rank ray tasks
+become per-host processes launched over runners, and `get_or_fail` +
+straggler cancellation (:637-678) becomes first-failure kill of the gang.
+
+Runs detached (spawned by job_lib.schedule_step), owns the job's status
+transitions SETTING_UP -> RUNNING -> terminal, and tees per-rank output into
+rank-named log files plus a combined run.log that tail_logs streams
+(reference rank-named files: cloud_vm_ray_backend.py:608-617).
+
+Spec schema (JSON, written by the backend):
+{
+  "job_id": 3, "cluster_name": "c", "run_timestamp": "sky-...",
+  "setup_cmd": "pip install -r ..." | null,
+  "run_cmd": "python train.py",
+  "env": {"USER_VAR": "x"},
+  "accelerator": "tpu-v5e-8", "chips_per_host": 4, "num_slices": 1,
+  "task_id": "sky-..._c_3",
+  "hosts": [
+    {"slice": 0, "host": 0, "ip": "127.0.0.1", "ssh_port": 22,
+     "runner": "local" | "ssh", "ssh_user": "...", "ssh_key": "...",
+     "home": "/per/host/home (fake hosts only)"},
+    ...
+  ]
+}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.utils import command_runner
+
+
+def make_runner(host: Dict[str, Any]) -> command_runner.CommandRunner:
+    host_env = {}
+    if host.get('home'):
+        host_env['SKYTPU_HOME'] = host['home']
+    if host.get('runner', 'local') == 'local':
+        return command_runner.LocalCommandRunner(host_env)
+    return command_runner.SSHCommandRunner(host['ip'], host['ssh_user'],
+                                           host['ssh_key'],
+                                           host.get('ssh_port', 22),
+                                           host_env)
+
+
+def rank_env(spec: Dict[str, Any], rank: int) -> Dict[str, str]:
+    """The rank-wiring contract (see agent/constants.py). Host order in
+    spec['hosts'] IS rank order."""
+    hosts = spec['hosts']
+    host = hosts[rank]
+    head_ip = hosts[0]['ip']
+    num_slices = int(spec.get('num_slices', 1))
+    env = {
+        constants.ENV_TASK_ID: spec.get('task_id', ''),
+        constants.ENV_JOB_ID: str(spec['job_id']),
+        constants.ENV_NUM_SLICES: str(num_slices),
+        constants.ENV_SLICE_INDEX: str(host['slice']),
+        constants.ENV_NUM_NODES: str(len(hosts)),
+        constants.ENV_NODE_RANK: str(rank),
+        constants.ENV_HOST_INDEX: str(host['host']),
+        constants.ENV_NODE_IPS: '\n'.join(h['ip'] for h in hosts),
+        constants.ENV_CHIPS_PER_HOST: str(spec.get('chips_per_host', 0)),
+        constants.ENV_ACCELERATOR: spec.get('accelerator', ''),
+    }
+    if len(hosts) > 1:
+        # Explicit JAX coordinator wiring for multi-host single-slice (on
+        # real TPU pods jax.distributed.initialize() can also self-discover
+        # via the TPU metadata server; exporting these works for both and
+        # is the only option for CPU-simulated meshes).
+        env[constants.ENV_JAX_COORDINATOR] = (
+            f'{head_ip}:{constants.JAX_COORDINATOR_PORT}')
+        env[constants.ENV_JAX_NUM_PROCESSES] = str(len(hosts))
+        env[constants.ENV_JAX_PROCESS_ID] = str(rank)
+    if num_slices > 1:
+        env[constants.ENV_MEGASCALE_COORDINATOR] = (
+            f'{head_ip}:{constants.MEGASCALE_PORT}')
+        env[constants.ENV_MEGASCALE_NUM_SLICES] = str(num_slices)
+        env[constants.ENV_MEGASCALE_SLICE_ID] = str(host['slice'])
+        env[constants.ENV_MEGASCALE_PORT] = str(constants.MEGASCALE_PORT)
+    return env
+
+
+class GangRun:
+    """Run one command on all hosts; first failure cancels the stragglers
+    (reference epilogue semantics: get_or_fail + returncode-137 cancel,
+    cloud_vm_ray_backend.py:637-678)."""
+
+    def __init__(self, spec: Dict[str, Any], log_dir: str,
+                 marker: str) -> None:
+        self.spec = spec
+        self.log_dir = log_dir
+        self.marker = marker
+        self._procs: List[Optional[Any]] = [None] * len(spec['hosts'])
+        self._rcs: List[Optional[int]] = [None] * len(spec['hosts'])
+        self._lock = threading.Lock()
+        self._failed = threading.Event()
+        self._combined = open(os.path.join(log_dir, 'run.log'), 'a',
+                              buffering=1, encoding='utf-8')
+
+    def _pump(self, rank: int, proc, prefix: str) -> None:
+        rank_log = os.path.join(self.log_dir, f'rank-{rank}.log')
+        with open(rank_log, 'a', buffering=1, encoding='utf-8') as rf:
+            for line in proc.stdout:
+                rf.write(line)
+                with self._lock:
+                    self._combined.write(prefix + line)
+        rc = proc.wait()
+        self._rcs[rank] = rc
+        if rc != 0:
+            self._failed.set()
+
+    def _cancel_stragglers(self) -> None:
+        for rank, host in enumerate(self.spec['hosts']):
+            proc = self._procs[rank]
+            if proc is None or proc.poll() is not None:
+                continue
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+            # Killing the bash/ssh wrapper orphans its children (they keep
+            # the stdout pipe open, wedging the pump thread); kill the whole
+            # gang by env marker on the host (requires skypilot_tpu on the
+            # host, which provisioning installs — reference ships its wheel
+            # the same way, sky/backends/wheel_utils.py).
+            runner = make_runner(host)
+            # sys.executable only exists on this machine; remote hosts use
+            # their own python3 (provisioning guarantees one).
+            python = (sys.executable
+                      if host.get('runner', 'local') == 'local' else
+                      'python3')
+            runner.run(
+                f'{python} -c "from skypilot_tpu.utils.'
+                f'subprocess_utils import kill_by_marker; '
+                f'kill_by_marker(\'{self.marker}\')" || true',
+                stream_logs=False)
+
+    def run(self, cmd: str, base_env: Dict[str, str]) -> List[int]:
+        hosts = self.spec['hosts']
+        many = len(hosts) > 1
+        threads = []
+        for rank, host in enumerate(hosts):
+            env = dict(base_env)
+            env.update(rank_env(self.spec, rank))
+            env[constants.ENV_JOB_MARKER] = self.marker
+            runner = make_runner(host)
+            proc = runner.popen(cmd, env=env)
+            self._procs[rank] = proc
+            prefix = f'(rank {rank}) ' if many else ''
+            t = threading.Thread(target=self._pump,
+                                 args=(rank, proc, prefix), daemon=True)
+            t.start()
+            threads.append(t)
+        # Wait; on first failure cancel the rest (poll so we can react
+        # before slow ranks finish).
+        cancelled = False
+        while any(t.is_alive() for t in threads):
+            if self._failed.is_set() and not cancelled:
+                self._cancel_stragglers()
+                cancelled = True
+                break
+            for t in threads:
+                t.join(timeout=0.2)
+        for t in threads:
+            t.join(timeout=15.0 if cancelled else None)
+        if cancelled and any(t.is_alive() for t in threads):
+            # Orphans still hold the stdout pipe (e.g. the remote marker
+            # kill found no python); force-close to unblock pump readline —
+            # the job must reach a terminal status no matter what.
+            for proc in self._procs:
+                if proc is not None and proc.stdout is not None:
+                    try:
+                        proc.stdout.close()
+                    except OSError:
+                        pass
+            for t in threads:
+                t.join(timeout=5.0)
+        self._combined.flush()
+        return [rc if rc is not None else 137 for rc in self._rcs]
+
+    def close(self) -> None:
+        self._combined.close()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    parser.add_argument('--spec', required=True)
+    parser.add_argument('--marker', default=None)
+    args = parser.parse_args()
+
+    with open(args.spec, 'r', encoding='utf-8') as f:
+        spec = json.load(f)
+    job_id = args.job_id
+    log_dir = constants.job_log_dir(spec['run_timestamp'])
+    os.makedirs(log_dir, exist_ok=True)
+    marker = args.marker or f'skytpu-job-{job_id}'
+
+    def _sigterm(signum, frame):  # cancellation path (job_lib.cancel_jobs)
+        del signum, frame
+        gang._cancel_stragglers()  # pylint: disable=protected-access
+        sys.exit(143)
+
+    gang = GangRun(spec, log_dir, marker)
+    signal.signal(signal.SIGTERM, _sigterm)
+
+    base_env = dict(spec.get('env') or {})
+    try:
+        setup_cmd = spec.get('setup_cmd')
+        if setup_cmd:
+            job_lib.set_status(job_id, job_lib.JobStatus.SETTING_UP)
+            rcs = gang.run(setup_cmd, base_env)
+            if any(rc != 0 for rc in rcs):
+                job_lib.set_status(job_id, job_lib.JobStatus.FAILED_SETUP)
+                return 1
+        job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
+        rcs = gang.run(spec['run_cmd'], base_env)
+        if all(rc == 0 for rc in rcs):
+            job_lib.set_status(job_id, job_lib.JobStatus.SUCCEEDED)
+            return 0
+        job_lib.set_status(job_id, job_lib.JobStatus.FAILED)
+        return 1
+    except Exception:  # pylint: disable=broad-except
+        import traceback
+        traceback.print_exc()
+        job_lib.set_status(job_id, job_lib.JobStatus.FAILED)
+        return 1
+    finally:
+        gang.close()
+        # Slice freed: let the next pending job in.
+        job_lib.schedule_step_safe()
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,316 @@
+"""The on-cluster job queue: sqlite-backed FSM + FIFO scheduler.
+
+Reference parity: sky/skylet/job_lib.py (935 LoC) — jobs/pending_jobs tables
+(:57-83), JobStatus FSM (:86-146), FIFOScheduler.schedule_step launching via
+`ray job submit` (:148-243), status reconciliation against live processes
+(update_job_status, :512-614), is_cluster_idle (:641).
+
+TPU-native differences: no Ray — a scheduled job spawns a detached *gang
+driver* process (agent/driver.py) that fans the per-rank command out to every
+host of every slice; a TPU slice is exclusively owned by one running job at a
+time (chips are not fractionally shareable the way the reference's
+CPU-count scheduling assumes).
+"""
+from __future__ import annotations
+
+import enum
+import getpass
+import json
+import os
+import signal
+import sqlite3
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.utils import db_utils
+from skypilot_tpu.utils import subprocess_utils
+
+
+class JobStatus(enum.Enum):
+    """Reference FSM (sky/skylet/job_lib.py:86-146):
+    INIT -> PENDING -> SETTING_UP -> RUNNING ->
+    {SUCCEEDED, FAILED, FAILED_SETUP, CANCELLED}."""
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    @classmethod
+    def nonterminal_statuses(cls) -> List['JobStatus']:
+        return [s for s in cls if not s.is_terminal()]
+
+
+_TERMINAL = {JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.FAILED_SETUP,
+             JobStatus.CANCELLED}
+
+
+def _create_table(cursor: sqlite3.Cursor, conn: sqlite3.Connection) -> None:
+    del conn
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            job_name TEXT,
+            username TEXT,
+            submitted_at REAL,
+            status TEXT,
+            run_timestamp TEXT,
+            start_at REAL,
+            end_at REAL,
+            resources TEXT,
+            driver_pid INTEGER,
+            spec_json TEXT)""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS config (key TEXT PRIMARY KEY, value TEXT)
+        """)
+
+
+_db: Optional[db_utils.SQLiteConn] = None
+
+
+def _get_db() -> db_utils.SQLiteConn:
+    global _db
+    if _db is None or _db.db_path != os.path.expanduser(
+            constants.jobs_db_path()):
+        _db = db_utils.SQLiteConn(constants.jobs_db_path(), _create_table)
+    return _db
+
+
+# ---------------- write API (head-node only) ----------------
+def add_job(job_name: str, username: Optional[str], run_timestamp: str,
+            resources_str: str) -> int:
+    """Reserve a job id (status INIT) before code sync so logs have a home
+    (reference: job_lib.add_job)."""
+    username = username or getpass.getuser()
+    with _get_db().cursor() as c:
+        c.execute(
+            'INSERT INTO jobs (job_name, username, submitted_at, status, '
+            'run_timestamp, resources) VALUES (?, ?, ?, ?, ?, ?)',
+            (job_name, username, time.time(), JobStatus.INIT.value,
+             run_timestamp, resources_str))
+        return c.lastrowid
+
+
+def queue_job(job_id: int, spec: Dict[str, Any]) -> None:
+    """Attach the gang spec and mark PENDING; the scheduler picks it up.
+    Spec schema: see agent/driver.py (command, hosts, env, slices...)."""
+    with _get_db().cursor() as c:
+        c.execute('UPDATE jobs SET status = ?, spec_json = ? '
+                  'WHERE job_id = ?',
+                  (JobStatus.PENDING.value, json.dumps(spec), job_id))
+    schedule_step_safe()
+
+
+def set_status(job_id: int, status: JobStatus) -> None:
+    now = time.time()
+    with _get_db().cursor() as c:
+        if status == JobStatus.RUNNING:
+            c.execute('UPDATE jobs SET status = ?, start_at = ? '
+                      'WHERE job_id = ?', (status.value, now, job_id))
+        elif status.is_terminal():
+            c.execute('UPDATE jobs SET status = ?, end_at = ? '
+                      'WHERE job_id = ?', (status.value, now, job_id))
+        else:
+            c.execute('UPDATE jobs SET status = ? WHERE job_id = ?',
+                      (status.value, job_id))
+
+
+def set_driver_pid(job_id: int, pid: int) -> None:
+    with _get_db().cursor() as c:
+        c.execute('UPDATE jobs SET driver_pid = ? WHERE job_id = ?',
+                  (pid, job_id))
+
+
+# ---------------- read API ----------------
+def get_status(job_id: int) -> Optional[JobStatus]:
+    with _get_db().cursor() as c:
+        row = c.execute('SELECT status FROM jobs WHERE job_id = ?',
+                        (job_id,)).fetchone()
+    return JobStatus(row[0]) if row else None
+
+
+def get_record(job_id: int) -> Optional[Dict[str, Any]]:
+    with _get_db().cursor() as c:
+        row = c.execute(
+            'SELECT job_id, job_name, username, submitted_at, status, '
+            'run_timestamp, start_at, end_at, resources, driver_pid, '
+            'spec_json FROM jobs WHERE job_id = ?', (job_id,)).fetchone()
+    return _row_to_record(row) if row else None
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    return {
+        'job_id': row[0], 'job_name': row[1], 'username': row[2],
+        'submitted_at': row[3], 'status': JobStatus(row[4]),
+        'run_timestamp': row[5], 'start_at': row[6], 'end_at': row[7],
+        'resources': row[8], 'driver_pid': row[9],
+        'spec': json.loads(row[10]) if row[10] else None,
+    }
+
+
+def get_job_queue(username: Optional[str] = None,
+                  all_jobs: bool = True) -> List[Dict[str, Any]]:
+    q = ('SELECT job_id, job_name, username, submitted_at, status, '
+         'run_timestamp, start_at, end_at, resources, driver_pid, spec_json '
+         'FROM jobs')
+    args: tuple = ()
+    conds = []
+    if username:
+        conds.append('username = ?')
+        args += (username,)
+    if not all_jobs:
+        conds.append('status IN (%s)' % ','.join(
+            f'{s.value!r}' for s in JobStatus.nonterminal_statuses()))
+    if conds:
+        q += ' WHERE ' + ' AND '.join(conds)
+    q += ' ORDER BY job_id DESC'
+    with _get_db().cursor() as c:
+        rows = c.execute(q, args).fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def get_latest_job_id() -> Optional[int]:
+    with _get_db().cursor() as c:
+        row = c.execute('SELECT MAX(job_id) FROM jobs').fetchone()
+    return row[0] if row and row[0] is not None else None
+
+
+def log_dir_for(job_id: int) -> Optional[str]:
+    rec = get_record(job_id)
+    if rec is None:
+        return None
+    return constants.job_log_dir(rec['run_timestamp'])
+
+
+def is_cluster_idle() -> bool:
+    """No nonterminal jobs (autostop's idleness signal; reference:
+    job_lib.is_cluster_idle :641)."""
+    with _get_db().cursor() as c:
+        row = c.execute(
+            'SELECT COUNT(*) FROM jobs WHERE status IN (%s)' % ','.join(
+                f'{s.value!r}' for s in JobStatus.nonterminal_statuses())
+        ).fetchone()
+    return row[0] == 0
+
+
+def last_activity_time() -> float:
+    """Latest of: last submit, last job end (autostop idle clock)."""
+    with _get_db().cursor() as c:
+        row = c.execute('SELECT MAX(submitted_at), MAX(end_at) '
+                        'FROM jobs').fetchone()
+    candidates = [t for t in (row or (None, None)) if t is not None]
+    return max(candidates) if candidates else 0.0
+
+
+# ---------------- scheduler ----------------
+def _job_marker(job_id: int) -> str:
+    return f'skytpu-job-{os.path.basename(constants.agent_home())}-{job_id}'
+
+
+def schedule_step() -> Optional[int]:
+    """Launch the oldest PENDING job if the slice is free. Returns the
+    launched job id, if any. A TPU slice runs one gang at a time
+    (reference's CPU-count packing, job_lib.py:148-243, does not apply to
+    chips)."""
+    # Busy-check + claim must be one atomic statement: the agent tick and a
+    # queue_job codegen subprocess race on the same db, and a double-claim
+    # would run the user command twice on every host.
+    with _get_db().cursor() as c:
+        row = c.execute(
+            'UPDATE jobs SET status = ? WHERE job_id = ('
+            '  SELECT job_id FROM jobs WHERE status = ?'
+            '  AND NOT EXISTS (SELECT 1 FROM jobs WHERE status IN (?, ?))'
+            '  ORDER BY job_id LIMIT 1)'
+            'AND status = ? RETURNING job_id, spec_json',
+            (JobStatus.SETTING_UP.value, JobStatus.PENDING.value,
+             JobStatus.SETTING_UP.value, JobStatus.RUNNING.value,
+             JobStatus.PENDING.value)).fetchone()
+    if row is None:
+        return None
+    job_id, spec_json = row
+    spec_path = os.path.join(constants.agent_home(), f'job-{job_id}.spec')
+    os.makedirs(constants.agent_home(), exist_ok=True)
+    with open(spec_path, 'w', encoding='utf-8') as f:
+        f.write(spec_json)
+    # Detached gang driver; survives agent restarts and ssh disconnects
+    # (the reference detaches via `ray job submit`,
+    # cloud_vm_ray_backend.py:3193-3260).
+    # The marker travels as a CLI arg, NOT env: rank processes get it in
+    # their env for gang kill; the driver itself must not match
+    # kill_by_marker or cancellation would kill the canceller.
+    with open(os.path.join(constants.agent_home(),
+                           f'job-{job_id}.driver.log'), 'a',
+              encoding='utf-8') as driver_log:
+        proc = subprocess.Popen(
+            [sys.executable, '-u', '-m', 'skypilot_tpu.agent.driver',
+             '--job-id', str(job_id), '--spec', spec_path,
+             '--marker', _job_marker(job_id)],
+            stdout=driver_log, stderr=subprocess.STDOUT,
+            start_new_session=True)
+    set_driver_pid(job_id, proc.pid)
+    return job_id
+
+
+def schedule_step_safe() -> None:
+    try:
+        schedule_step()
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
+# ---------------- reconciliation ----------------
+def _pid_alive(pid: Optional[int]) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def update_job_statuses() -> None:
+    """Jobs claiming to run whose driver died -> FAILED (reference:
+    update_job_status reconciling against Ray job states, job_lib.py:512)."""
+    for rec in get_job_queue(all_jobs=False):
+        if rec['status'] in (JobStatus.SETTING_UP, JobStatus.RUNNING):
+            if not _pid_alive(rec['driver_pid']):
+                set_status(rec['job_id'], JobStatus.FAILED)
+
+
+def cancel_jobs(job_ids: Optional[List[int]] = None,
+                cancel_all: bool = False) -> List[int]:
+    """Kill gang drivers + every process carrying the job marker."""
+    if cancel_all:
+        targets = [r['job_id'] for r in get_job_queue(all_jobs=False)]
+    else:
+        targets = job_ids or []
+    cancelled = []
+    for job_id in targets:
+        rec = get_record(job_id)
+        if rec is None or rec['status'].is_terminal():
+            continue
+        if rec['driver_pid']:
+            subprocess_utils.kill_process_tree(rec['driver_pid'],
+                                               signal.SIGTERM)
+        subprocess_utils.kill_by_marker(_job_marker(job_id))
+        set_status(job_id, JobStatus.CANCELLED)
+        cancelled.append(job_id)
+    schedule_step_safe()
+    return cancelled
+
+
+def fail_all_inflight_jobs() -> None:
+    """On agent restart after a crash/stop: anything nonterminal is dead."""
+    for rec in get_job_queue(all_jobs=False):
+        if rec['status'] != JobStatus.PENDING:
+            set_status(rec['job_id'], JobStatus.FAILED)

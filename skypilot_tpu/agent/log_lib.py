@@ -1,0 +1,134 @@
+"""Job execution with log capture + tailing.
+
+Reference parity: sky/skylet/log_lib.py (463 LoC): run_with_log (:130),
+make_task_bash_script (:261), run_bash_command_with_log (:308 — the
+ray.remote unit, here just a function the gang driver calls per rank),
+tail_logs with follow (:336-463).
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+import textwrap
+import time
+from typing import Dict, Optional, TextIO, Tuple
+
+from skypilot_tpu.agent import constants
+
+
+def make_task_bash_script(codegen: str,
+                          env_vars: Optional[Dict[str, str]] = None) -> str:
+    """Wrap a user command in a login-shell script with env + cwd setup
+    (reference: log_lib.py:261)."""
+    script = [
+        textwrap.dedent("""\
+            #!/bin/bash
+            source ~/.bashrc 2>/dev/null
+            set -a
+            """),
+    ]
+    for k, v in (env_vars or {}).items():
+        script.append(f'{k}={shlex.quote(str(v))}\n')
+    script.append(
+        textwrap.dedent(f"""\
+            set +a
+            cd {constants.agent_home()}/workdir 2>/dev/null || cd ~
+            {codegen}
+            """))
+    return ''.join(script)
+
+
+def run_with_log(cmd,
+                 log_path: str,
+                 *,
+                 env_vars: Optional[Dict[str, str]] = None,
+                 stream_logs: bool = False,
+                 streaming_prefix: str = '',
+                 shell: bool = True,
+                 start_new_session: bool = True) -> Tuple[int, int]:
+    """Run cmd, teeing combined stdout/stderr into log_path line-by-line.
+
+    Returns (returncode, pid). The line-level tee is what tail_logs
+    streams; it is also the seam where the C++ log mux slots in later.
+    """
+    log_path = os.path.expanduser(log_path)
+    os.makedirs(os.path.dirname(log_path) or '.', exist_ok=True)
+    env = dict(os.environ)
+    env.update(env_vars or {})
+    with open(log_path, 'a', encoding='utf-8') as log_file:
+        proc = subprocess.Popen(cmd, shell=shell, env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=start_new_session,
+                                text=True, bufsize=1)
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            log_file.write(line)
+            log_file.flush()
+            if stream_logs:
+                sys.stdout.write(streaming_prefix + line)
+                sys.stdout.flush()
+        proc.wait()
+        return proc.returncode, proc.pid
+
+
+def run_bash_command_with_log(bash_command: str,
+                              log_path: str,
+                              *,
+                              env_vars: Optional[Dict[str, str]] = None,
+                              stream_logs: bool = False) -> int:
+    """The per-rank execution unit (reference: log_lib.py:308). Writes the
+    script to disk next to the log so it is inspectable, then runs it."""
+    script_path = log_path.replace('.log', '.sh')
+    script = make_task_bash_script(bash_command, env_vars)
+    os.makedirs(os.path.dirname(os.path.expanduser(script_path)) or '.',
+                exist_ok=True)
+    with open(os.path.expanduser(script_path), 'w', encoding='utf-8') as f:
+        f.write(script)
+    rc, _ = run_with_log(f'bash {script_path}', log_path,
+                         stream_logs=stream_logs)
+    return rc
+
+
+def _follow(f: TextIO, stop_when: callable, idle_timeout: float = 1.0,
+            out: TextIO = sys.stdout) -> None:
+    while True:
+        line = f.readline()
+        if line:
+            out.write(line)
+            out.flush()
+            continue
+        if stop_when():
+            # Drain whatever raced in after the status flipped.
+            rest = f.read()
+            if rest:
+                out.write(rest)
+                out.flush()
+            return
+        time.sleep(idle_timeout)
+
+
+def tail_logs(log_path: str,
+              *,
+              follow: bool = True,
+              job_is_running: Optional[callable] = None,
+              out: TextIO = sys.stdout,
+              wait_for_file_timeout: float = 30.0) -> None:
+    """Stream a job's log (reference: log_lib.py:336-463). With follow=True
+    keeps streaming until job_is_running() goes False."""
+    log_path = os.path.expanduser(log_path)
+    deadline = time.time() + wait_for_file_timeout
+    while not os.path.exists(log_path):
+        if time.time() > deadline or not follow:
+            out.write(f'Log file not found: {log_path}\n')
+            return
+        time.sleep(0.2)
+    with open(log_path, 'r', encoding='utf-8') as f:
+        if not follow:
+            out.write(f.read())
+            return
+        stop = job_is_running if job_is_running is not None else \
+            (lambda: True)
+        _follow(f, stop_when=lambda: not stop(), out=out)

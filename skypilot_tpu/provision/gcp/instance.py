@@ -38,6 +38,17 @@ _STATE_MAP = {
 _CLUSTER_LABEL = 'skytpu-cluster'
 _SLICE_LABEL = 'skytpu-slice'
 
+# AcceleratorConfig.type enum values (TPU API AcceleratorConfig docs);
+# keys are the gcp_accelerator_type prefix (before the count suffix).
+_ACCEL_CONFIG_TYPE = {
+    'v2': 'V2',
+    'v3': 'V3',
+    'v4': 'V4',
+    'v5litepod': 'V5LITE_POD',
+    'v5p': 'V5P',
+    'v6e': 'V6E',
+}
+
 
 def _client(provider_config: Optional[Dict[str, Any]]) -> tpu_api.TpuClient:
     project = (provider_config or {}).get('project')
@@ -65,11 +76,17 @@ def _node_body(config: common.ProvisionConfig, slice_index: int
         },
         'metadata': {},
     }
-    if config.topology:
-        # acceleratorConfig supersedes acceleratorType when an explicit
-        # topology is requested (e.g. twisted tori on v5p).
-        gen = config.accelerator_type.split('-')[0].upper()
-        body['acceleratorConfig'] = {'type': gen, 'topology': config.topology}
+    explicit_topology = config.provider_config.get('explicit_topology')
+    if explicit_topology:
+        # The API takes acceleratorType OR acceleratorConfig, never both.
+        # Only a user-requested non-default topology (e.g. twisted tori on
+        # v5p via accelerator_args) uses the config form.
+        del body['acceleratorType']
+        body['acceleratorConfig'] = {
+            'type': _ACCEL_CONFIG_TYPE[
+                config.accelerator_type.rsplit('-', 1)[0]],
+            'topology': explicit_topology,
+        }
     if config.use_spot:
         body['schedulingConfig'] = {'spot': True}
     if config.authorized_key:
@@ -95,9 +112,13 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
         if labels.get(_CLUSTER_LABEL) != cluster_name:
             continue
         idx = int(labels.get(_SLICE_LABEL, 0))
-        existing[idx] = node
         state = _STATE_MAP.get(node.get('state', ''),
                                common.InstanceStatus.PENDING)
+        if state == common.InstanceStatus.TERMINATED:
+            # Dead/mid-deletion nodes are not "existing" — the slice must
+            # be recreated or the gang would come up incomplete.
+            continue
+        existing[idx] = node
         node_id = node['name'].rsplit('/', 1)[-1]
         if state == common.InstanceStatus.STOPPED:
             client.start_node(zone, node_id)

@@ -74,6 +74,10 @@ def _default_transport(method: str, url: str,
             return e.code, json.loads(payload)
         except json.JSONDecodeError:
             return e.code, {'error': {'message': payload}}
+    except (urllib.error.URLError, OSError) as e:
+        # DNS/conn-refused/socket-timeout must stay inside the taxonomy so
+        # the failover engine retries in place instead of aborting the walk.
+        raise errors.TransientApiError(f'TPU API unreachable: {e}') from e
 
 
 class TpuClient:

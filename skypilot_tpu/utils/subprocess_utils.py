@@ -1,0 +1,87 @@
+"""Subprocess helpers: parallel map, process-tree kill.
+
+Reference parity: sky/utils/subprocess_utils.py (189 LoC).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+from concurrent import futures
+from typing import Any, Callable, List, Optional
+
+
+def run_in_parallel(fn: Callable, args: List[Any],
+                    num_threads: Optional[int] = None) -> List[Any]:
+    """Apply fn over args with a thread pool; re-raises the first error."""
+    if not args:
+        return []
+    if len(args) == 1:
+        return [fn(args[0])]
+    workers = num_threads or min(len(args), 32)
+    with futures.ThreadPoolExecutor(max_workers=workers) as pool:
+        return list(pool.map(fn, args))
+
+
+def kill_process_tree(pid: int, sig: int = signal.SIGTERM,
+                      include_parent: bool = True) -> None:
+    """Signal a process and all descendants (no psutil dependency: walk
+    /proc children files, fall back to process-group kill)."""
+    try:
+        children: List[int] = []
+        stack = [pid]
+        while stack:
+            p = stack.pop()
+            try:
+                with open(f'/proc/{p}/task/{p}/children',
+                          encoding='utf-8') as f:
+                    kids = [int(c) for c in f.read().split()]
+            except (FileNotFoundError, ProcessLookupError, ValueError):
+                kids = []
+            children.extend(kids)
+            stack.extend(kids)
+        targets = children + ([pid] if include_parent else [])
+        for p in targets:
+            try:
+                os.kill(p, sig)
+            except ProcessLookupError:
+                pass
+    except Exception:  # pylint: disable=broad-except
+        try:
+            os.killpg(os.getpgid(pid), sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def kill_by_marker(marker: str, sig: int = signal.SIGTERM) -> int:
+    """Kill every process whose environment carries the job marker — gang
+    cancellation without Ray (see agent/constants.py ENV_JOB_MARKER).
+    Returns the number of processes signaled."""
+    killed = 0
+    for pid_dir in os.listdir('/proc'):
+        if not pid_dir.isdigit():
+            continue
+        pid = int(pid_dir)
+        if pid == os.getpid():
+            continue
+        try:
+            with open(f'/proc/{pid}/environ', 'rb') as f:
+                environ = f.read().decode(errors='replace')
+        except (FileNotFoundError, PermissionError, ProcessLookupError):
+            continue
+        # environ entries are NUL-terminated; requiring the terminator
+        # prevents marker '...-1' from matching another job's '...-12'.
+        if marker + '\x00' in environ:
+            try:
+                os.kill(pid, sig)
+                killed += 1
+            except (ProcessLookupError, PermissionError):
+                pass
+    return killed
+
+
+def run(cmd, **kwargs) -> subprocess.CompletedProcess:
+    shell = isinstance(cmd, str)
+    kwargs.setdefault('capture_output', True)
+    kwargs.setdefault('text', True)
+    return subprocess.run(cmd, shell=shell, check=False, **kwargs)

@@ -1,0 +1,239 @@
+"""Agent runtime: job queue FSM, gang driver (multi-"host" local), logs,
+cancellation, autostop config, codegen round-trip.
+
+These run the real driver subprocess against LocalCommandRunner hosts —
+hermetic multi-host gang execution the reference cannot test (SURVEY §4.5).
+"""
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from skypilot_tpu.agent import autostop_lib
+from skypilot_tpu.agent import codegen
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.agent import log_lib
+from skypilot_tpu.utils import command_runner
+from skypilot_tpu.utils import common_utils
+
+
+@pytest.fixture(autouse=True)
+def agent_home(tmp_path, monkeypatch):
+    home = tmp_path / 'agent_home'
+    home.mkdir()
+    monkeypatch.setenv('SKYTPU_HOME', str(home))
+    # Reset job_lib's cached connection (path changed).
+    job_lib._db = None  # pylint: disable=protected-access
+    yield str(home)
+
+
+def _spec(run_cmd, *, num_hosts=1, setup_cmd=None, env=None, job_id=1,
+          run_timestamp='sky-test', tmp_home=None):
+    hosts = []
+    for r in range(num_hosts):
+        h = {'slice': 0, 'host': r, 'ip': '127.0.0.1', 'runner': 'local'}
+        if tmp_home:
+            h['home'] = tmp_home
+        hosts.append(h)
+    return {
+        'job_id': job_id, 'cluster_name': 'c', 'run_timestamp': run_timestamp,
+        'setup_cmd': setup_cmd, 'run_cmd': run_cmd, 'env': env or {},
+        'accelerator': 'tpu-v5e-8', 'chips_per_host': 8, 'num_slices': 1,
+        'task_id': 'sky-test_c_1', 'hosts': hosts,
+    }
+
+
+def _wait_status(job_id, statuses, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = job_lib.get_status(job_id)
+        if st in statuses:
+            return st
+        time.sleep(0.1)
+    raise AssertionError(
+        f'job {job_id} stuck in {job_lib.get_status(job_id)}')
+
+
+class TestJobQueue:
+
+    def test_fsm_happy_path(self, agent_home):
+        job_id = job_lib.add_job('j1', 'u', 'sky-test', 'tpu-v5e-8')
+        assert job_lib.get_status(job_id) == job_lib.JobStatus.INIT
+        job_lib.queue_job(job_id, _spec('echo hello; exit 0',
+                                        job_id=job_id))
+        st = _wait_status(job_id, {job_lib.JobStatus.SUCCEEDED,
+                                   job_lib.JobStatus.FAILED})
+        assert st == job_lib.JobStatus.SUCCEEDED
+        log = os.path.join(constants.job_log_dir('sky-test'), 'run.log')
+        with open(log, encoding='utf-8') as f:
+            assert 'hello' in f.read()
+
+    def test_failed_job(self, agent_home):
+        job_id = job_lib.add_job('j1', 'u', 'sky-test', 'r')
+        job_lib.queue_job(job_id, _spec('exit 3', job_id=job_id))
+        st = _wait_status(job_id, {job_lib.JobStatus.SUCCEEDED,
+                                   job_lib.JobStatus.FAILED})
+        assert st == job_lib.JobStatus.FAILED
+
+    def test_failed_setup(self, agent_home):
+        job_id = job_lib.add_job('j1', 'u', 'sky-test', 'r')
+        job_lib.queue_job(job_id, _spec('echo never', setup_cmd='exit 9',
+                                        job_id=job_id))
+        st = _wait_status(job_id, {job_lib.JobStatus.FAILED_SETUP,
+                                   job_lib.JobStatus.FAILED})
+        assert st == job_lib.JobStatus.FAILED_SETUP
+
+    def test_fifo_one_at_a_time(self, agent_home):
+        """Second job waits until the first finishes (slice exclusivity)."""
+        j1 = job_lib.add_job('j1', 'u', 'ts1', 'r')
+        j2 = job_lib.add_job('j2', 'u', 'ts2', 'r')
+        job_lib.queue_job(j1, _spec('sleep 1.0', job_id=j1,
+                                    run_timestamp='ts1'))
+        job_lib.queue_job(j2, _spec('echo second', job_id=j2,
+                                    run_timestamp='ts2'))
+        # While j1 runs, j2 must stay PENDING.
+        _wait_status(j1, {job_lib.JobStatus.RUNNING})
+        assert job_lib.get_status(j2) == job_lib.JobStatus.PENDING
+        _wait_status(j1, {job_lib.JobStatus.SUCCEEDED})
+        # Driver's exit hook schedules the next job.
+        st = _wait_status(j2, {job_lib.JobStatus.SUCCEEDED})
+        assert st == job_lib.JobStatus.SUCCEEDED
+
+    def test_cancel_running_job(self, agent_home):
+        job_id = job_lib.add_job('j1', 'u', 'sky-test', 'r')
+        job_lib.queue_job(job_id, _spec('sleep 60', job_id=job_id))
+        _wait_status(job_id, {job_lib.JobStatus.RUNNING})
+        cancelled = job_lib.cancel_jobs([job_id])
+        assert cancelled == [job_id]
+        assert job_lib.get_status(job_id) == job_lib.JobStatus.CANCELLED
+
+    def test_reconcile_dead_driver(self, agent_home):
+        job_id = job_lib.add_job('j1', 'u', 'sky-test', 'r')
+        # Fake a RUNNING job with a dead driver pid.
+        job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
+        job_lib.set_driver_pid(job_id, 99999999)
+        job_lib.update_job_statuses()
+        assert job_lib.get_status(job_id) == job_lib.JobStatus.FAILED
+
+    def test_idleness(self, agent_home):
+        assert job_lib.is_cluster_idle()
+        job_id = job_lib.add_job('j1', 'u', 'sky-test', 'r')
+        assert not job_lib.is_cluster_idle()
+        job_lib.set_status(job_id, job_lib.JobStatus.SUCCEEDED)
+        assert job_lib.is_cluster_idle()
+
+
+class TestGangExecution:
+
+    def test_multi_host_rank_env(self, agent_home):
+        """4 local 'hosts': each rank sees correct rank wiring env."""
+        job_id = job_lib.add_job('gang', 'u', 'sky-gang', 'tpu-v2-32')
+        cmd = ('echo rank=$SKYTPU_NODE_RANK/$SKYTPU_NUM_NODES '
+               'slice=$SKYTPU_SLICE_INDEX host=$SKYTPU_HOST_INDEX '
+               'jaxpid=$JAX_PROCESS_ID of $JAX_NUM_PROCESSES')
+        spec = _spec(cmd, num_hosts=4, job_id=job_id,
+                     run_timestamp='sky-gang')
+        job_lib.queue_job(job_id, spec)
+        _wait_status(job_id, {job_lib.JobStatus.SUCCEEDED})
+        logs = {}
+        log_dir = constants.job_log_dir('sky-gang')
+        for r in range(4):
+            with open(os.path.join(log_dir, f'rank-{r}.log'),
+                      encoding='utf-8') as f:
+                logs[r] = f.read()
+        for r in range(4):
+            assert f'rank={r}/4' in logs[r]
+            assert f'jaxpid={r} of 4' in logs[r]
+        with open(os.path.join(log_dir, 'run.log'), encoding='utf-8') as f:
+            combined = f.read()
+        assert '(rank 2) rank=2/4' in combined
+
+    def test_gang_first_failure_cancels_stragglers(self, agent_home):
+        job_id = job_lib.add_job('gang', 'u', 'sky-fail', 'r')
+        # rank 1 fails fast; rank 0 would run 60s unless cancelled.
+        cmd = ('if [ "$SKYTPU_NODE_RANK" = "1" ]; then exit 7; '
+               'else sleep 60; fi')
+        spec = _spec(cmd, num_hosts=2, job_id=job_id,
+                     run_timestamp='sky-fail')
+        job_lib.queue_job(job_id, spec)
+        start = time.time()
+        st = _wait_status(job_id, {job_lib.JobStatus.FAILED}, timeout=30)
+        assert st == job_lib.JobStatus.FAILED
+        assert time.time() - start < 25, 'straggler was not cancelled'
+
+
+class TestLogLib:
+
+    def test_run_with_log_and_tail(self, agent_home, tmp_path):
+        log = str(tmp_path / 'x.log')
+        rc, _ = log_lib.run_with_log('echo line1; echo line2', log)
+        assert rc == 0
+        out = io.StringIO()
+        log_lib.tail_logs(log, follow=False, out=out)
+        assert 'line1\nline2\n' in out.getvalue()
+
+    def test_tail_follow_until_done(self, agent_home, tmp_path):
+        log = str(tmp_path / 'y.log')
+        with open(log, 'w', encoding='utf-8') as f:
+            f.write('early\n')
+        flag = {'running': True}
+
+        import threading
+
+        def writer():
+            time.sleep(0.3)
+            with open(log, 'a', encoding='utf-8') as f:
+                f.write('late\n')
+            flag['running'] = False
+
+        t = threading.Thread(target=writer)
+        t.start()
+        out = io.StringIO()
+        log_lib.tail_logs(log, follow=True,
+                          job_is_running=lambda: flag['running'], out=out)
+        t.join()
+        assert 'early' in out.getvalue()
+        assert 'late' in out.getvalue()
+
+
+class TestAutostop:
+
+    def test_config_roundtrip(self, agent_home):
+        autostop_lib.set_autostop(10, down=True)
+        cfg = autostop_lib.get_autostop_config()
+        assert cfg.enabled and cfg.idle_minutes == 10 and cfg.down
+        autostop_lib.set_autostop(-1, down=False)
+        assert not autostop_lib.get_autostop_config().enabled
+
+
+class TestCodegen:
+
+    def test_roundtrip_over_local_runner(self, agent_home):
+        """Client-side codegen -> 'remote' execution -> payload decode,
+        exactly as the backend will do over SSH."""
+        runner = command_runner.LocalCommandRunner(
+            {'SKYTPU_HOME': agent_home,
+             'PYTHONPATH': os.pathsep.join(sys.path)})
+        job_id = codegen.run_on_head(
+            runner, codegen.JobCodeGen.add_job('j', 'u', 'sky-cg', 'r'))
+        assert isinstance(job_id, int)
+        spec = _spec('echo from-codegen', job_id=job_id,
+                     run_timestamp='sky-cg')
+        codegen.run_on_head(
+            runner, codegen.JobCodeGen.queue_job(job_id, json.dumps(spec)))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            status = codegen.run_on_head(
+                runner, codegen.JobCodeGen.get_job_status(job_id))
+            if status in ('SUCCEEDED', 'FAILED'):
+                break
+            time.sleep(0.2)
+        assert status == 'SUCCEEDED'
+        queue = codegen.run_on_head(
+            runner, codegen.JobCodeGen.get_job_queue(None, True))
+        assert queue[0]['job_name'] == 'j'
