@@ -47,6 +47,9 @@ def make_runner(host: Dict[str, Any]) -> command_runner.CommandRunner:
     host_env = {}
     if host.get('home'):
         host_env['SKYTPU_HOME'] = host['home']
+        # `~` in user commands must resolve to the per-host home, matching
+        # a real TPU host's $HOME.
+        host_env['HOME'] = host['home']
     if host.get('runner', 'local') == 'local':
         return command_runner.LocalCommandRunner(host_env)
     return command_runner.SSHCommandRunner(host['ip'], host['ssh_user'],

@@ -1,0 +1,10 @@
+"""Backends: turn a (Task, Resources) pair into a live slice-cluster and
+run jobs on it (reference: sky/backends/__init__.py)."""
+from skypilot_tpu.backends.backend import Backend
+from skypilot_tpu.backends.backend import ResourceHandle
+from skypilot_tpu.backends.cloud_tpu_backend import CloudTpuBackend
+from skypilot_tpu.backends.cloud_tpu_backend import CloudTpuResourceHandle
+
+__all__ = [
+    'Backend', 'ResourceHandle', 'CloudTpuBackend', 'CloudTpuResourceHandle'
+]

@@ -1,0 +1,71 @@
+"""Abstract Backend interface.
+
+Reference parity: sky/backends/backend.py:28-121 — the provision /
+sync_workdir / sync_file_mounts / setup / execute / teardown surface that
+the execution layer's staged pipeline drives. Each method is a stage;
+backends own how a stage maps onto the cloud + cluster runtime.
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Generic, Optional, TypeVar
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+
+
+class ResourceHandle:
+    """Opaque pickleable identifier of a provisioned cluster, stored in
+    global_user_state (reference: backend.py:20-26)."""
+
+    def get_cluster_name(self) -> str:
+        raise NotImplementedError
+
+
+_HandleT = TypeVar('_HandleT', bound=ResourceHandle)
+
+
+class Backend(Generic[_HandleT]):
+    """Backend interface: provision a cluster, stage files, run jobs."""
+
+    NAME = 'backend'
+
+    # --- lifecycle ---
+    def provision(self,
+                  task: 'task_lib.Task',
+                  to_provision: Optional['resources_lib.Resources'],
+                  dryrun: bool,
+                  stream_logs: bool,
+                  cluster_name: Optional[str] = None,
+                  retry_until_up: bool = False) -> Optional[_HandleT]:
+        raise NotImplementedError
+
+    def sync_workdir(self, handle: _HandleT, workdir: str) -> None:
+        raise NotImplementedError
+
+    def sync_file_mounts(self, handle: _HandleT,
+                         all_file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        raise NotImplementedError
+
+    def setup(self, handle: _HandleT, task: 'task_lib.Task',
+              detach_setup: bool = False) -> None:
+        raise NotImplementedError
+
+    def execute(self, handle: _HandleT, task: 'task_lib.Task',
+                detach_run: bool, dryrun: bool = False) -> Optional[int]:
+        """Submit the task's run command as a job; returns job id."""
+        raise NotImplementedError
+
+    def post_execute(self, handle: _HandleT, down: bool) -> None:
+        pass
+
+    def teardown(self, handle: _HandleT, terminate: bool,
+                 purge: bool = False) -> None:
+        raise NotImplementedError
+
+    # --- utilities ---
+    def register_info(self, **kwargs: Any) -> None:
+        """Pass backend-specific knobs from the execution layer."""
+        del kwargs

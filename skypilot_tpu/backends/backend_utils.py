@@ -1,0 +1,176 @@
+"""Backend helpers: per-cluster locks, status reconciliation, lookups.
+
+Reference parity: sky/backends/backend_utils.py — cluster status refresh
+that reconciles local sqlite state with cloud reality and detects
+abnormal/partial clusters (_update_cluster_status_no_lock:1777,
+refresh_cluster_record:2051), per-cluster file locks (:2051+), and
+check_cluster_available. The Ray-liveness half of the reference's health
+check (ray status over ssh, :1059) is replaced by the cloud-truth half
+only; agent liveness is probed lazily by the first codegen that fails.
+"""
+from __future__ import annotations
+
+import os
+import re
+import typing
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision
+from skypilot_tpu import status_lib
+from skypilot_tpu.provision import common as provision_common
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.backends import cloud_tpu_backend
+
+CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
+_LOCK_TIMEOUT_SECONDS = 20 * 60
+
+
+def check_cluster_name_is_valid(cluster_name: str) -> None:
+    """Cloud resource names must be DNS-label-ish (reference:
+    backend_utils.check_cluster_name_is_valid)."""
+    if not cluster_name:
+        raise ValueError('Cluster name must be non-empty.')
+    if CLUSTER_NAME_VALID_REGEX.match(cluster_name) is None:
+        raise ValueError(
+            f'Cluster name {cluster_name!r} is invalid: must start with a '
+            'letter, contain only letters/digits/-/_/. and not end with a '
+            'separator.')
+
+
+def cluster_lock(cluster_name: str) -> filelock.FileLock:
+    """Serialize mutations of one cluster across client processes
+    (reference: per-cluster .lock files at backend_utils.py:2051+)."""
+    lock_dir = os.path.join(
+        os.path.expanduser(os.environ.get('SKYTPU_HOME', '~/.skytpu')),
+        'locks')
+    os.makedirs(lock_dir, exist_ok=True)
+    return filelock.FileLock(
+        os.path.join(lock_dir, f'{cluster_name}.lock'),
+        timeout=_LOCK_TIMEOUT_SECONDS)
+
+
+# ---------------- status reconciliation ----------------
+def _query_cloud_status(
+    handle: 'cloud_tpu_backend.CloudTpuResourceHandle'
+) -> Dict[str, provision_common.InstanceStatus]:
+    info = handle.cluster_info
+    return provision.query_instances(
+        info.provider_name,
+        handle.cluster_name,
+        provider_config=handle.provider_config(),
+        non_terminated_only=True)
+
+
+def _reconcile(
+    handle: 'cloud_tpu_backend.CloudTpuResourceHandle',
+    statuses: Dict[str, provision_common.InstanceStatus],
+) -> Optional[status_lib.ClusterStatus]:
+    """Map per-slice cloud statuses to one ClusterStatus; None = gone.
+
+    Gang semantics: all slices RUNNING → UP; all STOPPED → STOPPED;
+    anything partial/preempted → INIT (abnormal — reference marks these
+    INIT too, backend_utils.py:1920-2000)."""
+    expected = handle.launched_resources.num_slices
+    if not statuses:
+        return None
+    values = list(statuses.values())
+    running = [s for s in values if s == provision_common.InstanceStatus.RUNNING]
+    stopped = [
+        s for s in values if s in (provision_common.InstanceStatus.STOPPED,
+                                   provision_common.InstanceStatus.STOPPING)
+    ]
+    if len(running) == expected:
+        return status_lib.ClusterStatus.UP
+    if len(stopped) == expected:
+        # All slices cleanly stopped. A shorter all-stopped list means some
+        # slices were terminated (e.g. preempted-and-deleted) — that is a
+        # partial cluster, INIT below.
+        return status_lib.ClusterStatus.STOPPED
+    return status_lib.ClusterStatus.INIT
+
+
+def refresh_cluster_record(cluster_name: str,
+                           force_refresh: bool = True
+                           ) -> Optional[Dict[str, Any]]:
+    """Re-read cloud truth and update the local record; returns the fresh
+    record, or None if the cluster no longer exists anywhere (reference:
+    refresh_cluster_record, backend_utils.py:2051)."""
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        return None
+    handle = record['handle']
+    if handle is None or not force_refresh:
+        return record
+    try:
+        statuses = _query_cloud_status(handle)
+    except Exception:  # pylint: disable=broad-except
+        # Cloud unreachable: keep the cached record (reference keeps stale
+        # status rather than wrongly deleting state).
+        return record
+    new_status = _reconcile(handle, statuses)
+    if new_status is None:
+        # Terminated behind our back (or autostop-down fired): drop state.
+        global_user_state.remove_cluster(cluster_name, terminate=True)
+        return None
+    if new_status != record['status']:
+        if new_status == status_lib.ClusterStatus.STOPPED:
+            global_user_state.remove_cluster(cluster_name, terminate=False)
+        else:
+            global_user_state.update_cluster_status(cluster_name, new_status)
+        record = global_user_state.get_cluster_from_name(cluster_name)
+    return record
+
+
+def refresh_cluster_status_handle(
+    cluster_name: str,
+    force_refresh: bool = True,
+) -> (Optional[status_lib.ClusterStatus], Optional[Any]):
+    record = refresh_cluster_record(cluster_name, force_refresh)
+    if record is None:
+        return None, None
+    return record['status'], record['handle']
+
+
+def get_clusters(refresh: bool = False,
+                 cluster_names: Optional[List[str]] = None
+                 ) -> List[Dict[str, Any]]:
+    """All cluster records, optionally reconciled against the cloud
+    (reference: backend_utils.get_clusters:2410)."""
+    records = global_user_state.get_clusters()
+    if cluster_names is not None:
+        wanted = set(cluster_names)
+        records = [r for r in records if r['name'] in wanted]
+    if not refresh:
+        return records
+    fresh = []
+    for r in records:
+        nr = refresh_cluster_record(r['name'], force_refresh=True)
+        if nr is not None:
+            fresh.append(nr)
+    return fresh
+
+
+def check_cluster_available(
+    cluster_name: str,
+    operation: str,
+) -> 'cloud_tpu_backend.CloudTpuResourceHandle':
+    """Raise ClusterNotUpError unless the cluster exists and is UP
+    (reference: backend_utils.check_cluster_available:2560)."""
+    record = refresh_cluster_record(cluster_name, force_refresh=False)
+    if record is None:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} does not exist; cannot {operation}.')
+    if record['status'] != status_lib.ClusterStatus.UP:
+        # Re-check against the cloud before giving up.
+        record = refresh_cluster_record(cluster_name, force_refresh=True)
+        if record is None or record['status'] != status_lib.ClusterStatus.UP:
+            status = None if record is None else record['status'].value
+            raise exceptions.ClusterNotUpError(
+                f'Cluster {cluster_name!r} is not UP (status: {status}); '
+                f'cannot {operation}.')
+    return record['handle']

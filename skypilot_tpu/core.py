@@ -1,0 +1,208 @@
+"""Core ops API: status / start / stop / down / autostop / job ops /
+cost report / storage ops.
+
+Reference parity: sky/core.py (837 LoC) — status w/ refresh (:38),
+start/stop/down/autostop (:245-517), queue/cancel/tail_logs/download_logs/
+job_status (:517-800), cost_report (:136), storage_ls/delete (:800,822).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import status_lib
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.backends import cloud_tpu_backend
+from skypilot_tpu.utils import timeline
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------- cluster status ----------------
+@timeline.event
+def status(cluster_names: Optional[Union[str, List[str]]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Cluster records, optionally reconciled against the cloud
+    (reference: sky.status, core.py:38)."""
+    if isinstance(cluster_names, str):
+        cluster_names = [cluster_names]
+    return backend_utils.get_clusters(refresh=refresh,
+                                      cluster_names=cluster_names)
+
+
+def _get_handle(cluster_name: str, operation: str
+                ) -> 'cloud_tpu_backend.CloudTpuResourceHandle':
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} does not exist; cannot {operation}.')
+    return record['handle']
+
+
+# ---------------- lifecycle ----------------
+@timeline.event
+def start(cluster_name: str, retry_until_up: bool = False,
+          idle_minutes_to_autostop: Optional[int] = None,
+          down: bool = False) -> None:
+    """Restart a STOPPED (or wedged-INIT) cluster (reference: sky.start,
+    core.py:245)."""
+    from skypilot_tpu import task as task_lib
+    record = backend_utils.refresh_cluster_record(cluster_name,
+                                                  force_refresh=True)
+    if record is None:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} does not exist.')
+    if record['status'] == status_lib.ClusterStatus.UP:
+        logger.info('Cluster %r is already UP.', cluster_name)
+        return
+    handle = record['handle']
+    backend = cloud_tpu_backend.CloudTpuBackend()
+    task = task_lib.Task()
+    task.set_resources({handle.launched_resources})
+    backend.provision(task, handle.launched_resources, dryrun=False,
+                      stream_logs=True, cluster_name=cluster_name,
+                      retry_until_up=retry_until_up)
+    if idle_minutes_to_autostop is not None:
+        handle = _get_handle(cluster_name, 'autostop')
+        backend.set_autostop(handle, idle_minutes_to_autostop, down)
+
+
+@timeline.event
+def stop(cluster_name: str, purge: bool = False) -> None:
+    """Stop a cluster, preserving its disk (reference: sky.stop,
+    core.py:317). Spot/multi-host TPU slices cannot stop — only down."""
+    handle = _get_handle(cluster_name, 'stop')
+    backend = cloud_tpu_backend.CloudTpuBackend()
+    backend.teardown(handle, terminate=False, purge=purge)
+
+
+@timeline.event
+def down(cluster_name: str, purge: bool = False) -> None:
+    """Terminate a cluster (reference: sky.down, core.py:375)."""
+    handle = _get_handle(cluster_name, 'down')
+    backend = cloud_tpu_backend.CloudTpuBackend()
+    backend.teardown(handle, terminate=True, purge=purge)
+
+
+@timeline.event
+def autostop(cluster_name: str, idle_minutes: int,
+             down: bool = False) -> None:  # pylint: disable=redefined-outer-name
+    """Arm/disarm autostop (reference: sky.autostop, core.py:408;
+    idle_minutes < 0 disarms)."""
+    handle = backend_utils.check_cluster_available(cluster_name, 'autostop')
+    backend = cloud_tpu_backend.CloudTpuBackend()
+    backend.set_autostop(handle, idle_minutes, down)
+
+
+# ---------------- job ops ----------------
+@timeline.event
+def queue(cluster_name: str, skip_finished: bool = False,
+          all_users: bool = True) -> List[Dict[str, Any]]:
+    """Job queue of one cluster (reference: sky.queue, core.py:517)."""
+    import getpass
+    handle = backend_utils.check_cluster_available(cluster_name, 'queue')
+    backend = cloud_tpu_backend.CloudTpuBackend()
+    username = None if all_users else getpass.getuser()
+    jobs = backend.get_job_queue(handle, username=username, all_jobs=True)
+    if skip_finished:
+        terminal = {'SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'CANCELLED'}
+        jobs = [j for j in jobs if j['status'] not in terminal]
+    return jobs
+
+
+@timeline.event
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    """(reference: sky.cancel, core.py:579)"""
+    if not job_ids and not all_jobs:
+        raise ValueError('Specify job_ids or all_jobs=True.')
+    handle = backend_utils.check_cluster_available(cluster_name, 'cancel')
+    backend = cloud_tpu_backend.CloudTpuBackend()
+    return backend.cancel_jobs(handle, job_ids, cancel_all=all_jobs)
+
+
+@timeline.event
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True) -> int:
+    """(reference: sky.tail_logs, core.py:666)"""
+    handle = backend_utils.check_cluster_available(cluster_name, 'tail logs')
+    backend = cloud_tpu_backend.CloudTpuBackend()
+    return backend.tail_logs(handle, job_id, follow=follow)
+
+
+@timeline.event
+def download_logs(cluster_name: str, job_id: Optional[int] = None,
+                  local_dir: str = '~/.skytpu/job_logs') -> str:
+    """(reference: sky.download_logs, core.py:705)"""
+    handle = backend_utils.check_cluster_available(cluster_name,
+                                                   'download logs')
+    backend = cloud_tpu_backend.CloudTpuBackend()
+    return backend.sync_down_logs(handle, job_id, local_dir)
+
+
+@timeline.event
+def job_status(cluster_name: str, job_ids: Optional[List[int]] = None
+               ) -> Dict[int, Optional[str]]:
+    """(reference: sky.job_status, core.py:747)"""
+    handle = backend_utils.check_cluster_available(cluster_name,
+                                                   'query job status')
+    backend = cloud_tpu_backend.CloudTpuBackend()
+    if job_ids is None:
+        latest = backend.get_job_status(handle, None)
+        return {-1: latest}
+    return {jid: backend.get_job_status(handle, jid) for jid in job_ids}
+
+
+# ---------------- accounting ----------------
+@timeline.event
+def cost_report() -> List[Dict[str, Any]]:
+    """Per-cluster cost from recorded usage intervals (reference:
+    sky.cost_report, core.py:136; intervals recorded in
+    global_user_state:446-503)."""
+    import time as time_lib
+    records = global_user_state.get_cluster_history()
+    for record in records:
+        launched = record.get('launched_resources')
+        duration = 0
+        for (start_t, end_t) in record.get('usage_intervals') or []:
+            end_t = end_t if end_t is not None else int(time_lib.time())
+            duration += end_t - start_t
+        cost = 0.0
+        if launched is not None and duration:
+            try:
+                cost = launched.get_cost(duration)
+            except Exception:  # pylint: disable=broad-except
+                cost = 0.0
+        record['duration'] = duration
+        record['total_cost'] = cost
+    return records
+
+
+# ---------------- storage ----------------
+@timeline.event
+def storage_ls() -> List[Dict[str, Any]]:
+    """(reference: sky.storage_ls, core.py:800)"""
+    storages = global_user_state.get_storage()
+    return storages
+
+
+@timeline.event
+def storage_delete(name: str) -> None:
+    """(reference: sky.storage_delete, core.py:822)"""
+    try:
+        from skypilot_tpu.data import storage as storage_lib
+    except ImportError as e:
+        raise exceptions.NotSupportedError(
+            'Storage ops require the data layer, which is not available in '
+            'this build.') from e
+    stores = {s['name']: s for s in global_user_state.get_storage()}
+    if name not in stores:
+        raise exceptions.StorageError(f'Storage {name!r} not found.')
+    handle = stores[name]['handle']
+    if handle is None:
+        global_user_state.remove_storage(name)
+        return
+    store = storage_lib.Storage.from_metadata(handle)
+    store.delete()

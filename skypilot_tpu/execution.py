@@ -1,0 +1,164 @@
+"""Execution layer: the staged launch/exec pipeline.
+
+Reference parity: sky/execution.py (568 LoC) — the 9-stage pipeline
+OPTIMIZE→PROVISION→SYNC_WORKDIR→SYNC_FILE_MOUNTS→SETUP→PRE_EXEC→EXEC→DOWN
+(execution.py:31-43, _execute:95), `launch` (:347) and `exec` (:480, the
+fast path that skips provisioning). CLONE_DISK is dropped: TPU slices have
+no persistent boot disks worth cloning.
+"""
+from __future__ import annotations
+
+import enum
+import logging
+from typing import List, Optional, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.backends import cloud_tpu_backend
+from skypilot_tpu.utils import timeline
+
+logger = logging.getLogger(__name__)
+
+
+class Stage(enum.Enum):
+    """(reference: execution.py:31-43)"""
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
+    SETUP = enum.auto()
+    PRE_EXEC = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+def _as_dag(task_or_dag: Union['task_lib.Task', 'dag_lib.Dag']
+            ) -> 'dag_lib.Dag':
+    if isinstance(task_or_dag, dag_lib.Dag):
+        return task_or_dag
+    dag = dag_lib.Dag()
+    dag.add(task_or_dag)
+    return dag
+
+
+@timeline.event
+def _execute(
+    task_or_dag: Union['task_lib.Task', 'dag_lib.Dag'],
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    cluster_name: Optional[str] = None,
+    stages: Optional[List[Stage]] = None,
+    detach_run: bool = False,
+    idle_minutes_to_autostop: Optional[int] = None,
+    retry_until_up: bool = False,
+    minimize: optimizer.OptimizeTarget = optimizer.OptimizeTarget.COST,
+    quiet_optimizer: bool = False,
+):
+    """(reference: _execute, sky/execution.py:95)"""
+    dag = _as_dag(task_or_dag)
+    if len(dag.tasks) != 1:
+        raise exceptions.NotSupportedError(
+            'launch/exec take a single task; for multi-task DAGs use '
+            'managed jobs (skypilot_tpu.jobs.launch).')
+    task = dag.tasks[0]
+    stages = stages or list(Stage)
+    if down and idle_minutes_to_autostop is None:
+        # `down=True` means "tear down when the job is done", and the job
+        # may be detached — so it becomes 1-minute autodown enforced by the
+        # on-cluster agent, never an immediate teardown that would kill a
+        # running job (reference: execution.py:194-211).
+        idle_minutes_to_autostop = 1
+    if idle_minutes_to_autostop is not None:
+        stages = [s for s in stages if s != Stage.DOWN]
+
+    backend = cloud_tpu_backend.CloudTpuBackend()
+    backend.register_info(minimize=minimize)
+
+    handle = None
+    to_provision = None
+    if Stage.PROVISION in stages:
+        # Reuse path: an UP cluster short-circuits the optimizer
+        # (reference: execution.py:249-259 only optimizes when the cluster
+        # does not exist yet).
+        record = (global_user_state.get_cluster_from_name(cluster_name)
+                  if cluster_name else None)
+        if record is not None and record['handle'] is not None:
+            to_provision = record['handle'].launched_resources
+        elif Stage.OPTIMIZE in stages:
+            dag = optimizer.optimize(dag, minimize=minimize,
+                                     quiet=quiet_optimizer or dryrun)
+            to_provision = task.best_resources()
+        else:
+            to_provision = task.best_resources()
+        if dryrun:
+            logger.info('Dryrun: would provision %s.', to_provision)
+            return None, None
+        handle = backend.provision(task, to_provision, dryrun=False,
+                                   stream_logs=stream_logs,
+                                   cluster_name=cluster_name,
+                                   retry_until_up=retry_until_up)
+    else:
+        assert cluster_name is not None
+        handle = backend_utils.check_cluster_available(cluster_name, 'exec')
+
+    job_id = None
+    if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
+        backend.sync_workdir(handle, task.workdir)
+    if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
+                                             task.storage_mounts):
+        backend.sync_file_mounts(handle, task.file_mounts,
+                                 task.storage_mounts)
+    if Stage.SETUP in stages:
+        backend.setup(handle, task)
+    if Stage.PRE_EXEC in stages:
+        if idle_minutes_to_autostop is not None:
+            backend.set_autostop(handle, idle_minutes_to_autostop,
+                                 down=down)
+    if Stage.EXEC in stages:
+        job_id = backend.execute(handle, task, detach_run=detach_run)
+    return job_id, handle
+
+
+@timeline.event
+def launch(
+    task: Union['task_lib.Task', 'dag_lib.Dag'],
+    cluster_name: Optional[str] = None,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    detach_run: bool = False,
+    idle_minutes_to_autostop: Optional[int] = None,
+    retry_until_up: bool = False,
+    minimize: optimizer.OptimizeTarget = optimizer.OptimizeTarget.COST,
+    quiet_optimizer: bool = False,
+):
+    """Provision (or reuse) a cluster and run the task on it
+    (reference: sky.launch, execution.py:347). Returns (job_id, handle)."""
+    return _execute(task, dryrun=dryrun, down=down, stream_logs=stream_logs,
+                    cluster_name=cluster_name, stages=None,
+                    detach_run=detach_run,
+                    idle_minutes_to_autostop=idle_minutes_to_autostop,
+                    retry_until_up=retry_until_up, minimize=minimize,
+                    quiet_optimizer=quiet_optimizer)
+
+
+@timeline.event
+def exec(  # pylint: disable=redefined-builtin
+    task: Union['task_lib.Task', 'dag_lib.Dag'],
+    cluster_name: str,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    detach_run: bool = False,
+):
+    """Fast path: run on an existing UP cluster — workdir sync + exec only,
+    no provisioning/setup (reference: sky.exec, execution.py:480)."""
+    return _execute(task, dryrun=dryrun, down=down, stream_logs=stream_logs,
+                    cluster_name=cluster_name,
+                    stages=[Stage.SYNC_WORKDIR, Stage.EXEC],
+                    detach_run=detach_run)

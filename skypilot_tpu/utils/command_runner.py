@@ -130,20 +130,56 @@ class LocalCommandRunner(CommandRunner):
                              timeout=timeout)
 
     def rsync(self, source: str, target: str, *, up: bool, excludes=None):
+        """Pure-Python mirror with `rsync -a --delete` semantics for the
+        dir case (overwrite-in-place, remove extraneous dst entries): no
+        rsync binary needed for fake-cloud hosts, and re-syncs are
+        idempotent even with symlinks."""
         del up  # both sides local
-        argv = ['rsync', '-a', '--delete-excluded']
-        for e in excludes or []:
-            argv += ['--exclude', e]
-        target = os.path.expanduser(target)
-        os.makedirs(os.path.dirname(target.rstrip('/')) or '.',
-                    exist_ok=True)
-        argv += [os.path.expanduser(source), target]
-        proc = subprocess.run(argv, capture_output=True, text=True,
-                              check=False)
-        if proc.returncode != 0:
+        import fnmatch
+        import shutil
+        src = os.path.expanduser(source)
+        dst = os.path.expanduser(target)
+        os.makedirs(os.path.dirname(dst.rstrip('/')) or '.', exist_ok=True)
+        patterns = list(excludes or [])
+
+        def _excluded(name: str) -> bool:
+            return any(fnmatch.fnmatch(name, p) for p in patterns)
+
+        def _copy_entry(s: str, d: str) -> None:
+            if os.path.islink(s):
+                if os.path.lexists(d):
+                    _rm(d)
+                os.symlink(os.readlink(s), d)
+            elif os.path.isdir(s):
+                _mirror(s, d)
+            else:
+                if os.path.isdir(d) and not os.path.islink(d):
+                    shutil.rmtree(d)
+                shutil.copy2(s, d)
+
+        def _rm(path: str) -> None:
+            if os.path.isdir(path) and not os.path.islink(path):
+                shutil.rmtree(path)
+            else:
+                os.unlink(path)
+
+        def _mirror(s_dir: str, d_dir: str) -> None:
+            os.makedirs(d_dir, exist_ok=True)
+            src_names = [n for n in os.listdir(s_dir) if not _excluded(n)]
+            for stale in set(os.listdir(d_dir)) - set(src_names):
+                _rm(os.path.join(d_dir, stale))
+            for n in src_names:
+                _copy_entry(os.path.join(s_dir, n), os.path.join(d_dir, n))
+
+        try:
+            if os.path.isdir(src) and not os.path.islink(src):
+                _mirror(src, dst)
+            else:
+                _copy_entry(src, dst)
+        except OSError as e:
             from skypilot_tpu import exceptions
-            raise exceptions.CommandError(proc.returncode, ' '.join(argv),
-                                          proc.stderr)
+            raise exceptions.CommandError(
+                1, f'local sync {src} -> {dst}', str(e)) from e
 
 
 class SSHCommandRunner(CommandRunner):

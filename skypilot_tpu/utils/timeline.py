@@ -1,0 +1,124 @@
+"""Chrome trace-event tracing of client operations.
+
+Reference parity: sky/utils/timeline.py (133 LoC) — `@timeline.event`
+decorator and `FileLockEvent` record begin/end ('B'/'E') trace events;
+the trace is dumped at exit as Chrome trace-event JSON when
+SKYTPU_DEBUG=1 (load in chrome://tracing or Perfetto).
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Union
+
+_events: List[dict] = []
+_lock = threading.Lock()
+_enabled: Optional[bool] = None
+
+
+def _is_enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        _enabled = os.environ.get('SKYTPU_DEBUG', '0') == '1'
+        if _enabled:
+            atexit.register(save_timeline)
+    return _enabled
+
+
+def _record(name: str, phase: str) -> None:
+    event = {
+        'name': name,
+        'cat': 'default',
+        'ph': phase,
+        'ts': f'{time.time() * 10 ** 6: .3f}',
+        'pid': str(os.getpid()),
+        'tid': str(threading.get_ident()),
+    }
+    with _lock:
+        _events.append(event)
+
+
+class Event:
+    """Context manager recording one B/E pair."""
+
+    def __init__(self, name: str) -> None:
+        self._name = name
+
+    def begin(self) -> None:
+        if _is_enabled():
+            _record(self._name, 'B')
+
+    def end(self) -> None:
+        if _is_enabled():
+            _record(self._name, 'E')
+
+    def __enter__(self) -> 'Event':
+        self.begin()
+        return self
+
+    def __exit__(self, *args) -> None:
+        self.end()
+
+
+def event(name_or_fn: Union[str, Callable], name: Optional[str] = None):
+    """Decorator (or context-manager factory) tracing a function
+    (reference: timeline.event; applied e.g. at sky/execution.py:345)."""
+    if isinstance(name_or_fn, str):
+        return Event(name_or_fn)
+    fn = name_or_fn
+    fn_name = name or f'{fn.__module__}.{fn.__qualname__}'
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with Event(fn_name):
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+class FileLockEvent:
+    """Wrap a filelock acquire so lock contention shows in the trace
+    (reference: timeline.FileLockEvent)."""
+
+    def __init__(self, lockfile: str) -> None:
+        self._lockfile = lockfile
+        import filelock
+        self._lock = filelock.FileLock(lockfile)
+        self._event = Event(f'[FileLock.acquire]:{lockfile}')
+
+    def acquire(self) -> None:
+        self._event.begin()
+        self._lock.acquire()
+        self._event.end()
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self) -> 'FileLockEvent':
+        self.acquire()
+        return self
+
+    def __exit__(self, *args) -> None:
+        self.release()
+
+
+def save_timeline() -> None:
+    if not _events:
+        return
+    path = os.environ.get(
+        'SKYTPU_TIMELINE_FILE',
+        os.path.expanduser(f'~/.skytpu/timelines/timeline-{os.getpid()}.json'))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with _lock:
+        payload = {
+            'traceEvents': list(_events),
+            'displayTimeUnit': 'ms',
+            'otherData': {'argv': ' '.join(os.sys.argv)},
+        }
+        _events.clear()
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f)
