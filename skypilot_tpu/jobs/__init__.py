@@ -1,0 +1,17 @@
+"""Managed jobs: auto-recovering (spot-friendly) jobs on TPU slices.
+
+Reference parity: sky/jobs/ (3,040 LoC; SURVEY §2.6). Public API mirrors
+sky.jobs.{launch,queue,cancel,tail_logs}.
+"""
+from skypilot_tpu.jobs.core import cancel
+from skypilot_tpu.jobs.core import launch
+from skypilot_tpu.jobs.core import queue
+from skypilot_tpu.jobs.core import tail_logs
+from skypilot_tpu.jobs.recovery_strategy import RECOVERY_STRATEGIES
+from skypilot_tpu.jobs.recovery_strategy import StrategyExecutor
+from skypilot_tpu.jobs.state import ManagedJobStatus
+
+__all__ = [
+    'ManagedJobStatus', 'RECOVERY_STRATEGIES', 'StrategyExecutor', 'cancel',
+    'launch', 'queue', 'tail_logs'
+]

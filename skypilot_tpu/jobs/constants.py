@@ -1,0 +1,66 @@
+"""Managed-jobs constants.
+
+Reference parity: sky/jobs/constants.py (controller sizing, poll gaps) —
+here the controller is a local daemon process, so the sizing knobs become
+poll/backoff knobs, all env-overridable so hermetic tests can run the full
+preempt→recover loop in seconds.
+"""
+from __future__ import annotations
+
+import os
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def jobs_home() -> str:
+    from skypilot_tpu.agent import constants as agent_constants
+    return os.path.join(agent_constants.agent_home(), 'managed_jobs')
+
+
+def jobs_db_path() -> str:
+    return os.path.join(jobs_home(), 'managed_jobs.db')
+
+
+def signal_dir() -> str:
+    return os.path.join(jobs_home(), 'signals')
+
+
+def controller_log_path(job_id: int) -> str:
+    return os.path.join(jobs_home(), f'controller-{job_id}.log')
+
+
+def dag_yaml_path(job_id: int) -> str:
+    return os.path.join(jobs_home(), f'dag-{job_id}.yaml')
+
+
+# How often the controller polls the job's status on its cluster
+# (reference: JOB_STATUS_CHECK_GAP_SECONDS, sky/jobs/utils.py).
+def job_status_check_gap_seconds() -> float:
+    return _env_float('SKYTPU_JOBS_POLL_SECONDS', 15.0)
+
+
+# Wait between failed recovery attempts (reference:
+# RECOVERY_...GAP via recovery_strategy.py retry gaps).
+def recovery_wait_seconds() -> float:
+    return _env_float('SKYTPU_JOBS_RECOVERY_WAIT_SECONDS', 60.0)
+
+
+# Cap on optimizer/provision retries within one recovery attempt before
+# the strategy gives up and sleeps (reference: _MAX_RETRY_CNT,
+# recovery_strategy.py).
+MAX_LAUNCH_RETRIES = int(os.environ.get('SKYTPU_JOBS_MAX_LAUNCH_RETRIES',
+                                        '3'))
+
+# Managed-job cluster names are <task-name>-<job_id> (reference generates
+# unique cluster names per managed job, jobs/utils.py).
+JOB_CLUSTER_NAME_PREFIX = 'skytpu-jobs'
+
+# Stable across recoveries; exported into the task env so user programs can
+# key checkpoints on it (reference: SKYPILOT_TASK_ID,
+# sky/skylet/constants.py:64-71).
+TASK_ID_ENV_VAR = 'SKYTPU_MANAGED_TASK_ID'

@@ -1,0 +1,226 @@
+"""The managed-jobs controller: launch, monitor, recover.
+
+Reference parity: sky/jobs/controller.py (550 LoC) — `JobsController` with
+`_run_one_task` (controller.py:103-325): poll job status each gap; on
+SUCCEEDED tear down and move to the next chain task; on preemption
+(cluster not UP) or lost job status, clean up the slice and invoke the
+recovery strategy; signal-file cancel (:407); chain-DAG pipelines (:325).
+
+Architectural deviation (deliberate): the reference runs this loop on a
+dedicated controller VM as a Ray job; here it is a detached local process
+(`python -m skypilot_tpu.jobs.controller`), which keeps the defining
+property — the controller recursively drives the full launch stack — while
+staying Ray-free and hermetically testable.
+
+TPU-specific: a preempted TPU slice must be deleted before relaunch
+(reference: resources.py:602, controller.py:305-315); strategies always
+terminate before recovering.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+import traceback
+import typing
+from typing import Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs import utils as jobs_utils
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import dag_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+logger = logging.getLogger(__name__)
+
+# On-cluster job statuses that are terminal (agent/job_lib FSM values come
+# back over the codegen RPC as plain strings).
+_JOB_TERMINAL = {'SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'CANCELLED'}
+
+
+class JobsController:
+    """Runs one managed job: a chain of tasks, each with recovery."""
+
+    def __init__(self, job_id: int, dag_yaml: str) -> None:
+        self.job_id = job_id
+        self.dag = dag_utils.load_chain_dag_from_yaml(dag_yaml)
+        self.strategy: Optional[recovery_strategy.StrategyExecutor] = None
+
+    # ---------------- helpers ----------------
+
+    def _cancelled(self) -> bool:
+        return jobs_utils.check_cancel_signal(self.job_id)
+
+    def _job_status_on_cluster(self, cluster_name: str) -> Optional[str]:
+        """Best-effort job status; None means we could not reach the
+        cluster (treated as a preemption signal by the caller)."""
+        from skypilot_tpu import core
+        try:
+            statuses = core.job_status(cluster_name)
+            return next(iter(statuses.values()))
+        except (exceptions.ClusterNotUpError, exceptions.CommandError,
+                exceptions.JobNotFoundError):
+            return None
+
+    def _cluster_is_up(self, cluster_name: str) -> bool:
+        try:
+            status, _ = backend_utils.refresh_cluster_status_handle(
+                cluster_name, force_refresh=True)
+        except Exception:  # pylint: disable=broad-except
+            return False
+        return status == ClusterStatus.UP
+
+    # ---------------- the monitoring loop ----------------
+
+    def _run_one_task(self, task_id: int, task: 'task_lib.Task') -> bool:
+        """Returns True iff the task ran to SUCCEEDED."""
+        job_id = self.job_id
+        cluster_name = jobs_utils.generate_managed_job_cluster_name(
+            task.name, job_id)
+        # Stable task id across recoveries — the checkpoint/resume contract
+        # (reference: SKYPILOT_TASK_ID, skylet/constants.py:64-71).
+        task.update_envs({
+            constants.TASK_ID_ENV_VAR:
+                f'sky-managed-{job_id}-{task_id}-{task.name or "task"}',
+            'SKYTPU_MANAGED_JOB_ID': str(job_id),
+        })
+        max_restarts = 0
+        for resources in task.resources:
+            args = resources.accelerator_args or {}
+            max_restarts = max(max_restarts,
+                               int(args.get('max_restarts_on_errors', 0)))
+        self.strategy = recovery_strategy.StrategyExecutor.make(
+            cluster_name, task, max_restarts_on_errors=max_restarts)
+
+        import datetime
+        jobs_state.set_submitted(
+            job_id, task_id,
+            datetime.datetime.now().strftime('sky-%Y-%m-%d-%H-%M-%S-%f'))
+        jobs_state.set_starting(job_id, task_id)
+        try:
+            self.strategy.launch()
+        except exceptions.ProvisionPrechecksError as e:
+            jobs_state.set_failed(job_id, task_id,
+                                  jobs_state.ManagedJobStatus.FAILED_PRECHECKS,
+                                  str(e))
+            return False
+        except exceptions.ManagedJobReachedMaxRetriesError as e:
+            jobs_state.set_failed(
+                job_id, task_id,
+                jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
+            return False
+        jobs_state.set_started(job_id, task_id, cluster_name)
+
+        gap = constants.job_status_check_gap_seconds()
+        while True:
+            if self._cancelled():
+                jobs_state.set_cancelling(job_id)
+                self.strategy.terminate_cluster()
+                jobs_state.set_cancelled(job_id)
+                return False
+            time.sleep(gap)
+            status = self._job_status_on_cluster(cluster_name)
+
+            if status == 'SUCCEEDED':
+                jobs_state.set_succeeded(job_id, task_id)
+                self.strategy.terminate_cluster()
+                return True
+
+            # Cloud truth trumps the job-status RPC: a TPU slice can lose
+            # hosts to preemption while the head host still answers (the
+            # reference polls cluster status every loop for the same
+            # reason, controller.py:188-325).
+            if not self._cluster_is_up(cluster_name):
+                self._recover(task_id)
+                continue
+
+            if status in ('FAILED', 'FAILED_SETUP'):
+                # User-code failure on a healthy cluster (health was just
+                # verified above): recovery only helps if the user budgeted
+                # restarts (reference: controller.py:230-270).
+                if not self.strategy.should_restart_on_failure():
+                    failure = (jobs_state.ManagedJobStatus.FAILED_SETUP
+                               if status == 'FAILED_SETUP' else
+                               jobs_state.ManagedJobStatus.FAILED)
+                    jobs_state.set_failed(
+                        job_id, task_id, failure,
+                        f'Task exited with status {status}.')
+                    self.strategy.terminate_cluster()
+                    return False
+                self._recover(task_id)
+                continue
+
+            if status == 'CANCELLED':
+                # Cancelled out-of-band on the cluster itself.
+                jobs_state.set_cancelling(job_id)
+                self.strategy.terminate_cluster()
+                jobs_state.set_cancelled(job_id)
+                return False
+            # None (transient RPC failure on a healthy cluster) or
+            # PENDING/SETTING_UP/RUNNING: keep polling.
+
+    def _recover(self, task_id: int) -> None:
+        """Preemption path: delete the (partial) slice, relaunch via the
+        strategy, resume monitoring."""
+        logger.info('Managed job %d task %d: recovering.', self.job_id,
+                    task_id)
+        jobs_state.set_recovering(self.job_id, task_id)
+        assert self.strategy is not None
+        self.strategy.recover()
+        jobs_state.set_recovered(self.job_id, task_id,
+                                 self.strategy.cluster_name)
+
+    def run(self) -> None:
+        """Chain pipeline: run tasks in topological order; stop at the
+        first failure (reference: JobsController.run, controller.py:325)."""
+        for task_id, task in enumerate(self.dag.topological_order()):
+            succeeded = self._run_one_task(task_id, task)
+            if not succeeded:
+                # Remaining tasks stay PENDING→ marked failed for clarity.
+                status = jobs_state.get_status(self.job_id)
+                if status == jobs_state.ManagedJobStatus.CANCELLED:
+                    return
+                jobs_state.set_failed(
+                    self.job_id, None,
+                    jobs_state.ManagedJobStatus.FAILED,
+                    f'Upstream task {task_id} did not succeed.')
+                return
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description='Managed-jobs controller.')
+    parser.add_argument('--job-id', type=int, required=True)
+    parser.add_argument('--dag-yaml', type=str, required=True)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(levelname)s %(name)s: %(message)s')
+    controller = JobsController(args.job_id, args.dag_yaml)
+    try:
+        controller.run()
+    except Exception:  # pylint: disable=broad-except
+        logger.error('Controller crashed:\n%s', traceback.format_exc())
+        jobs_state.set_failed(
+            args.job_id, None,
+            jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+            traceback.format_exc(limit=3))
+        # Best-effort cleanup of the task cluster.
+        if controller.strategy is not None:
+            try:
+                controller.strategy.terminate_cluster()
+            except Exception:  # pylint: disable=broad-except
+                pass
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,158 @@
+"""Managed-jobs client API: launch / queue / cancel / tail_logs.
+
+Reference parity: sky/jobs/core.py (330 LoC) — `launch` dumps the dag to
+YAML and starts a controller for it (there: a controller *cluster* via
+jobs-controller.yaml.j2; here: a detached local controller process — see
+jobs/controller.py for the rationale), `queue` (:138), `cancel` (:225),
+`tail_logs` (:281).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import typing
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.jobs import state
+from skypilot_tpu.jobs import utils as jobs_utils
+from skypilot_tpu.utils import dag_utils
+from skypilot_tpu.utils import timeline
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import task as task_lib
+
+
+@timeline.event
+def launch(
+    task: Union['task_lib.Task', 'dag_lib.Dag'],
+    name: Optional[str] = None,
+    detach_run: bool = True,
+) -> int:
+    """Launches a managed job (reference: sky.jobs.launch, jobs/core.py:30).
+
+    Returns the managed job id. The controller process owns the full
+    lifecycle: provision (with failover), monitor, recover on preemption,
+    tear down.
+    """
+    dag = dag_utils.convert_entrypoint_to_dag(task)
+    dag.validate()
+    if not dag.is_chain():
+        raise exceptions.NotSupportedError(
+            'Managed jobs support single tasks or chain pipelines only.')
+    if name is not None:
+        dag.name = name
+    if dag.name is None:
+        dag.name = dag.tasks[0].name or 'managed-job'
+
+    for t in dag.tasks:
+        if not t.resources:
+            raise ValueError(f'Task {t.name!r} has no resources set.')
+
+    os.makedirs(constants.jobs_home(), exist_ok=True)
+    job_id = state.set_job_info(dag.name, '')
+    dag_yaml = constants.dag_yaml_path(job_id)
+    dag_utils.dump_chain_dag_to_yaml(dag, dag_yaml)
+
+    for task_id, t in enumerate(dag.topological_order()):
+        resources_str = ', '.join(
+            str(r.accelerators or r.cloud_name or 'cpu')
+            for r in t.resources)
+        state.set_pending(job_id, task_id, t.name or f'task-{task_id}',
+                          resources_str)
+
+    log_path = constants.controller_log_path(job_id)
+    with open(log_path, 'ab') as log_file:
+        proc = subprocess.Popen(  # pylint: disable=consider-using-with
+            [
+                sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+                '--job-id', str(job_id), '--dag-yaml', dag_yaml
+            ],
+            stdout=log_file,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+            start_new_session=True,
+            env=os.environ.copy())
+    state.set_controller_pid(job_id, proc.pid)
+
+    if not detach_run:
+        proc.wait()
+    return job_id
+
+
+def _resolve_job_ids(name: Optional[str], job_ids: Optional[List[int]],
+                     all_jobs: bool) -> List[int]:
+    if all_jobs:
+        return state.get_nonterminal_job_ids()
+    resolved: List[int] = list(job_ids or [])
+    if name is not None:
+        job_id = state.get_job_id_by_name(name)
+        if job_id is None:
+            raise exceptions.JobNotFoundError(
+                f'No managed job named {name!r}.')
+        resolved.append(job_id)
+    if not resolved:
+        raise ValueError('Specify name=, job_ids=, or all_jobs=True.')
+    return resolved
+
+
+@timeline.event
+def queue(refresh: bool = True,
+          skip_finished: bool = False) -> List[Dict[str, Any]]:
+    """All managed jobs (reference: sky.jobs.queue, jobs/core.py:138).
+    `refresh` runs dead-controller detection first."""
+    if refresh:
+        jobs_utils.update_managed_job_status()
+    records = state.get_managed_jobs()
+    if skip_finished:
+        records = [r for r in records if not r['status'].is_terminal()]
+    return records
+
+
+@timeline.event
+def cancel(name: Optional[str] = None,
+           job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    """Cancel managed jobs by name/id (reference: sky.jobs.cancel,
+    jobs/core.py:225). Signal-file protocol: the controller consumes the
+    signal at its next poll tick and tears the cluster down."""
+    cancelled = []
+    for job_id in _resolve_job_ids(name, job_ids, all_jobs):
+        status = state.get_status(job_id)
+        if status is None or status.is_terminal():
+            continue
+        jobs_utils.send_cancel_signal(job_id)
+        cancelled.append(job_id)
+    return cancelled
+
+
+@timeline.event
+def tail_logs(name: Optional[str] = None,
+              job_id: Optional[int] = None,
+              follow: bool = True,
+              controller: bool = False) -> int:
+    """Stream a managed job's logs (reference: sky.jobs.tail_logs,
+    jobs/core.py:281). With controller=True, streams the controller's own
+    log instead of the task's."""
+    ids = _resolve_job_ids(name, [job_id] if job_id else None,
+                           all_jobs=False)
+    job_id = ids[0]
+    if controller:
+        path = constants.controller_log_path(job_id)
+        if not os.path.exists(path):
+            raise exceptions.JobNotFoundError(
+                f'No controller log for managed job {job_id}.')
+        with open(path, 'r', encoding='utf-8') as f:
+            sys.stdout.write(f.read())
+        return 0
+    records = state.get_task_records(job_id)
+    current = next((r for r in records if not r['status'].is_terminal()),
+                   records[-1] if records else None)
+    if current is None or not current.get('cluster_name'):
+        raise exceptions.JobNotFoundError(
+            f'Managed job {job_id} has no running task cluster.')
+    from skypilot_tpu import core
+    return core.tail_logs(current['cluster_name'], None, follow=follow)

@@ -1,0 +1,229 @@
+"""Recovery strategies for managed jobs.
+
+Reference parity: sky/jobs/recovery_strategy.py (543 LoC) — a
+`StrategyExecutor` registry (recovery_strategy.py:62-113), `launch()` with
+optimizer retries (`_launch:246`), and two concrete strategies: FAILOVER
+(retry the last-used zone/region first, then fail over, :372) and
+EAGER_NEXT_REGION (immediately move to new regions — the default for spot
+TPUs, since a preempted zone is usually still capacity-starved, :458).
+
+TPU-specific behavior: preempted TPU slices cannot be restarted in place —
+the queued-resource/node must be *deleted* before a new launch
+(reference: resources.py:602, jobs/controller.py:305-315), so
+`terminate_cluster` is always a full delete here.
+"""
+from __future__ import annotations
+
+import logging
+import time
+import typing
+from typing import Dict, Optional, Type
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import status_lib
+from skypilot_tpu.jobs import constants
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_RECOVERY_STRATEGY = 'EAGER_NEXT_REGION'
+RECOVERY_STRATEGIES: Dict[str, Type['StrategyExecutor']] = {}
+
+
+class StrategyExecutor:
+    """Handles launch/recover of one task's cluster (reference:
+    recovery_strategy.py:62)."""
+
+    NAME = 'STRATEGY_BASE'
+
+    def __init__(self, cluster_name: str, task: 'task_lib.Task',
+                 max_restarts_on_errors: int = 0) -> None:
+        self.cluster_name = cluster_name
+        self.task = task
+        self.max_restarts_on_errors = max_restarts_on_errors
+        self.restart_cnt_on_failure = 0
+
+    def __init_subclass__(cls) -> None:
+        if cls.NAME in RECOVERY_STRATEGIES:
+            raise ValueError(f'Duplicate strategy name: {cls.NAME}')
+        RECOVERY_STRATEGIES[cls.NAME] = cls
+
+    @classmethod
+    def make(cls, cluster_name: str, task: 'task_lib.Task',
+             max_restarts_on_errors: int = 0) -> 'StrategyExecutor':
+        """Picks the strategy from the task's resources.job_recovery
+        (reference: StrategyExecutor.make, recovery_strategy.py:80-113)."""
+        names = set()
+        for resources in task.resources:
+            if resources.job_recovery is not None:
+                names.add(resources.job_recovery.upper())
+        if len(names) > 1:
+            raise ValueError(
+                f'Conflicting job_recovery strategies: {sorted(names)}')
+        name = names.pop() if names else DEFAULT_RECOVERY_STRATEGY
+        if name not in RECOVERY_STRATEGIES:
+            raise ValueError(
+                f'Unknown job_recovery strategy {name!r}; available: '
+                f'{sorted(RECOVERY_STRATEGIES)}')
+        return RECOVERY_STRATEGIES[name](cluster_name, task,
+                                         max_restarts_on_errors)
+
+    # ---------------- operations ----------------
+
+    def launch(self) -> float:
+        """First launch. Returns the launch timestamp.
+
+        Raises ProvisionPrechecksError for user errors (bad spec — do not
+        retry) and ManagedJobReachedMaxRetriesError when capacity never
+        materializes (reference: _launch raise_on_failure path)."""
+        launched = self._launch(raise_on_failure=True)
+        assert launched is not None
+        return launched
+
+    def recover(self) -> float:
+        """Relaunch after preemption/failure; returns the relaunch
+        timestamp. Subclasses implement the region-ordering policy."""
+        raise NotImplementedError
+
+    def terminate_cluster(self, max_retry: int = 3) -> None:
+        """Delete the task cluster (TPU slices cannot stop — full delete;
+        reference: recovery_strategy.py terminate_cluster + TPU cleanup at
+        jobs/controller.py:305-315)."""
+        from skypilot_tpu import core
+        for attempt in range(max_retry):
+            try:
+                record = global_user_state.get_cluster_from_name(
+                    self.cluster_name)
+                if record is None:
+                    return
+                core.down(self.cluster_name, purge=(attempt ==
+                                                    max_retry - 1))
+                return
+            except exceptions.ClusterNotUpError:
+                return
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning('Failed to terminate %s (attempt %d): %s',
+                               self.cluster_name, attempt, e)
+                time.sleep(min(2 ** attempt, 10))
+
+    def _launch(self, raise_on_failure: bool = True,
+                resources_override: Optional[dict] = None
+                ) -> Optional[float]:
+        """One launch attempt cycle: walk the optimizer's candidates via
+        execution.launch (which itself fails over across zones/regions),
+        retrying up to MAX_LAUNCH_RETRIES with a gap (reference: _launch,
+        recovery_strategy.py:246-370)."""
+        from skypilot_tpu import execution
+
+        task = self.task
+        if resources_override:
+            new_resources = {
+                r.copy(**resources_override) for r in task.resources
+            }
+            import copy
+            task = copy.copy(task)
+            task.set_resources(new_resources)
+
+        backoff = constants.recovery_wait_seconds()
+        for retry_cnt in range(1, constants.MAX_LAUNCH_RETRIES + 1):
+            try:
+                job_id, handle = execution.launch(
+                    task,
+                    cluster_name=self.cluster_name,
+                    detach_run=True,
+                    stream_logs=False,
+                    quiet_optimizer=True)
+                assert job_id is not None and handle is not None
+                return time.time()
+            except exceptions.ProvisionPrechecksError:
+                raise
+            except exceptions.ResourcesUnavailableError as e:
+                # Every candidate was capacity-blocked. If the failover
+                # history contains only capacity errors this is retryable;
+                # anything else is a precheck-style failure
+                # (reference: recovery_strategy.py:300-340 distinguishes
+                # via failover_history).
+                logger.info('Launch attempt %d/%d found no capacity: %s',
+                            retry_cnt, constants.MAX_LAUNCH_RETRIES, e)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning('Launch attempt %d/%d failed: %s',
+                               retry_cnt, constants.MAX_LAUNCH_RETRIES, e)
+            if retry_cnt < constants.MAX_LAUNCH_RETRIES:
+                time.sleep(backoff)
+        if raise_on_failure:
+            raise exceptions.ManagedJobReachedMaxRetriesError(
+                f'Failed to launch {self.cluster_name!r} after '
+                f'{constants.MAX_LAUNCH_RETRIES} attempts.')
+        return None
+
+    def should_restart_on_failure(self) -> bool:
+        """User-code failure budget (reference: recovery_strategy.py
+        max_restarts_on_errors handling)."""
+        self.restart_cnt_on_failure += 1
+        return self.restart_cnt_on_failure <= self.max_restarts_on_errors
+
+
+class FailoverStrategyExecutor(StrategyExecutor):
+    """Retry the same region first, then fail over (reference:
+    recovery_strategy.py:372)."""
+
+    NAME = 'FAILOVER'
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._launched_region: Optional[str] = None
+        self._launched_zone: Optional[str] = None
+
+    def _record_location(self) -> None:
+        record = global_user_state.get_cluster_from_name(self.cluster_name)
+        if record and record['handle'] is not None:
+            launched = record['handle'].launched_resources
+            self._launched_region = launched.region
+            self._launched_zone = launched.zone
+
+    def launch(self) -> float:
+        launched = super().launch()
+        self._record_location()
+        return launched
+
+    def recover(self) -> float:
+        # The preempted slice must be deleted before ANY relaunch — a TPU
+        # queued-resource/node cannot be re-created over its own corpse
+        # (reference: resources.py:602, jobs/controller.py:305-315).
+        self.terminate_cluster()
+        # 1. Same zone/region first: transient preemptions sometimes free
+        #    back up, and data residency is preserved.
+        if self._launched_region is not None:
+            launched = self._launch(
+                raise_on_failure=False,
+                resources_override={
+                    'region': self._launched_region,
+                    'zone': self._launched_zone,
+                })
+            if launched is not None:
+                return launched
+        # 2. Fail over anywhere.
+        launched = self._launch(raise_on_failure=True)
+        self._record_location()
+        return launched
+
+
+class EagerFailoverStrategyExecutor(FailoverStrategyExecutor):
+    """Immediately move to a different zone — the default for TPU spot:
+    a zone that just preempted you is the *least* likely to have capacity
+    (reference: EAGER_NEXT_REGION, recovery_strategy.py:458)."""
+
+    NAME = 'EAGER_NEXT_REGION'
+
+    def recover(self) -> float:
+        # Terminate first, then relaunch with no location pin: the
+        # optimizer+failover engine walks every candidate zone, and the
+        # preempting zone naturally sorts last once its capacity error
+        # lands in the failover blocklist.
+        self.terminate_cluster()
+        launched = self._launch(raise_on_failure=True)
+        self._record_location()
+        return launched

@@ -1,0 +1,322 @@
+"""Managed-jobs state: sqlite tables + ManagedJobStatus FSM.
+
+Reference parity: sky/jobs/state.py (613 LoC) — `spot` table (one row per
+task of a managed job) and `job_info` table (one row per managed job), with
+the PENDING→SUBMITTED→STARTING→RUNNING→{RECOVERING⇄RUNNING}→terminal FSM
+(state.py:129-234). The db lives client-side (the controller is a local
+daemon here, not a controller VM).
+"""
+from __future__ import annotations
+
+import enum
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.utils import db_utils
+
+
+class ManagedJobStatus(enum.Enum):
+    """FSM for one task of a managed job (reference: state.py:129-234)."""
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    CANCELLING = 'CANCELLING'
+    # Terminal.
+    SUCCEEDED = 'SUCCEEDED'
+    CANCELLED = 'CANCELLED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_PRECHECKS = 'FAILED_PRECHECKS'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def is_failed(self) -> bool:
+        return self in _FAILED
+
+    @classmethod
+    def terminal_statuses(cls) -> List['ManagedJobStatus']:
+        return list(_TERMINAL)
+
+    def colored_str(self) -> str:
+        return self.value
+
+
+_TERMINAL = (
+    ManagedJobStatus.SUCCEEDED,
+    ManagedJobStatus.CANCELLED,
+    ManagedJobStatus.FAILED,
+    ManagedJobStatus.FAILED_SETUP,
+    ManagedJobStatus.FAILED_PRECHECKS,
+    ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER,
+)
+_FAILED = tuple(s for s in _TERMINAL
+                if s.value.startswith('FAILED'))
+
+
+def _create_table(cursor: sqlite3.Cursor, conn: sqlite3.Connection) -> None:
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS spot (
+            job_id INTEGER,
+            task_id INTEGER DEFAULT 0,
+            task_name TEXT,
+            resources TEXT,
+            cluster_name TEXT,
+            submitted_at REAL,
+            status TEXT,
+            run_timestamp TEXT,
+            start_at REAL,
+            end_at REAL,
+            last_recovered_at REAL DEFAULT -1,
+            recovery_count INTEGER DEFAULT 0,
+            failure_reason TEXT,
+            PRIMARY KEY (job_id, task_id))""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS job_info (
+            spot_job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            dag_yaml_path TEXT,
+            controller_pid INTEGER)""")
+    conn.commit()
+
+
+_db: Optional[db_utils.SQLiteConn] = None
+_db_path: Optional[str] = None
+
+
+def _get_db() -> db_utils.SQLiteConn:
+    global _db, _db_path
+    path = constants.jobs_db_path()
+    if _db is None or _db_path != path:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        _db = db_utils.SQLiteConn(path, _create_table)
+        _db_path = path
+    return _db
+
+
+# ---------------- job_info ----------------
+
+
+def set_job_info(name: str, dag_yaml_path: str) -> int:
+    """Registers a managed job; returns its job_id."""
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'INSERT INTO job_info (name, dag_yaml_path, controller_pid) '
+            'VALUES (?, ?, NULL)', (name, dag_yaml_path))
+        return cursor.lastrowid
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'UPDATE job_info SET controller_pid = ? WHERE spot_job_id = ?',
+            (pid, job_id))
+
+
+def get_job_info(job_id: int) -> Optional[Dict[str, Any]]:
+    db = _get_db()
+    with db.cursor() as cursor:
+        row = cursor.execute(
+            'SELECT spot_job_id, name, dag_yaml_path, controller_pid '
+            'FROM job_info WHERE spot_job_id = ?', (job_id,)).fetchone()
+    if row is None:
+        return None
+    return dict(zip(('job_id', 'name', 'dag_yaml_path', 'controller_pid'),
+                    row))
+
+
+def get_job_id_by_name(name: str) -> Optional[int]:
+    db = _get_db()
+    with db.cursor() as cursor:
+        row = cursor.execute(
+            'SELECT spot_job_id FROM job_info WHERE name = ? '
+            'ORDER BY spot_job_id DESC LIMIT 1', (name,)).fetchone()
+    return row[0] if row else None
+
+
+# ---------------- spot (per-task) rows ----------------
+
+
+def set_pending(job_id: int, task_id: int, task_name: str,
+                resources_str: str) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'INSERT OR REPLACE INTO spot '
+            '(job_id, task_id, task_name, resources, submitted_at, status) '
+            'VALUES (?, ?, ?, ?, ?, ?)',
+            (job_id, task_id, task_name, resources_str, time.time(),
+             ManagedJobStatus.PENDING.value))
+
+
+def set_submitted(job_id: int, task_id: int, run_timestamp: str) -> None:
+    _set(job_id, task_id, status=ManagedJobStatus.SUBMITTED.value,
+         run_timestamp=run_timestamp)
+
+
+def set_starting(job_id: int, task_id: int) -> None:
+    _set(job_id, task_id, status=ManagedJobStatus.STARTING.value)
+
+
+def set_started(job_id: int, task_id: int, cluster_name: str) -> None:
+    now = time.time()
+    db = _get_db()
+    with db.cursor() as cursor:
+        # start_at is set only once; re-entry after recovery keeps it
+        # (reference: state.set_started only updates NULL start_at).
+        cursor.execute(
+            'UPDATE spot SET status = ?, cluster_name = ?, '
+            'start_at = COALESCE(start_at, ?), last_recovered_at = ? '
+            'WHERE job_id = ? AND task_id = ?',
+            (ManagedJobStatus.RUNNING.value, cluster_name, now, now,
+             job_id, task_id))
+
+
+def set_recovering(job_id: int, task_id: int) -> None:
+    _set(job_id, task_id, status=ManagedJobStatus.RECOVERING.value)
+
+
+def set_recovered(job_id: int, task_id: int, cluster_name: str) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'UPDATE spot SET status = ?, cluster_name = ?, '
+            'last_recovered_at = ?, recovery_count = recovery_count + 1 '
+            'WHERE job_id = ? AND task_id = ?',
+            (ManagedJobStatus.RUNNING.value, cluster_name, time.time(),
+             job_id, task_id))
+
+
+def set_cancelling(job_id: int) -> None:
+    _set_all_nonterminal(job_id, ManagedJobStatus.CANCELLING)
+
+
+def set_cancelled(job_id: int) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'UPDATE spot SET status = ?, end_at = ? '
+            'WHERE job_id = ? AND status = ?',
+            (ManagedJobStatus.CANCELLED.value, time.time(), job_id,
+             ManagedJobStatus.CANCELLING.value))
+
+
+def set_succeeded(job_id: int, task_id: int) -> None:
+    _set(job_id, task_id, status=ManagedJobStatus.SUCCEEDED.value,
+         end_at=time.time())
+
+
+def set_failed(job_id: int, task_id: Optional[int],
+               failure_type: ManagedJobStatus,
+               failure_reason: str) -> None:
+    """Marks the task (or every nonterminal task when task_id is None —
+    controller-level failure) failed."""
+    assert failure_type.is_failed(), failure_type
+    db = _get_db()
+    with db.cursor() as cursor:
+        if task_id is None:
+            cursor.execute(
+                'UPDATE spot SET status = ?, end_at = ?, failure_reason = ? '
+                'WHERE job_id = ? AND status NOT IN '
+                f'({",".join(["?"] * len(_TERMINAL))})',
+                (failure_type.value, time.time(), failure_reason, job_id,
+                 *[s.value for s in _TERMINAL]))
+        else:
+            cursor.execute(
+                'UPDATE spot SET status = ?, end_at = ?, failure_reason = ? '
+                'WHERE job_id = ? AND task_id = ?',
+                (failure_type.value, time.time(), failure_reason, job_id,
+                 task_id))
+
+
+def _set(job_id: int, task_id: int, **fields: Any) -> None:
+    db = _get_db()
+    cols = ', '.join(f'{k} = ?' for k in fields)
+    with db.cursor() as cursor:
+        cursor.execute(
+            f'UPDATE spot SET {cols} WHERE job_id = ? AND task_id = ?',
+            (*fields.values(), job_id, task_id))
+
+
+def _set_all_nonterminal(job_id: int, status: ManagedJobStatus) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'UPDATE spot SET status = ? WHERE job_id = ? AND status NOT IN '
+            f'({",".join(["?"] * len(_TERMINAL))})',
+            (status.value, job_id, *[s.value for s in _TERMINAL]))
+
+
+_COLUMNS = ('job_id', 'task_id', 'task_name', 'resources', 'cluster_name',
+            'submitted_at', 'status', 'run_timestamp', 'start_at', 'end_at',
+            'last_recovered_at', 'recovery_count', 'failure_reason')
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    rec = dict(zip(_COLUMNS, row))
+    rec['status'] = ManagedJobStatus(rec['status'])
+    return rec
+
+
+def get_task_records(job_id: int) -> List[Dict[str, Any]]:
+    db = _get_db()
+    with db.cursor() as cursor:
+        rows = cursor.execute(
+            f'SELECT {", ".join(_COLUMNS)} FROM spot WHERE job_id = ? '
+            'ORDER BY task_id', (job_id,)).fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def get_status(job_id: int) -> Optional[ManagedJobStatus]:
+    """Collapses per-task rows to one job status: the first nonterminal
+    task's status, else the first failure, else SUCCEEDED/CANCELLED
+    (reference: get_status_no_lock aggregation, jobs/state.py)."""
+    records = get_task_records(job_id)
+    if not records:
+        return None
+    for rec in records:
+        if not rec['status'].is_terminal():
+            return rec['status']
+    for rec in records:
+        if rec['status'] != ManagedJobStatus.SUCCEEDED:
+            return rec['status']
+    return ManagedJobStatus.SUCCEEDED
+
+
+def get_managed_jobs() -> List[Dict[str, Any]]:
+    """All managed jobs, newest first, one record per (job, task)."""
+    db = _get_db()
+    with db.cursor() as cursor:
+        rows = cursor.execute(
+            f'SELECT {", ".join("spot." + c for c in _COLUMNS)}, '
+            'job_info.name, job_info.controller_pid '
+            'FROM spot LEFT JOIN job_info '
+            'ON spot.job_id = job_info.spot_job_id '
+            'ORDER BY spot.job_id DESC, spot.task_id').fetchall()
+    records = []
+    for row in rows:
+        rec = _row_to_record(row[:len(_COLUMNS)])
+        rec['job_name'] = row[len(_COLUMNS)]
+        rec['controller_pid'] = row[len(_COLUMNS) + 1]
+        records.append(rec)
+    return records
+
+
+def get_nonterminal_job_ids() -> List[int]:
+    db = _get_db()
+    with db.cursor() as cursor:
+        rows = cursor.execute(
+            'SELECT DISTINCT job_id FROM spot WHERE status NOT IN '
+            f'({",".join(["?"] * len(_TERMINAL))})',
+            tuple(s.value for s in _TERMINAL)).fetchall()
+    return [r[0] for r in rows]

@@ -1,0 +1,101 @@
+"""Managed-jobs helpers: cancel signals, dead-controller detection, queue
+formatting.
+
+Reference parity: sky/jobs/utils.py (847 LoC) — `update_managed_job_status`
+(failure detection for dead controller processes) and the signal-file
+cancel protocol (jobs/controller.py:_handle_signal). The codegen-RPC parts
+of the reference disappear: our controller is local, so these are direct
+function calls.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional
+
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.jobs import state
+
+logger = logging.getLogger(__name__)
+
+
+class UserSignal:
+    CANCEL = 'CANCEL'
+
+
+def signal_path(job_id: int) -> str:
+    return os.path.join(constants.signal_dir(), str(job_id))
+
+
+def send_cancel_signal(job_id: int) -> None:
+    os.makedirs(constants.signal_dir(), exist_ok=True)
+    with open(signal_path(job_id), 'w', encoding='utf-8') as f:
+        f.write(UserSignal.CANCEL)
+
+
+def check_cancel_signal(job_id: int) -> bool:
+    """Consumes and returns whether a cancel signal is pending (reference:
+    _handle_signal, jobs/controller.py:407)."""
+    path = signal_path(job_id)
+    if not os.path.exists(path):
+        return False
+    try:
+        with open(path, 'r', encoding='utf-8') as f:
+            signal = f.read().strip()
+        os.remove(path)
+    except OSError:
+        return False
+    return signal == UserSignal.CANCEL
+
+
+def _pid_alive(pid: Optional[int]) -> bool:
+    if pid is None:
+        return False
+    try:
+        os.kill(pid, 0)
+    except (OSError, ProcessLookupError):
+        return False
+    # kill(pid, 0) succeeds for zombies (a dead controller stays a zombie
+    # until its parent reaps it) — check the process state too.
+    try:
+        with open(f'/proc/{pid}/stat', 'r', encoding='utf-8') as f:
+            # Field 3 (after the parenthesised comm) is the state.
+            state = f.read().rsplit(')', 1)[1].split()[0]
+        return state != 'Z'
+    except (OSError, IndexError):
+        return True
+
+
+def update_managed_job_status(job_ids: Optional[List[int]] = None) -> None:
+    """Failure detection: any nonterminal managed job whose controller
+    process is dead is marked FAILED_CONTROLLER (reference:
+    update_managed_job_status, sky/jobs/utils.py — there driven by a skylet
+    event; here invoked on every queue/status read)."""
+    if job_ids is None:
+        job_ids = state.get_nonterminal_job_ids()
+    for job_id in job_ids:
+        info = state.get_job_info(job_id)
+        if info is None:
+            continue
+        pid = info['controller_pid']
+        if pid is None:
+            # Controller not yet registered; the launch API writes the pid
+            # right after spawning, so a missing pid means the spawn
+            # itself died.
+            continue
+        if not _pid_alive(pid):
+            status = state.get_status(job_id)
+            if status is not None and not status.is_terminal():
+                logger.warning(
+                    'Controller process %s of managed job %d is dead; '
+                    'marking FAILED_CONTROLLER.', pid, job_id)
+                state.set_failed(
+                    job_id, None, state.ManagedJobStatus.FAILED_CONTROLLER,
+                    'Controller process died unexpectedly.')
+
+
+def generate_managed_job_cluster_name(task_name: str, job_id: int) -> str:
+    # Cluster names must be stable across recoveries of the same job.
+    safe = ''.join(c if c.isalnum() or c == '-' else '-'
+                   for c in (task_name or 'task').lower())
+    return f'{safe}-{job_id}'

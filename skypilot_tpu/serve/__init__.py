@@ -1,0 +1,28 @@
+"""SkyServe-equivalent: autoscaled replica fleets of TPU slices.
+
+Reference parity: sky/serve/ (5,273 LoC; SURVEY §2.7). Public API mirrors
+sky.serve.{up,update,down,status,tail_logs}.
+"""
+from skypilot_tpu.serve.autoscalers import Autoscaler
+from skypilot_tpu.serve.autoscalers import AutoscalerDecision
+from skypilot_tpu.serve.autoscalers import AutoscalerDecisionOperator
+from skypilot_tpu.serve.autoscalers import FallbackRequestRateAutoscaler
+from skypilot_tpu.serve.autoscalers import RequestRateAutoscaler
+from skypilot_tpu.serve.core import down
+from skypilot_tpu.serve.core import get_endpoint
+from skypilot_tpu.serve.core import status
+from skypilot_tpu.serve.core import tail_logs
+from skypilot_tpu.serve.core import up
+from skypilot_tpu.serve.core import update
+from skypilot_tpu.serve.core import wait_until_ready
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+__all__ = [
+    'Autoscaler', 'AutoscalerDecision', 'AutoscalerDecisionOperator',
+    'FallbackRequestRateAutoscaler', 'ReplicaStatus', 'RequestRateAutoscaler',
+    'ServiceSpec', 'ServiceStatus', 'SkyServiceSpec', 'down', 'get_endpoint',
+    'status', 'tail_logs', 'up', 'update', 'wait_until_ready'
+]

@@ -1,0 +1,275 @@
+"""Autoscalers: request-rate scaling with hysteresis + spot fallback.
+
+Reference parity: sky/serve/autoscalers.py (634 LoC) —
+`AutoscalerDecision` {SCALE_UP, SCALE_DOWN} (autoscalers.py:22-55);
+`RequestRateAutoscaler`: target = ceil(qps / target_qps_per_replica) with
+upscale/downscale hysteresis delays (:141-474);
+`FallbackRequestRateAutoscaler`: spot replicas with on-demand base +
+dynamic fallback (:476-634). Pure logic — driven by the controller loop,
+directly testable with synthetic request timestamps (the reference's own
+test strategy, tests/test_serve_autoscaler.py).
+
+On TPU, "a replica" is a whole slice (e.g. one v5e-8 running JetStream) —
+chips are the scaling unit, so scale decisions map 1:1 to slice
+provision/teardown.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import math
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve import serve_state
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+logger = logging.getLogger(__name__)
+
+
+class AutoscalerDecisionOperator(enum.Enum):
+    SCALE_UP = 'scale_up'
+    SCALE_DOWN = 'scale_down'
+
+
+@dataclasses.dataclass
+class AutoscalerDecision:
+    """(reference: AutoscalerDecision, autoscalers.py:22-55)
+
+    target: for SCALE_UP, an override dict applied to the replica's
+    resources (e.g. {'use_spot': True}); for SCALE_DOWN, the replica id.
+    """
+    operator: AutoscalerDecisionOperator
+    target: Any
+
+
+class Autoscaler:
+    """Base: tracks the spec; emits decisions from replica info."""
+
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+        self.min_replicas = spec.min_replicas
+        self.max_replicas = (spec.max_replicas
+                             if spec.max_replicas is not None
+                             else spec.min_replicas)
+        self.target_qps_per_replica = spec.target_qps_per_replica
+
+    def update_spec(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+        self.min_replicas = spec.min_replicas
+        self.max_replicas = (spec.max_replicas
+                             if spec.max_replicas is not None
+                             else spec.min_replicas)
+        self.target_qps_per_replica = spec.target_qps_per_replica
+
+    def collect_request_information(
+            self, request_timestamps: List[float]) -> None:
+        raise NotImplementedError
+
+    def evaluate_scaling(
+        self,
+        replica_infos: List['replica_managers.ReplicaInfo'],
+    ) -> List[AutoscalerDecision]:
+        raise NotImplementedError
+
+
+class RequestRateAutoscaler(Autoscaler):
+    """target_replicas = ceil(qps / target_qps_per_replica), bounded to
+    [min, max], applied only after the target has held steadily for the
+    upscale/downscale delay (reference: autoscalers.py:141-474)."""
+
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+        super().__init__(spec)
+        self.request_timestamps: List[float] = []
+        upscale_delay = (spec.upscale_delay_seconds
+                         if spec.upscale_delay_seconds is not None
+                         else constants.upscale_delay_seconds())
+        downscale_delay = (spec.downscale_delay_seconds
+                           if spec.downscale_delay_seconds is not None
+                           else constants.downscale_delay_seconds())
+        interval = constants.autoscaler_decision_interval_seconds()
+        # Delays are enforced as N consecutive decisions holding the same
+        # direction (reference: scale_up_consecutive_periods, :200-220).
+        self.scale_up_threshold = max(1, int(upscale_delay / interval))
+        self.scale_down_threshold = max(1, int(downscale_delay / interval))
+        self.upscale_counter = 0
+        self.downscale_counter = 0
+        self.latest_version: int = 1
+
+    # ---------------- inputs ----------------
+
+    def collect_request_information(
+            self, request_timestamps: List[float]) -> None:
+        """Feed LB-reported request arrival times; trims to the QPS window
+        (reference: collect_request_information, :230)."""
+        self.request_timestamps.extend(request_timestamps)
+        cutoff = time.time() - constants.qps_window_size_seconds()
+        # Timestamps arrive roughly ordered; drop the stale prefix.
+        index = 0
+        for index, ts in enumerate(self.request_timestamps):
+            if ts >= cutoff:
+                break
+        else:
+            index = len(self.request_timestamps)
+        del self.request_timestamps[:index]
+
+    def _qps(self) -> float:
+        window = constants.qps_window_size_seconds()
+        cutoff = time.time() - window
+        live = [t for t in self.request_timestamps if t >= cutoff]
+        return len(live) / window
+
+    # ---------------- decisions ----------------
+
+    def _target_from_qps(self) -> int:
+        if self.target_qps_per_replica is None:
+            return self.min_replicas
+        raw = math.ceil(self._qps() / self.target_qps_per_replica)
+        return max(self.min_replicas, min(self.max_replicas, raw))
+
+    def _stable_target(self, current: int, desired: int) -> int:
+        """Hysteresis: only move once the direction has held long enough
+        (reference: :330-400)."""
+        if desired > current:
+            self.upscale_counter += 1
+            self.downscale_counter = 0
+            if self.upscale_counter >= self.scale_up_threshold:
+                self.upscale_counter = 0
+                return desired
+        elif desired < current:
+            self.downscale_counter += 1
+            self.upscale_counter = 0
+            if self.downscale_counter >= self.scale_down_threshold:
+                self.downscale_counter = 0
+                return desired
+        else:
+            self.upscale_counter = 0
+            self.downscale_counter = 0
+        return current
+
+    def _replica_overrides(self) -> Dict[str, Any]:
+        """Resource overrides for newly launched replicas; subclasses use
+        this for spot/on-demand mixing."""
+        return {}
+
+    def _select_scale_down(
+        self,
+        infos: List['replica_managers.ReplicaInfo'],
+        count: int,
+    ) -> List[int]:
+        """Least-useful-first: old-version replicas, then by FSM order
+        (PENDING before READY), reference: _select_replicas_to_scale_down."""
+        order = {
+            status: i for i, status in enumerate(
+                serve_state.ReplicaStatus.scale_down_decision_order())
+        }
+
+        def key(info):
+            # Old versions first; within a version, least-useful first
+            # (PENDING before READY — ascending FSM order).
+            return (info.version, order.get(info.status, -1))
+
+        ranked = sorted(infos, key=key)
+        return [info.replica_id for info in ranked[:count]]
+
+    def evaluate_scaling(
+        self,
+        replica_infos: List['replica_managers.ReplicaInfo'],
+    ) -> List[AutoscalerDecision]:
+        alive = [i for i in replica_infos if i.status.counts_toward_fleet()]
+        current = len(alive)
+        desired = self._stable_target(current, self._target_from_qps())
+        decisions: List[AutoscalerDecision] = []
+        if desired > current:
+            for _ in range(desired - current):
+                decisions.append(
+                    AutoscalerDecision(AutoscalerDecisionOperator.SCALE_UP,
+                                       dict(self._replica_overrides())))
+        elif desired < current:
+            for replica_id in self._select_scale_down(
+                    alive, current - desired):
+                decisions.append(
+                    AutoscalerDecision(
+                        AutoscalerDecisionOperator.SCALE_DOWN, replica_id))
+        return decisions
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """Spot replicas with on-demand fallback (reference:
+    autoscalers.py:476-634):
+
+    - `base_ondemand_fallback_replicas` on-demand replicas always run.
+    - With `dynamic_ondemand_fallback`, every spot replica that is not yet
+      READY is temporarily covered by an extra on-demand replica, torn
+      down once the spot replica becomes ready.
+    """
+
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+        super().__init__(spec)
+        self.base_ondemand = spec.base_ondemand_fallback_replicas or 0
+        self.dynamic_fallback = bool(spec.dynamic_ondemand_fallback)
+
+    def update_spec(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+        super().update_spec(spec)
+        self.base_ondemand = spec.base_ondemand_fallback_replicas or 0
+        self.dynamic_fallback = bool(spec.dynamic_ondemand_fallback)
+
+    def _replica_overrides(self) -> Dict[str, Any]:
+        return {'use_spot': True}
+
+    def evaluate_scaling(
+        self,
+        replica_infos: List['replica_managers.ReplicaInfo'],
+    ) -> List[AutoscalerDecision]:
+        alive = [i for i in replica_infos if i.status.counts_toward_fleet()]
+        spot = [i for i in alive if i.is_spot]
+        ondemand = [i for i in alive if not i.is_spot]
+
+        decisions: List[AutoscalerDecision] = []
+
+        # 1. Spot fleet follows the request rate.
+        desired_spot = self._stable_target(len(spot),
+                                           self._target_from_qps())
+        if desired_spot > len(spot):
+            for _ in range(desired_spot - len(spot)):
+                decisions.append(
+                    AutoscalerDecision(AutoscalerDecisionOperator.SCALE_UP,
+                                       {'use_spot': True}))
+        elif desired_spot < len(spot):
+            for replica_id in self._select_scale_down(
+                    spot, len(spot) - desired_spot):
+                decisions.append(
+                    AutoscalerDecision(
+                        AutoscalerDecisionOperator.SCALE_DOWN, replica_id))
+
+        # 2. On-demand = base + (dynamic cover for each not-ready spot).
+        desired_ondemand = self.base_ondemand
+        if self.dynamic_fallback:
+            spot_not_ready = sum(
+                1 for i in spot
+                if i.status != serve_state.ReplicaStatus.READY)
+            headroom = max(0, desired_spot - (len(spot) - spot_not_ready))
+            desired_ondemand += min(headroom, spot_not_ready +
+                                    max(0, desired_spot - len(spot)))
+        if desired_ondemand > len(ondemand):
+            for _ in range(desired_ondemand - len(ondemand)):
+                decisions.append(
+                    AutoscalerDecision(AutoscalerDecisionOperator.SCALE_UP,
+                                       {'use_spot': False}))
+        elif desired_ondemand < len(ondemand):
+            for replica_id in self._select_scale_down(
+                    ondemand, len(ondemand) - desired_ondemand):
+                decisions.append(
+                    AutoscalerDecision(
+                        AutoscalerDecisionOperator.SCALE_DOWN, replica_id))
+        return decisions
+
+
+def make_autoscaler(spec: 'spec_lib.SkyServiceSpec') -> Autoscaler:
+    if spec.use_ondemand_fallback:
+        return FallbackRequestRateAutoscaler(spec)
+    return RequestRateAutoscaler(spec)

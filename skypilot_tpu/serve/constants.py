@@ -1,0 +1,70 @@
+"""Serve timing/naming constants.
+
+Reference parity: sky/serve/constants.py (23-60) — 60s QPS window, 20s
+autoscaler decision interval (5s when zero replicas), 300s upscale / 1200s
+downscale hysteresis, 20s LB↔controller sync, 10s probe interval, 15s
+probe timeout. All env-overridable so hermetic tests can run the full
+scale-up/probe/failover loop in seconds.
+"""
+from __future__ import annotations
+
+import os
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def qps_window_size_seconds() -> float:
+    return _env_float('SKYTPU_SERVE_QPS_WINDOW', 60.0)
+
+
+def autoscaler_decision_interval_seconds() -> float:
+    return _env_float('SKYTPU_SERVE_DECISION_INTERVAL', 20.0)
+
+
+def autoscaler_no_replica_decision_interval_seconds() -> float:
+    return _env_float('SKYTPU_SERVE_NO_REPLICA_INTERVAL', 5.0)
+
+
+def upscale_delay_seconds() -> float:
+    return _env_float('SKYTPU_SERVE_UPSCALE_DELAY', 300.0)
+
+
+def downscale_delay_seconds() -> float:
+    return _env_float('SKYTPU_SERVE_DOWNSCALE_DELAY', 1200.0)
+
+
+def lb_controller_sync_interval_seconds() -> float:
+    return _env_float('SKYTPU_SERVE_LB_SYNC_INTERVAL', 20.0)
+
+
+def probe_interval_seconds() -> float:
+    return _env_float('SKYTPU_SERVE_PROBE_INTERVAL', 10.0)
+
+
+def probe_timeout_seconds() -> float:
+    return _env_float('SKYTPU_SERVE_PROBE_TIMEOUT', 15.0)
+
+
+# Consecutive failed readiness probes before a replica is considered
+# unhealthy (after it has first turned READY).
+PROBE_FAILURE_THRESHOLD = 3
+
+CONTROLLER_HOST = '127.0.0.1'
+
+
+def serve_home() -> str:
+    from skypilot_tpu.agent import constants as agent_constants
+    return os.path.join(agent_constants.agent_home(), 'serve')
+
+
+def service_dir(service_name: str) -> str:
+    return os.path.join(serve_home(), service_name)
+
+
+def replica_cluster_name(service_name: str, replica_id: int) -> str:
+    return f'{service_name}-replica-{replica_id}'

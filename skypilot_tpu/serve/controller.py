@@ -1,0 +1,183 @@
+"""Serve controller: autoscaler loop + REST for load-balancer sync.
+
+Reference parity: sky/serve/controller.py (165 LoC) —
+`SkyServeController`: web app with an autoscaler loop thread
+(controller.py:54-87) and REST endpoints the LB polls
+(`/controller/load_balancer_sync`) plus replica-info debug endpoints.
+Implemented on aiohttp (fastapi/uvicorn are not in the image; aiohttp
+handles streaming just as well).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+import typing
+from typing import List, Optional
+
+from aiohttp import web
+
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import serve_state
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+logger = logging.getLogger(__name__)
+
+
+class SkyServeController:
+    """One controller per service (reference: SkyServeController,
+    controller.py:33)."""
+
+    def __init__(self, service_name: str, spec: 'spec_lib.SkyServiceSpec',
+                 task: 'task_lib.Task', port: int) -> None:
+        self.service_name = service_name
+        self.port = port
+        self.replica_manager = replica_managers.SkyPilotReplicaManager(
+            service_name, spec, task)
+        self.autoscaler = autoscalers.make_autoscaler(spec)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # ---------------- loops ----------------
+
+    def _autoscaler_loop(self) -> None:
+        """(reference: _run_autoscaler, controller.py:54-87)"""
+        while not self._stop.is_set():
+            try:
+                infos = self.replica_manager.get_replica_infos()
+                decisions = self.autoscaler.evaluate_scaling(infos)
+                for decision in decisions:
+                    if decision.operator == \
+                            autoscalers.AutoscalerDecisionOperator.SCALE_UP:
+                        self.replica_manager.scale_up(decision.target)
+                    else:
+                        self.replica_manager.scale_down(decision.target)
+                self._update_service_status()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('autoscaler tick failed')
+            interval = (
+                constants.autoscaler_decision_interval_seconds()
+                if self.replica_manager.get_replica_infos() else
+                constants.autoscaler_no_replica_decision_interval_seconds())
+            self._stop.wait(interval)
+
+    def _prober_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.replica_manager.probe_all_replicas()
+                self._update_service_status()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('probe sweep failed')
+            self._stop.wait(constants.probe_interval_seconds())
+
+    def _update_service_status(self) -> None:
+        statuses = [
+            i.status for i in self.replica_manager.get_replica_infos()
+        ]
+        serve_state.set_service_status(
+            self.service_name,
+            serve_state.ServiceStatus.from_replica_statuses(statuses))
+
+    # ---------------- REST ----------------
+
+    async def _handle_lb_sync(self, request: web.Request) -> web.Response:
+        """LB posts observed request timestamps; controller returns the
+        ready replica list (reference: controller.py REST +
+        load_balancer_sync)."""
+        data = await request.json()
+        timestamps = data.get('request_timestamps', [])
+        self.autoscaler.collect_request_information(timestamps)
+        return web.json_response({
+            'ready_replica_urls':
+                self.replica_manager.get_ready_replica_urls()
+        })
+
+    async def _handle_replica_info(self,
+                                   request: web.Request) -> web.Response:
+        del request
+        return web.json_response({
+            'replicas': [
+                i.to_info_dict()
+                for i in self.replica_manager.get_replica_infos()
+            ]
+        })
+
+    async def _handle_health(self, request: web.Request) -> web.Response:
+        del request
+        return web.json_response({'status': 'ok'})
+
+    def _make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post('/controller/load_balancer_sync',
+                            self._handle_lb_sync)
+        app.router.add_get('/controller/replica_info',
+                           self._handle_replica_info)
+        app.router.add_get('/controller/health', self._handle_health)
+        return app
+
+    # ---------------- lifecycle ----------------
+
+    def run(self) -> None:
+        """Blocks serving REST; loops run as daemon threads."""
+        for target in (self._autoscaler_loop, self._prober_loop):
+            thread = threading.Thread(target=target, daemon=True)
+            thread.start()
+            self._threads.append(thread)
+        web.run_app(self._make_app(),
+                    host=constants.CONTROLLER_HOST,
+                    port=self.port,
+                    print=None,
+                    handle_signals=False)
+
+    def start_in_thread(self) -> threading.Thread:
+        """For tests / the service entrypoint: run the REST app on a
+        background event loop."""
+        for target in (self._autoscaler_loop, self._prober_loop):
+            thread = threading.Thread(target=target, daemon=True)
+            thread.start()
+            self._threads.append(thread)
+
+        def _serve() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            runner = web.AppRunner(self._make_app())
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, constants.CONTROLLER_HOST, self.port)
+            loop.run_until_complete(site.start())
+            try:
+                loop.run_forever()
+            finally:
+                loop.run_until_complete(runner.cleanup())
+                loop.close()
+
+        thread = threading.Thread(target=_serve, daemon=True)
+        thread.start()
+        self._threads.append(thread)
+        return thread
+
+    def stop(self, terminate_replicas: bool = True,
+             timeout: float = 60.0) -> None:
+        self._stop.set()
+        if terminate_replicas:
+            for info in self.replica_manager.get_replica_infos():
+                self.replica_manager.scale_down(info.replica_id, purge=True)
+            self.replica_manager.join(timeout)
+
+    def wait_port_ready(self, timeout: float = 10.0) -> bool:
+        import socket
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with socket.socket() as sock:
+                sock.settimeout(0.5)
+                try:
+                    sock.connect((constants.CONTROLLER_HOST, self.port))
+                    return True
+                except OSError:
+                    time.sleep(0.1)
+        return False

@@ -1,0 +1,161 @@
+"""Load balancer: async reverse proxy in front of the replica fleet.
+
+Reference parity: sky/serve/load_balancer.py (245 LoC) — FastAPI/httpx
+reverse proxy syncing its ready-replica list from the controller every
+LB_CONTROLLER_SYNC_INTERVAL_SECONDS and reporting observed request
+timestamps (the autoscaler's input signal). Implemented on aiohttp, which
+natively streams chunked responses — the hot path for LLM token streaming.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from typing import List, Optional
+
+import aiohttp
+from aiohttp import web
+
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve import load_balancing_policies as policies
+
+logger = logging.getLogger(__name__)
+
+_HOP_HEADERS = {
+    'connection', 'keep-alive', 'proxy-authenticate',
+    'proxy-authorization', 'te', 'trailers', 'transfer-encoding', 'upgrade',
+    'host', 'content-length',
+}
+
+
+class SkyServeLoadBalancer:
+    """(reference: SkyServeLoadBalancer, load_balancer.py:22)"""
+
+    def __init__(self, controller_url: str, port: int,
+                 policy_name: str = 'round_robin') -> None:
+        self.controller_url = controller_url.rstrip('/')
+        self.port = port
+        self.policy: policies.LoadBalancingPolicy = \
+            policies.POLICIES[policy_name]()
+        self.request_timestamps: List[float] = []
+        self._ts_lock = threading.Lock()
+        self._stop = asyncio.Event()
+        self._upstream_session: Optional[aiohttp.ClientSession] = None
+
+    def _session(self) -> aiohttp.ClientSession:
+        """One long-lived session → keep-alive connection reuse on the hot
+        token-streaming path (must be created inside the serving loop)."""
+        if self._upstream_session is None or \
+                self._upstream_session.closed:
+            self._upstream_session = aiohttp.ClientSession(
+                auto_decompress=False)
+        return self._upstream_session
+
+    # ---------------- controller sync ----------------
+
+    async def _sync_with_controller_once(
+            self, session: aiohttp.ClientSession) -> None:
+        with self._ts_lock:
+            timestamps, self.request_timestamps = \
+                self.request_timestamps, []
+        try:
+            async with session.post(
+                    self.controller_url + '/controller/load_balancer_sync',
+                    json={'request_timestamps': timestamps},
+                    timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                data = await resp.json()
+                self.policy.set_ready_replicas(
+                    data.get('ready_replica_urls', []))
+        except Exception as e:  # pylint: disable=broad-except
+            # Keep serving with the last-known replica list; re-queue the
+            # timestamps so the QPS signal is not lost.
+            with self._ts_lock:
+                self.request_timestamps = \
+                    timestamps + self.request_timestamps
+            logger.warning('LB↔controller sync failed: %s', e)
+
+    async def _sync_loop(self) -> None:
+        async with aiohttp.ClientSession() as session:
+            while not self._stop.is_set():
+                await self._sync_with_controller_once(session)
+                try:
+                    await asyncio.wait_for(
+                        self._stop.wait(),
+                        constants.lb_controller_sync_interval_seconds())
+                except asyncio.TimeoutError:
+                    pass
+
+    # ---------------- proxy ----------------
+
+    async def _proxy(self, request: web.Request) -> web.StreamResponse:
+        with self._ts_lock:
+            self.request_timestamps.append(time.time())
+        replica_url = self.policy.select_replica()
+        if replica_url is None:
+            return web.Response(
+                status=503,
+                text='No ready replicas. The service may be starting or '
+                     'scaled to zero; retry shortly.')
+        target = replica_url + str(request.rel_url)
+        headers = {
+            k: v for k, v in request.headers.items()
+            if k.lower() not in _HOP_HEADERS
+        }
+        body = await request.read()
+        try:
+            async with self._session().request(
+                    request.method, target, headers=headers,
+                    data=body if body else None,
+                    timeout=aiohttp.ClientTimeout(
+                        total=None, sock_connect=10)) as upstream:
+                response = web.StreamResponse(
+                    status=upstream.status,
+                    headers={
+                        k: v for k, v in upstream.headers.items()
+                        if k.lower() not in _HOP_HEADERS
+                    })
+                await response.prepare(request)
+                # Chunked relay — token streams flow through unbuffered.
+                async for chunk in upstream.content.iter_any():
+                    await response.write(chunk)
+                await response.write_eof()
+                return response
+        except aiohttp.ClientError as e:
+            return web.Response(status=502,
+                                text=f'Upstream replica error: {e}')
+
+    # ---------------- lifecycle ----------------
+
+    def _make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_route('*', '/{path:.*}', self._proxy)
+        return app
+
+    def run(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._stop = asyncio.Event()
+        loop.create_task(self._sync_loop())
+        web.run_app(self._make_app(), host='0.0.0.0', port=self.port,
+                    print=None, handle_signals=False, loop=loop)
+
+    def start_in_thread(self) -> threading.Thread:
+        def _serve() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._stop = asyncio.Event()
+            runner = web.AppRunner(self._make_app())
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, '0.0.0.0', self.port)
+            loop.run_until_complete(site.start())
+            loop.create_task(self._sync_loop())
+            try:
+                loop.run_forever()
+            finally:
+                loop.run_until_complete(runner.cleanup())
+                loop.close()
+
+        thread = threading.Thread(target=_serve, daemon=True)
+        thread.start()
+        return thread

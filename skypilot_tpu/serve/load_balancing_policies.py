@@ -1,0 +1,52 @@
+"""Load-balancing policies.
+
+Reference parity: sky/serve/load_balancing_policies.py (70 LoC) —
+`RoundRobinPolicy` (:47).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+
+class LoadBalancingPolicy:
+
+    def __init__(self) -> None:
+        self.ready_replica_urls: List[str] = []
+        self._lock = threading.Lock()
+
+    def set_ready_replicas(self, urls: List[str]) -> None:
+        raise NotImplementedError
+
+    def select_replica(self) -> Optional[str]:
+        raise NotImplementedError
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+    """(reference: RoundRobinPolicy, load_balancing_policies.py:47)"""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.index = 0
+
+    def set_ready_replicas(self, urls: List[str]) -> None:
+        with self._lock:
+            if set(urls) != set(self.ready_replica_urls):
+                # Reset rotation on membership change so a fresh replica
+                # is not skipped a whole cycle.
+                self.index = 0
+            self.ready_replica_urls = list(urls)
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_replica_urls:
+                return None
+            url = self.ready_replica_urls[self.index %
+                                          len(self.ready_replica_urls)]
+            self.index = (self.index + 1) % len(self.ready_replica_urls)
+            return url
+
+
+POLICIES = {
+    'round_robin': RoundRobinPolicy,
+}

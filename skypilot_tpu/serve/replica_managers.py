@@ -1,0 +1,374 @@
+"""Replica manager: launches, probes, and retires replica slices.
+
+Reference parity: sky/serve/replica_managers.py (1,233 LoC) —
+`launch_cluster` via sky.launch with retries (replica_managers.py:57),
+`SkyPilotReplicaManager` with a pool of launch/down workers (:604-958),
+readiness probing of every replica (`probe:487`, `_probe_all_replicas:1019`),
+preemption handling (:775), version updates (:1165).
+
+Each replica is one TPU slice cluster running the service task. The
+launch/down workers are threads (launches are I/O-bound; the reference
+uses a process pool only because of Ray's fork-safety constraints).
+
+Port contract: the manager exports SKYTPU_REPLICA_ID and
+SKYTPU_REPLICA_PORT to the replica task. On real clouds every replica has
+its own host, so SKYTPU_REPLICA_PORT is simply the task's declared port.
+With SKYTPU_SERVE_PORT_OFFSET_BY_REPLICA=1 (fake/local clouds, where all
+"hosts" share one machine) the port is offset by replica id — which is
+what makes multi-replica serving hermetically testable.
+"""
+from __future__ import annotations
+
+import copy
+import logging
+import os
+import threading
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.status_lib import ClusterStatus
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_REPLICA_PORT = 8080
+
+
+class ReplicaInfo:
+    """Everything the controller knows about one replica (reference:
+    ReplicaInfo, replica_managers.py:170)."""
+
+    def __init__(self, replica_id: int, cluster_name: str, version: int,
+                 is_spot: bool) -> None:
+        self.replica_id = replica_id
+        self.cluster_name = cluster_name
+        self.version = version
+        self.is_spot = is_spot
+        self.status = ReplicaStatus.PENDING
+        self.first_ready_time: Optional[float] = None
+        self.consecutive_failure_count = 0
+        self.launched_at = time.time()
+        self.failure_reason: Optional[str] = None
+        self.port: Optional[int] = None
+        self.ip: Optional[str] = None
+
+    @property
+    def url(self) -> Optional[str]:
+        if self.ip is None or self.port is None:
+            return None
+        return f'http://{self.ip}:{self.port}'
+
+    def to_info_dict(self) -> Dict[str, Any]:
+        return {
+            'replica_id': self.replica_id,
+            'cluster_name': self.cluster_name,
+            'version': self.version,
+            'is_spot': self.is_spot,
+            'status': self.status.value,
+            'url': self.url,
+            'launched_at': self.launched_at,
+            'first_ready_time': self.first_ready_time,
+            'failure_reason': self.failure_reason,
+        }
+
+    def __repr__(self) -> str:
+        return (f'ReplicaInfo({self.replica_id}, {self.cluster_name}, '
+                f'v{self.version}, {self.status.value})')
+
+
+def _port_for_replica(base_port: int, replica_id: int) -> int:
+    if os.environ.get('SKYTPU_SERVE_PORT_OFFSET_BY_REPLICA') == '1':
+        return base_port + replica_id
+    return base_port
+
+
+class SkyPilotReplicaManager:
+    """Owns the replica fleet of one service (reference:
+    SkyPilotReplicaManager, replica_managers.py:604)."""
+
+    def __init__(self, service_name: str, spec: 'spec_lib.SkyServiceSpec',
+                 task: 'task_lib.Task', version: int = 1) -> None:
+        self.service_name = service_name
+        self.spec = spec
+        self.task = task
+        self.version = version
+        self.lock = threading.RLock()
+        self.replicas: Dict[int, ReplicaInfo] = {}
+        self._next_replica_id = 1
+        self._threads: List[threading.Thread] = []
+        base_port = _DEFAULT_REPLICA_PORT
+        ports = None
+        for resources in task.resources:
+            ports = resources.ports
+            break
+        if ports:
+            base_port = int(str(ports[0]).split('-', maxsplit=1)[0])
+        self._base_port = base_port
+
+    # ---------------- scaling entry points ----------------
+
+    def scale_up(self,
+                 resources_override: Optional[Dict[str, Any]] = None
+                 ) -> int:
+        """Async: spawns a launch worker; returns the new replica id
+        (reference: scale_up → _launch_replica, replica_managers.py:671)."""
+        with self.lock:
+            replica_id = self._next_replica_id
+            self._next_replica_id += 1
+            cluster_name = constants.replica_cluster_name(
+                self.service_name, replica_id)
+            is_spot = bool((resources_override or {}).get('use_spot'))
+            if not is_spot:
+                is_spot = any(r.use_spot for r in self.task.resources)
+            info = ReplicaInfo(replica_id, cluster_name, self.version,
+                               is_spot)
+            self.replicas[replica_id] = info
+            self._persist(info)
+        self._spawn(self._launch_replica, replica_id,
+                    resources_override or {})
+        return replica_id
+
+    def scale_down(self, replica_id: int, purge: bool = False) -> None:
+        """Async teardown (reference: scale_down → _terminate_replica,
+        replica_managers.py:720)."""
+        with self.lock:
+            info = self.replicas.get(replica_id)
+            if info is None:
+                return
+            info.status = ReplicaStatus.SHUTTING_DOWN
+            self._persist(info)
+        self._spawn(self._terminate_replica, replica_id, purge)
+
+    def _spawn(self, target, *args) -> None:
+        thread = threading.Thread(target=target, args=args, daemon=True)
+        thread.start()
+        with self.lock:
+            # Prune finished workers so long-lived services with scaling
+            # churn don't accumulate dead Thread objects.
+            self._threads = [t for t in self._threads if t.is_alive()]
+            self._threads.append(thread)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.time() + timeout
+        with self.lock:
+            threads = list(self._threads)
+        for thread in threads:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.time()))
+            thread.join(remaining)
+
+    # ---------------- workers ----------------
+
+    def _replica_task(self, replica_id: int,
+                      resources_override: Dict[str, Any]
+                      ) -> 'task_lib.Task':
+        task = copy.copy(self.task)
+        port = _port_for_replica(self._base_port, replica_id)
+        task.update_envs({
+            'SKYTPU_REPLICA_ID': str(replica_id),
+            'SKYTPU_REPLICA_PORT': str(port),
+            'SKYTPU_SERVICE_NAME': self.service_name,
+        })
+        if resources_override:
+            task.set_resources({
+                r.copy(**resources_override) for r in self.task.resources
+            })
+        return task
+
+    def _launch_replica(self, replica_id: int,
+                        resources_override: Dict[str, Any]) -> None:
+        from skypilot_tpu import execution
+        info = self.replicas[replica_id]
+        info.status = ReplicaStatus.PROVISIONING
+        self._persist(info)
+        task = self._replica_task(replica_id, resources_override)
+        try:
+            job_id, handle = execution.launch(
+                task,
+                cluster_name=info.cluster_name,
+                detach_run=True,
+                stream_logs=False,
+                quiet_optimizer=True)
+            assert job_id is not None
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('Replica %d launch failed: %s', replica_id, e)
+            with self.lock:
+                info.status = ReplicaStatus.FAILED_PROVISION
+                info.failure_reason = str(e)
+                self._persist(info)
+            return
+        with self.lock:
+            current = self.replicas.get(replica_id)
+            if current is None or \
+                    current.status == ReplicaStatus.SHUTTING_DOWN:
+                # Scaled down while we were provisioning: the terminate
+                # worker may have run before the cluster existed, so the
+                # fresh slice is ours to delete.
+                launched_while_dying = True
+            else:
+                launched_while_dying = False
+                info.ip = handle.head_ip
+                info.port = _port_for_replica(self._base_port, replica_id)
+                info.status = ReplicaStatus.STARTING
+                self._persist(info)
+        if launched_while_dying:
+            self._terminate_replica(replica_id, purge=True)
+
+    def _terminate_replica(self, replica_id: int, purge: bool) -> None:
+        from skypilot_tpu import core
+        # Deterministic name: works even if the in-memory record is
+        # already gone (terminate racing a late launch worker).
+        cluster_name = constants.replica_cluster_name(
+            self.service_name, replica_id)
+        try:
+            if global_user_state.get_cluster_from_name(
+                    cluster_name) is not None:
+                core.down(cluster_name, purge=True)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('Replica %d teardown failed: %s', replica_id, e)
+            if not purge:
+                with self.lock:
+                    info = self.replicas.get(replica_id)
+                    if info is not None:
+                        info.status = ReplicaStatus.FAILED_CLEANUP
+                        info.failure_reason = str(e)
+                        self._persist(info)
+                return
+        with self.lock:
+            self.replicas.pop(replica_id, None)
+            serve_state.remove_replica(self.service_name, replica_id)
+
+    # ---------------- probing ----------------
+
+    def _probe_one(self, info: ReplicaInfo) -> bool:
+        """HTTP readiness probe (reference: probe, replica_managers.py:487).
+        Returns readiness."""
+        url = info.url
+        if url is None:
+            return False
+        probe_url = url + self.spec.readiness_path
+        try:
+            if self.spec.post_data is not None:
+                resp = requests.post(
+                    probe_url,
+                    json=self.spec.post_data,
+                    headers=self.spec.readiness_headers,
+                    timeout=constants.probe_timeout_seconds())
+            else:
+                resp = requests.get(
+                    probe_url,
+                    headers=self.spec.readiness_headers,
+                    timeout=constants.probe_timeout_seconds())
+            return resp.status_code == 200
+        except requests.RequestException:
+            return False
+
+    def _cluster_status(self, info: ReplicaInfo
+                        ) -> Optional[ClusterStatus]:
+        try:
+            status, _ = backend_utils.refresh_cluster_status_handle(
+                info.cluster_name, force_refresh=True)
+            return status
+        except Exception:  # pylint: disable=broad-except
+            return None
+
+    def probe_all_replicas(self) -> None:
+        """One probe sweep (reference: _probe_all_replicas,
+        replica_managers.py:1019): READY/NOT_READY transitions, initial
+        grace period, preemption detection, failure thresholds."""
+        with self.lock:
+            infos = [
+                i for i in self.replicas.values() if i.status in
+                (ReplicaStatus.STARTING, ReplicaStatus.READY,
+                 ReplicaStatus.NOT_READY)
+            ]
+        for info in infos:
+            ready = self._probe_one(info)
+            with self.lock:
+                if ready:
+                    if info.first_ready_time is None:
+                        info.first_ready_time = time.time()
+                    info.consecutive_failure_count = 0
+                    info.status = ReplicaStatus.READY
+                    self._persist(info)
+                    continue
+                # Not ready: distinguish still-starting / preempted /
+                # newly-unhealthy.
+                cluster_status = self._cluster_status(info)
+                if cluster_status != ClusterStatus.UP:
+                    # Preempted or partially dead slice (reference:
+                    # preemption handling, replica_managers.py:775).
+                    info.status = ReplicaStatus.PREEMPTED
+                    self._persist(info)
+                    self._handle_preemption(info.replica_id)
+                    continue
+                if info.first_ready_time is None:
+                    # Still in initial delay?
+                    elapsed = time.time() - info.launched_at
+                    if elapsed > self.spec.initial_delay_seconds:
+                        info.status = ReplicaStatus.FAILED_INITIAL_DELAY
+                        info.failure_reason = (
+                            f'Replica did not become ready within '
+                            f'initial_delay_seconds='
+                            f'{self.spec.initial_delay_seconds}.')
+                        self._persist(info)
+                        self.scale_down(info.replica_id)
+                    continue
+                info.consecutive_failure_count += 1
+                if info.consecutive_failure_count >= \
+                        constants.PROBE_FAILURE_THRESHOLD:
+                    info.status = ReplicaStatus.FAILED_PROBING
+                    info.failure_reason = 'Readiness probe kept failing.'
+                    self._persist(info)
+                    self.scale_down(info.replica_id)
+                else:
+                    info.status = ReplicaStatus.NOT_READY
+                    self._persist(info)
+
+    def _handle_preemption(self, replica_id: int) -> None:
+        """Preempted slices are deleted and replaced (TPU slices cannot
+        restart in place; the autoscaler sees the fleet shrink and scales
+        back up on its next tick)."""
+        self.scale_down(replica_id, purge=True)
+
+    # ---------------- views / persistence ----------------
+
+    def _persist(self, info: ReplicaInfo) -> None:
+        serve_state.add_or_update_replica(self.service_name,
+                                          info.replica_id, info)
+
+    def get_replica_infos(self) -> List[ReplicaInfo]:
+        with self.lock:
+            return list(self.replicas.values())
+
+    def get_ready_replica_urls(self) -> List[str]:
+        with self.lock:
+            return [
+                i.url for i in self.replicas.values()
+                if i.status == ReplicaStatus.READY and i.url is not None
+            ]
+
+    # ---------------- version updates ----------------
+
+    def update_version(self, version: int, spec: 'spec_lib.SkyServiceSpec',
+                       task: 'task_lib.Task') -> None:
+        """Blue-green-ish rollout (reference: update flow,
+        replica_managers.py:1165): new launches use the new version; the
+        autoscaler's scale-down ordering retires old-version replicas
+        first once new ones are READY."""
+        with self.lock:
+            self.version = version
+            self.spec = spec
+            self.task = task

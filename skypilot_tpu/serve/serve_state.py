@@ -1,0 +1,266 @@
+"""Serve-side state: services, replicas, versions + the two FSMs.
+
+Reference parity: sky/serve/serve_state.py (536 LoC) — sqlite `services`,
+`replicas` (pickled ReplicaInfo), `version_specs` tables
+(serve_state.py:31-58); `ReplicaStatus` FSM (:75); `ServiceStatus` (:190).
+"""
+from __future__ import annotations
+
+import enum
+import os
+import pickle
+import sqlite3
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.serve import constants
+from skypilot_tpu.utils import db_utils
+
+
+class ReplicaStatus(enum.Enum):
+    """FSM of one replica (reference: serve_state.py:75)."""
+    PENDING = 'PENDING'
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'          # cluster UP, job running, not ready yet
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'        # probe failing, not yet past threshold
+    FAILED = 'FAILED'
+    FAILED_INITIAL_DELAY = 'FAILED_INITIAL_DELAY'
+    FAILED_PROBING = 'FAILED_PROBING'
+    FAILED_PROVISION = 'FAILED_PROVISION'
+    FAILED_CLEANUP = 'FAILED_CLEANUP'
+    PREEMPTED = 'PREEMPTED'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+
+    def is_failed(self) -> bool:
+        return self.value.startswith('FAILED')
+
+    def is_terminal(self) -> bool:
+        return self.is_failed()
+
+    def counts_toward_fleet(self) -> bool:
+        """Whether the autoscaler should count this replica when sizing
+        the fleet: dying (SHUTTING_DOWN/PREEMPTED) and failed replicas do
+        NOT count, so their replacements launch immediately rather than
+        after the (minutes-long) slice teardown completes."""
+        return self in (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
+                        ReplicaStatus.STARTING, ReplicaStatus.READY,
+                        ReplicaStatus.NOT_READY)
+
+    @classmethod
+    def scale_down_decision_order(cls) -> List['ReplicaStatus']:
+        """Which replicas to kill first when scaling down (least useful
+        first; reference: replica_managers scale-down ordering)."""
+        return [
+            cls.PENDING, cls.PROVISIONING, cls.STARTING, cls.NOT_READY,
+            cls.READY
+        ]
+
+
+class ServiceStatus(enum.Enum):
+    """FSM of the whole service (reference: serve_state.py:190)."""
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'    # no ready replicas yet, some starting
+    CONTROLLER_FAILED = 'CONTROLLER_FAILED'
+    READY = 'READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    FAILED_CLEANUP = 'FAILED_CLEANUP'
+    NO_REPLICA = 'NO_REPLICA'
+
+    @classmethod
+    def from_replica_statuses(
+            cls, statuses: List[ReplicaStatus]) -> 'ServiceStatus':
+        if any(s == ReplicaStatus.READY for s in statuses):
+            return cls.READY
+        if any(s in (ReplicaStatus.PROVISIONING, ReplicaStatus.STARTING,
+                     ReplicaStatus.PENDING) for s in statuses):
+            return cls.REPLICA_INIT
+        if any(s.is_failed() for s in statuses):
+            return cls.FAILED
+        return cls.NO_REPLICA
+
+
+def _create_table(cursor: sqlite3.Cursor, conn: sqlite3.Connection) -> None:
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS services (
+            name TEXT PRIMARY KEY,
+            controller_pid INTEGER,
+            controller_port INTEGER,
+            lb_port INTEGER,
+            status TEXT,
+            policy TEXT,
+            task_yaml_path TEXT,
+            current_version INTEGER DEFAULT 1)""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS replicas (
+            service_name TEXT,
+            replica_id INTEGER,
+            replica_info BLOB,
+            PRIMARY KEY (service_name, replica_id))""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS version_specs (
+            service_name TEXT,
+            version INTEGER,
+            spec BLOB,
+            PRIMARY KEY (service_name, version))""")
+    conn.commit()
+
+
+_db: Optional[db_utils.SQLiteConn] = None
+_db_path: Optional[str] = None
+
+
+def _get_db() -> db_utils.SQLiteConn:
+    global _db, _db_path
+    path = os.path.join(constants.serve_home(), 'services.db')
+    if _db is None or _db_path != path:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        _db = db_utils.SQLiteConn(path, _create_table)
+        _db_path = path
+    return _db
+
+
+# ---------------- services ----------------
+
+
+def add_service(name: str, policy: str, task_yaml_path: str) -> bool:
+    """Returns False if the service already exists."""
+    db = _get_db()
+    with db.cursor() as cursor:
+        try:
+            cursor.execute(
+                'INSERT INTO services '
+                '(name, status, policy, task_yaml_path) VALUES (?, ?, ?, ?)',
+                (name, ServiceStatus.CONTROLLER_INIT.value, policy,
+                 task_yaml_path))
+        except sqlite3.IntegrityError:
+            return False
+    return True
+
+
+def remove_service(name: str) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute('DELETE FROM services WHERE name = ?', (name,))
+        cursor.execute('DELETE FROM replicas WHERE service_name = ?',
+                       (name,))
+        cursor.execute('DELETE FROM version_specs WHERE service_name = ?',
+                       (name,))
+
+
+def set_service_status(name: str, status: ServiceStatus) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute('UPDATE services SET status = ? WHERE name = ?',
+                       (status.value, name))
+
+
+def set_service_controller(name: str, pid: int, controller_port: int,
+                           lb_port: int) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'UPDATE services SET controller_pid = ?, controller_port = ?, '
+            'lb_port = ? WHERE name = ?',
+            (pid, controller_port, lb_port, name))
+
+
+def set_service_version(name: str, version: int) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'UPDATE services SET current_version = ? WHERE name = ?',
+            (version, name))
+
+
+_SERVICE_COLS = ('name', 'controller_pid', 'controller_port', 'lb_port',
+                 'status', 'policy', 'task_yaml_path', 'current_version')
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    db = _get_db()
+    with db.cursor() as cursor:
+        row = cursor.execute(
+            f'SELECT {", ".join(_SERVICE_COLS)} FROM services '
+            'WHERE name = ?', (name,)).fetchone()
+    if row is None:
+        return None
+    rec = dict(zip(_SERVICE_COLS, row))
+    rec['status'] = ServiceStatus(rec['status'])
+    return rec
+
+
+def get_services() -> List[Dict[str, Any]]:
+    db = _get_db()
+    with db.cursor() as cursor:
+        rows = cursor.execute(
+            f'SELECT {", ".join(_SERVICE_COLS)} FROM services '
+            'ORDER BY name').fetchall()
+    records = []
+    for row in rows:
+        rec = dict(zip(_SERVICE_COLS, row))
+        rec['status'] = ServiceStatus(rec['status'])
+        records.append(rec)
+    return records
+
+
+# ---------------- replicas ----------------
+
+
+def add_or_update_replica(service_name: str, replica_id: int,
+                          replica_info: Any) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'INSERT OR REPLACE INTO replicas '
+            '(service_name, replica_id, replica_info) VALUES (?, ?, ?)',
+            (service_name, replica_id,
+             sqlite3.Binary(pickle.dumps(replica_info))))
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'DELETE FROM replicas WHERE service_name = ? '
+            'AND replica_id = ?', (service_name, replica_id))
+
+
+def get_replica_info(service_name: str,
+                     replica_id: int) -> Optional[Any]:
+    db = _get_db()
+    with db.cursor() as cursor:
+        row = cursor.execute(
+            'SELECT replica_info FROM replicas WHERE service_name = ? '
+            'AND replica_id = ?', (service_name, replica_id)).fetchone()
+    return pickle.loads(row[0]) if row else None
+
+
+def get_replica_infos(service_name: str) -> List[Any]:
+    db = _get_db()
+    with db.cursor() as cursor:
+        rows = cursor.execute(
+            'SELECT replica_info FROM replicas WHERE service_name = ? '
+            'ORDER BY replica_id', (service_name,)).fetchall()
+    return [pickle.loads(r[0]) for r in rows]
+
+
+# ---------------- version specs ----------------
+
+
+def add_version_spec(service_name: str, version: int, spec: Any) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'INSERT OR REPLACE INTO version_specs '
+            '(service_name, version, spec) VALUES (?, ?, ?)',
+            (service_name, version, sqlite3.Binary(pickle.dumps(spec))))
+
+
+def get_version_spec(service_name: str, version: int) -> Optional[Any]:
+    db = _get_db()
+    with db.cursor() as cursor:
+        row = cursor.execute(
+            'SELECT spec FROM version_specs WHERE service_name = ? '
+            'AND version = ?', (service_name, version)).fetchone()
+    return pickle.loads(row[0]) if row else None

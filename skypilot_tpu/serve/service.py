@@ -1,0 +1,109 @@
+"""Service bootstrap: one process running controller + load balancer.
+
+Reference parity: sky/serve/service.py (280 LoC) — on-controller bootstrap
+that starts the controller (autoscaler + replica manager) and the load
+balancer as separate processes (service.py:131-280) and cleans up replicas
+on exit (:86).
+
+Architectural deviation (matching jobs/controller.py): the reference runs
+this on a dedicated controller VM; here it is a detached local process per
+service. Controller REST and LB run on two ports of that process.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import time
+import traceback
+
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import serve_state
+
+logger = logging.getLogger(__name__)
+
+
+def _cleanup(controller: controller_lib.SkyServeController,
+             service_name: str) -> bool:
+    """Tear down every replica; returns success (reference: _cleanup,
+    service.py:86)."""
+    try:
+        controller.stop(terminate_replicas=True, timeout=300.0)
+        return True
+    except Exception:  # pylint: disable=broad-except
+        logger.error('Cleanup failed:\n%s', traceback.format_exc())
+        return False
+
+
+def run_service(service_name: str, task_yaml: str, controller_port: int,
+                lb_port: int) -> int:
+    task = task_lib.Task.from_yaml(task_yaml)
+    assert task.service is not None, 'Task has no service section.'
+    spec = task.service
+
+    serve_state.add_version_spec(service_name, 1, spec)
+    controller = controller_lib.SkyServeController(
+        service_name, spec, task, controller_port)
+    # Seed the fleet at min_replicas; the autoscaler takes over from here.
+    for _ in range(spec.min_replicas):
+        controller.replica_manager.scale_up()
+    controller.start_in_thread()
+    if not controller.wait_port_ready():
+        logger.error('Controller REST did not come up.')
+        return 1
+    serve_state.set_service_status(service_name,
+                                   serve_state.ServiceStatus.REPLICA_INIT)
+
+    balancer = lb_lib.SkyServeLoadBalancer(
+        controller_url=(
+            f'http://{constants.CONTROLLER_HOST}:{controller_port}'),
+        port=lb_port)
+    balancer.start_in_thread()
+
+    stopping = {'flag': False}
+
+    def _handle_term(signum, frame):  # pylint: disable=unused-argument
+        stopping['flag'] = True
+
+    signal.signal(signal.SIGTERM, _handle_term)
+    signal.signal(signal.SIGINT, _handle_term)
+    while not stopping['flag']:
+        time.sleep(0.2)
+
+    serve_state.set_service_status(service_name,
+                                   serve_state.ServiceStatus.SHUTTING_DOWN)
+    ok = _cleanup(controller, service_name)
+    if ok:
+        serve_state.remove_service(service_name)
+        return 0
+    serve_state.set_service_status(service_name,
+                                   serve_state.ServiceStatus.FAILED_CLEANUP)
+    return 1
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description='Serve service runner.')
+    parser.add_argument('--service-name', required=True)
+    parser.add_argument('--task-yaml', required=True)
+    parser.add_argument('--controller-port', type=int, required=True)
+    parser.add_argument('--lb-port', type=int, required=True)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(levelname)s %(name)s: %(message)s')
+    try:
+        return run_service(args.service_name, args.task_yaml,
+                           args.controller_port, args.lb_port)
+    except Exception:  # pylint: disable=broad-except
+        logger.error('Service runner crashed:\n%s', traceback.format_exc())
+        serve_state.set_service_status(
+            args.service_name, serve_state.ServiceStatus.CONTROLLER_FAILED)
+        return 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

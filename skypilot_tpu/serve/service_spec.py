@@ -1,0 +1,154 @@
+"""Service spec: the `service:` section of a task YAML.
+
+Reference parity: sky/serve/service_spec.py (340 LoC) — `SkyServiceSpec`
+(service_spec.py:15-120): readiness path/probe, initial_delay_seconds,
+min/max replicas, target_qps_per_replica, spot-with-on-demand-fallback
+knobs (base_ondemand_fallback_replicas, dynamic_ondemand_fallback).
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Optional
+
+if typing.TYPE_CHECKING:
+    pass
+
+
+class SkyServiceSpec:
+    """Validated `service:` config of a serving task."""
+
+    def __init__(
+        self,
+        readiness_path: str = '/',
+        initial_delay_seconds: int = 1200,
+        readiness_timeout_seconds: Optional[int] = None,
+        post_data: Optional[Any] = None,
+        readiness_headers: Optional[Dict[str, str]] = None,
+        min_replicas: int = 1,
+        max_replicas: Optional[int] = None,
+        target_qps_per_replica: Optional[float] = None,
+        upscale_delay_seconds: Optional[int] = None,
+        downscale_delay_seconds: Optional[int] = None,
+        base_ondemand_fallback_replicas: Optional[int] = None,
+        dynamic_ondemand_fallback: Optional[bool] = None,
+        use_ondemand_fallback: bool = False,
+    ) -> None:
+        if not readiness_path.startswith('/'):
+            raise ValueError(
+                f'readiness_path must start with "/": {readiness_path!r}')
+        if min_replicas < 0:
+            raise ValueError('min_replicas must be >= 0')
+        if max_replicas is not None and max_replicas < min_replicas:
+            raise ValueError('max_replicas must be >= min_replicas')
+        if target_qps_per_replica is not None:
+            if target_qps_per_replica <= 0:
+                raise ValueError('target_qps_per_replica must be > 0')
+            if max_replicas is None:
+                raise ValueError(
+                    'max_replicas is required when autoscaling with '
+                    'target_qps_per_replica')
+        self.readiness_path = readiness_path
+        self.initial_delay_seconds = initial_delay_seconds
+        self.readiness_timeout_seconds = readiness_timeout_seconds
+        self.post_data = post_data
+        self.readiness_headers = readiness_headers or {}
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.target_qps_per_replica = target_qps_per_replica
+        self.upscale_delay_seconds = upscale_delay_seconds
+        self.downscale_delay_seconds = downscale_delay_seconds
+        self.base_ondemand_fallback_replicas = base_ondemand_fallback_replicas
+        self.dynamic_ondemand_fallback = dynamic_ondemand_fallback
+        self.use_ondemand_fallback = (
+            use_ondemand_fallback or
+            bool(base_ondemand_fallback_replicas) or
+            bool(dynamic_ondemand_fallback))
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return self.target_qps_per_replica is not None
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyServiceSpec':
+        """(reference: SkyServiceSpec.from_yaml_config, service_spec.py:122)
+
+        YAML shape:
+            service:
+              readiness_probe: /health          # or a dict
+              replicas: 2                       # fixed count, or:
+              replica_policy:
+                min_replicas: 1
+                max_replicas: 4
+                target_qps_per_replica: 2.5
+        """
+        if not isinstance(config, dict):
+            raise ValueError(f'service config must be a dict: {config!r}')
+        kwargs: Dict[str, Any] = {}
+        probe = config.get('readiness_probe')
+        if isinstance(probe, str):
+            kwargs['readiness_path'] = probe
+        elif isinstance(probe, dict):
+            kwargs['readiness_path'] = probe.get('path', '/')
+            if 'initial_delay_seconds' in probe:
+                kwargs['initial_delay_seconds'] = probe[
+                    'initial_delay_seconds']
+            if 'timeout_seconds' in probe:
+                kwargs['readiness_timeout_seconds'] = probe[
+                    'timeout_seconds']
+            kwargs['post_data'] = probe.get('post_data')
+            kwargs['readiness_headers'] = probe.get('headers')
+        replicas = config.get('replicas')
+        policy = config.get('replica_policy')
+        if replicas is not None and policy is not None:
+            raise ValueError(
+                'Specify either replicas or replica_policy, not both.')
+        if replicas is not None:
+            kwargs['min_replicas'] = replicas
+            kwargs['max_replicas'] = replicas
+        elif policy is not None:
+            for key in ('min_replicas', 'max_replicas',
+                        'target_qps_per_replica', 'upscale_delay_seconds',
+                        'downscale_delay_seconds',
+                        'base_ondemand_fallback_replicas',
+                        'dynamic_ondemand_fallback',
+                        'use_ondemand_fallback'):
+                if key in policy:
+                    kwargs[key] = policy[key]
+        return cls(**kwargs)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        probe: Dict[str, Any] = {'path': self.readiness_path}
+        if self.initial_delay_seconds != 1200:
+            probe['initial_delay_seconds'] = self.initial_delay_seconds
+        if self.readiness_timeout_seconds is not None:
+            probe['timeout_seconds'] = self.readiness_timeout_seconds
+        if self.post_data is not None:
+            probe['post_data'] = self.post_data
+        if self.readiness_headers:
+            probe['headers'] = self.readiness_headers
+        config: Dict[str, Any] = {'readiness_probe': probe}
+        if not self.autoscaling_enabled and \
+                self.max_replicas == self.min_replicas:
+            config['replicas'] = self.min_replicas
+        else:
+            policy: Dict[str, Any] = {'min_replicas': self.min_replicas}
+            for key in ('max_replicas', 'target_qps_per_replica',
+                        'upscale_delay_seconds', 'downscale_delay_seconds',
+                        'base_ondemand_fallback_replicas',
+                        'dynamic_ondemand_fallback'):
+                value = getattr(self, key)
+                if value is not None:
+                    policy[key] = value
+            if self.use_ondemand_fallback:
+                policy['use_ondemand_fallback'] = True
+            config['replica_policy'] = policy
+        return config
+
+    def __repr__(self) -> str:
+        return (f'SkyServiceSpec(probe={self.readiness_path!r}, '
+                f'replicas=[{self.min_replicas}, {self.max_replicas}], '
+                f'qps/replica={self.target_qps_per_replica})')
+
+
+# The name task.py binds to (`task.service`).
+ServiceSpec = SkyServiceSpec

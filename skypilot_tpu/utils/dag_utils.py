@@ -1,0 +1,54 @@
+"""Chain-DAG ⇄ YAML round trip for managed jobs.
+
+Reference parity: sky/utils/dag_utils.py — multi-document YAML where the
+first doc carries the dag name and each following doc is one task config,
+in chain order.
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import yaml
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import task as task_lib
+
+
+def convert_entrypoint_to_dag(
+        entrypoint: Union['task_lib.Task', 'dag_lib.Dag']) -> 'dag_lib.Dag':
+    if isinstance(entrypoint, dag_lib.Dag):
+        return entrypoint
+    dag = dag_lib.Dag()
+    dag.add(entrypoint)
+    dag.name = entrypoint.name
+    return dag
+
+
+def dump_chain_dag_to_yaml(dag: 'dag_lib.Dag', path: str) -> None:
+    assert dag.is_chain(), 'Managed jobs only support chain DAGs.'
+    configs = [{'name': dag.name}]
+    for task in dag.topological_order():
+        configs.append(task.to_yaml_config())
+    with open(path, 'w', encoding='utf-8') as f:
+        yaml.safe_dump_all(configs, f, default_flow_style=False)
+
+
+def load_chain_dag_from_yaml(path: str) -> 'dag_lib.Dag':
+    with open(path, 'r', encoding='utf-8') as f:
+        configs = list(yaml.safe_load_all(f))
+    dag_name: Optional[str] = None
+    if configs and configs[0] is not None and 'name' in configs[0] and \
+            len(configs[0]) == 1:
+        dag_name = configs[0]['name']
+        configs = configs[1:]
+    if not configs:
+        configs = [{}]
+    dag = dag_lib.Dag(name=dag_name)
+    prev = None
+    for config in configs:
+        task = task_lib.Task.from_yaml_config(config or {})
+        dag.add(task)
+        if prev is not None:
+            dag.add_edge(prev, task)
+        prev = task
+    return dag

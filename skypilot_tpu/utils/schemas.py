@@ -8,7 +8,8 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-import jsonschema
+# jsonschema's import chain costs >1s (rfc3987 format registry); it loads
+# lazily so codegen-RPC subprocesses and the CLI don't pay it on startup.
 
 
 def _case_insensitive_enum(values):
@@ -86,6 +87,7 @@ SERVICE_SCHEMA: Dict[str, Any] = {
                 'base_ondemand_fallback_replicas': {'type': 'integer',
                                                     'minimum': 0},
                 'dynamic_ondemand_fallback': {'type': 'boolean'},
+                'use_ondemand_fallback': {'type': 'boolean'},
             },
         },
         'replicas': {'type': 'integer', 'minimum': 1},
@@ -173,6 +175,7 @@ CONFIG_SCHEMA: Dict[str, Any] = {
 
 def _validate(config: Dict[str, Any], schema: Dict[str, Any],
               what: str) -> None:
+    import jsonschema
     try:
         jsonschema.validate(config, schema)
     except jsonschema.ValidationError as e:

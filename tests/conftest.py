@@ -16,6 +16,11 @@ import os
 # var alone is not enough — override through jax.config and drop any
 # already-initialized backends.
 os.environ['JAX_PLATFORMS'] = 'cpu'
+# The axon sitecustomize registers the TPU PJRT plugin (importing jax, ~2s)
+# in EVERY python subprocess when this var is set. Tests are CPU-only and
+# spawn many short-lived processes (agents, controllers, codegen RPCs) —
+# drop it so they start fast. bench.py keeps it for the real chip.
+os.environ.pop('PALLAS_AXON_POOL_IPS', None)
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
@@ -31,6 +36,11 @@ if _xb.backends_are_initialized():
     clear_backends()
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers', 'slow: full end-to-end loops on the fake cloud')
 
 
 @pytest.fixture(autouse=True)

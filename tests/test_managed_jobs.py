@@ -1,0 +1,197 @@
+"""Managed jobs on the fake cloud: the full launch→preempt→recover loop,
+hermetically — the test the reference can only run against real clouds by
+manually terminating instances (SURVEY §4.4: spot recovery smoke tests use
+`aws ec2 terminate-instances`).
+"""
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_user_state
+from skypilot_tpu.jobs import constants as jobs_constants
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs import utils as jobs_utils
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.provision.fake import FakeCloudState
+
+
+@pytest.fixture(autouse=True)
+def fast_polling(_isolate_state, monkeypatch):
+    global_user_state.set_enabled_clouds(['fake'])
+    monkeypatch.setenv('SKYTPU_JOBS_POLL_SECONDS', '0.2')
+    monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_WAIT_SECONDS', '0.1')
+    # Reset state-module singletons (per-test db isolation).
+    jobs_state._db = None  # pylint: disable=protected-access
+    yield
+
+
+def _task(run='echo managed', name='mj', acc='tpu-v5e-1', **kwargs):
+    task = sky.Task(name=name, run=run, **kwargs)
+    task.set_resources({sky.Resources(cloud='fake', accelerators=acc)})
+    return task
+
+
+def _wait_status(job_id, wanted, timeout=60.0):
+    deadline = time.time() + timeout
+    status = None
+    while time.time() < deadline:
+        status = jobs_state.get_status(job_id)
+        if status in wanted:
+            return status
+        time.sleep(0.2)
+    raise AssertionError(
+        f'managed job {job_id} stuck at {status}, wanted {wanted}')
+
+
+_TERMINAL = tuple(ManagedJobStatus.terminal_statuses())
+
+
+class TestStateMachine:
+
+    def test_fsm_and_aggregation(self):
+        job_id = jobs_state.set_job_info('j', '/tmp/dag.yaml')
+        jobs_state.set_pending(job_id, 0, 't0', 'tpu-v5e-1')
+        jobs_state.set_pending(job_id, 1, 't1', 'tpu-v5e-1')
+        assert jobs_state.get_status(job_id) == ManagedJobStatus.PENDING
+        jobs_state.set_submitted(job_id, 0, 'ts')
+        jobs_state.set_starting(job_id, 0)
+        jobs_state.set_started(job_id, 0, 'c-0')
+        assert jobs_state.get_status(job_id) == ManagedJobStatus.RUNNING
+        jobs_state.set_recovering(job_id, 0)
+        assert jobs_state.get_status(job_id) == ManagedJobStatus.RECOVERING
+        jobs_state.set_recovered(job_id, 0, 'c-0')
+        recs = jobs_state.get_task_records(job_id)
+        assert recs[0]['recovery_count'] == 1
+        jobs_state.set_succeeded(job_id, 0)
+        # Task 1 still pending → job not terminal.
+        assert jobs_state.get_status(job_id) == ManagedJobStatus.PENDING
+        jobs_state.set_succeeded(job_id, 1)
+        assert jobs_state.get_status(job_id) == ManagedJobStatus.SUCCEEDED
+
+    def test_set_failed_all_nonterminal(self):
+        job_id = jobs_state.set_job_info('j', '')
+        jobs_state.set_pending(job_id, 0, 't0', 'r')
+        jobs_state.set_pending(job_id, 1, 't1', 'r')
+        jobs_state.set_succeeded(job_id, 0)
+        jobs_state.set_failed(job_id, None,
+                              ManagedJobStatus.FAILED_CONTROLLER, 'dead')
+        recs = jobs_state.get_task_records(job_id)
+        assert recs[0]['status'] == ManagedJobStatus.SUCCEEDED
+        assert recs[1]['status'] == ManagedJobStatus.FAILED_CONTROLLER
+
+
+class TestStrategyRegistry:
+
+    def test_registry_and_default(self):
+        assert set(recovery_strategy.RECOVERY_STRATEGIES) == {
+            'FAILOVER', 'EAGER_NEXT_REGION'
+        }
+        ex = recovery_strategy.StrategyExecutor.make('c', _task())
+        assert ex.NAME == 'EAGER_NEXT_REGION'
+
+    def test_strategy_from_resources(self):
+        task = sky.Task(name='t', run='true')
+        task.set_resources({
+            sky.Resources(cloud='fake', accelerators='tpu-v5e-1',
+                          job_recovery='failover')
+        })
+        ex = recovery_strategy.StrategyExecutor.make('c', task)
+        assert ex.NAME == 'FAILOVER'
+
+    def test_unknown_strategy_raises(self):
+        task = sky.Task(name='t', run='true')
+        task.set_resources({
+            sky.Resources(cloud='fake', accelerators='tpu-v5e-1',
+                          job_recovery='nope')
+        })
+        with pytest.raises(ValueError, match='Unknown job_recovery'):
+            recovery_strategy.StrategyExecutor.make('c', task)
+
+
+class TestManagedJobEndToEnd:
+
+    def test_success_and_cluster_teardown(self):
+        job_id = jobs_core.launch(_task(), detach_run=True)
+        assert _wait_status(job_id, _TERMINAL) == ManagedJobStatus.SUCCEEDED
+        # The task cluster was torn down after success.
+        assert global_user_state.get_clusters() == []
+        recs = jobs_core.queue()
+        assert recs[0]['job_name'] == 'mj'
+        assert recs[0]['recovery_count'] == 0
+
+    def test_preemption_recovery(self):
+        # A job that runs long enough to be preempted mid-flight.
+        job_id = jobs_core.launch(_task(run='sleep 120', name='longjob'),
+                                  detach_run=True)
+        _wait_status(job_id, (ManagedJobStatus.RUNNING,))
+        cluster = jobs_utils.generate_managed_job_cluster_name(
+            'longjob', job_id)
+        FakeCloudState().preempt(cluster)
+        st = _wait_status(job_id,
+                          (ManagedJobStatus.RECOVERING,) + _TERMINAL)
+        assert st == ManagedJobStatus.RECOVERING
+        # Recovery relaunches and the job returns to RUNNING.
+        _wait_status(job_id, (ManagedJobStatus.RUNNING,))
+        recs = jobs_state.get_task_records(job_id)
+        assert recs[0]['recovery_count'] >= 1
+        jobs_core.cancel(job_ids=[job_id])
+        _wait_status(job_id, (ManagedJobStatus.CANCELLED,))
+
+    def test_cancel(self):
+        job_id = jobs_core.launch(_task(run='sleep 120'), detach_run=True)
+        _wait_status(job_id, (ManagedJobStatus.RUNNING,))
+        assert jobs_core.cancel(job_ids=[job_id]) == [job_id]
+        assert _wait_status(job_id, _TERMINAL) == ManagedJobStatus.CANCELLED
+        assert global_user_state.get_clusters() == []
+
+    def test_user_failure_no_restart_budget(self):
+        job_id = jobs_core.launch(_task(run='exit 3'), detach_run=True)
+        assert _wait_status(job_id, _TERMINAL) == ManagedJobStatus.FAILED
+        assert global_user_state.get_clusters() == []
+
+    def test_no_capacity_fails_no_resource(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_JOBS_MAX_LAUNCH_RETRIES', '1')
+        from skypilot_tpu import catalog
+        state = FakeCloudState()
+        # Every zone offering the accelerator reports a stockout →
+        # FAILED_NO_RESOURCE after the strategy's retry budget.
+        for _, zones, _ in catalog.get_region_zones('tpu-v5e-1', False):
+            for z in zones:
+                state.set_zone_failure(z, 'capacity')
+        job_id = jobs_core.launch(_task(), detach_run=True)
+        assert _wait_status(job_id, _TERMINAL) == \
+            ManagedJobStatus.FAILED_NO_RESOURCE
+
+    def test_pipeline_chain(self):
+        t1 = _task(run='echo stage-one', name='s1')
+        t2 = _task(run='echo stage-two', name='s2')
+        with sky.Dag() as dag:
+            dag.add(t1)
+            dag.add(t2)
+            dag.add_edge(t1, t2)
+        dag.name = 'pipeline'
+        job_id = jobs_core.launch(dag, detach_run=True)
+        assert _wait_status(job_id, _TERMINAL) == ManagedJobStatus.SUCCEEDED
+        recs = jobs_state.get_task_records(job_id)
+        assert len(recs) == 2
+        assert all(r['status'] == ManagedJobStatus.SUCCEEDED for r in recs)
+
+    def test_dead_controller_detection(self):
+        import os
+        import signal
+        job_id = jobs_core.launch(_task(run='sleep 120'), detach_run=True)
+        _wait_status(job_id, (ManagedJobStatus.RUNNING,))
+        pid = jobs_state.get_job_info(job_id)['controller_pid']
+        os.kill(pid, signal.SIGKILL)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            jobs_utils.update_managed_job_status()
+            if jobs_state.get_status(job_id) == \
+                    ManagedJobStatus.FAILED_CONTROLLER:
+                break
+            time.sleep(0.2)
+        assert jobs_state.get_status(job_id) == \
+            ManagedJobStatus.FAILED_CONTROLLER

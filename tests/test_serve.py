@@ -1,0 +1,277 @@
+"""Serve subsystem tests: autoscaler logic with synthetic request
+timestamps (the reference's own trick, tests/test_serve_autoscaler.py),
+service-spec YAML round trip, replica-FSM aggregation — and a full
+hermetic serve-up→probe→proxy→autoscale→down loop on the fake cloud,
+which the reference can only cover with real-cloud smoke tests.
+"""
+import time
+
+import pytest
+import requests
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_user_state
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+_TPU = 'tpu-v5e-1'
+
+
+@pytest.fixture(autouse=True)
+def serve_env(_isolate_state, monkeypatch):
+    global_user_state.set_enabled_clouds(['fake'])
+    for var, val in [
+        ('SKYTPU_SERVE_QPS_WINDOW', '2'),
+        ('SKYTPU_SERVE_DECISION_INTERVAL', '0.2'),
+        ('SKYTPU_SERVE_NO_REPLICA_INTERVAL', '0.1'),
+        ('SKYTPU_SERVE_UPSCALE_DELAY', '0.2'),
+        ('SKYTPU_SERVE_DOWNSCALE_DELAY', '0.4'),
+        ('SKYTPU_SERVE_LB_SYNC_INTERVAL', '0.2'),
+        ('SKYTPU_SERVE_PROBE_INTERVAL', '0.3'),
+        ('SKYTPU_SERVE_PROBE_TIMEOUT', '2'),
+        ('SKYTPU_SERVE_PORT_OFFSET_BY_REPLICA', '1'),
+    ]:
+        monkeypatch.setenv(var, val)
+    serve_state._db = None  # pylint: disable=protected-access
+    yield
+
+
+class _FakeReplica:
+    """Duck-typed ReplicaInfo for pure-logic autoscaler tests."""
+
+    def __init__(self, replica_id, status=ReplicaStatus.READY,
+                 is_spot=False, version=1):
+        self.replica_id = replica_id
+        self.status = status
+        self.is_spot = is_spot
+        self.version = version
+
+
+def _spec(**kw):
+    defaults = dict(min_replicas=1, max_replicas=4,
+                    target_qps_per_replica=1.0,
+                    upscale_delay_seconds=0, downscale_delay_seconds=0)
+    defaults.update(kw)
+    return SkyServiceSpec(**defaults)
+
+
+class TestServiceSpec:
+
+    def test_yaml_round_trip(self):
+        spec = SkyServiceSpec.from_yaml_config({
+            'readiness_probe': {
+                'path': '/health',
+                'initial_delay_seconds': 30
+            },
+            'replica_policy': {
+                'min_replicas': 1,
+                'max_replicas': 3,
+                'target_qps_per_replica': 2.0,
+                'base_ondemand_fallback_replicas': 1,
+            },
+        })
+        assert spec.readiness_path == '/health'
+        assert spec.use_ondemand_fallback
+        spec2 = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+        assert spec2.max_replicas == 3
+        assert spec2.target_qps_per_replica == 2.0
+        assert spec2.base_ondemand_fallback_replicas == 1
+
+    def test_use_ondemand_fallback_round_trip(self):
+        spec = SkyServiceSpec(min_replicas=1, max_replicas=2,
+                              target_qps_per_replica=1.0,
+                              use_ondemand_fallback=True)
+        spec2 = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+        assert spec2.use_ondemand_fallback
+
+    def test_fixed_replicas(self):
+        spec = SkyServiceSpec.from_yaml_config({
+            'readiness_probe': '/',
+            'replicas': 2
+        })
+        assert spec.min_replicas == spec.max_replicas == 2
+        assert not spec.autoscaling_enabled
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match='max_replicas'):
+            SkyServiceSpec(min_replicas=3, max_replicas=1)
+        with pytest.raises(ValueError, match='max_replicas is required'):
+            SkyServiceSpec(target_qps_per_replica=1.0)
+
+
+class TestRequestRateAutoscaler:
+
+    def test_scale_up_on_load(self):
+        scaler = autoscalers.RequestRateAutoscaler(_spec())
+        now = time.time()
+        # QPS window is 2s → 6 requests = 3 qps → 3 replicas wanted.
+        scaler.collect_request_information([now - 0.1] * 6)
+        decisions = scaler.evaluate_scaling([_FakeReplica(1)])
+        ups = [d for d in decisions if d.operator ==
+               autoscalers.AutoscalerDecisionOperator.SCALE_UP]
+        assert len(ups) == 2
+
+    def test_scale_down_when_idle(self):
+        scaler = autoscalers.RequestRateAutoscaler(_spec())
+        replicas = [_FakeReplica(i) for i in range(1, 4)]
+        decisions = scaler.evaluate_scaling(replicas)
+        downs = [d for d in decisions if d.operator ==
+                 autoscalers.AutoscalerDecisionOperator.SCALE_DOWN]
+        # No traffic → fall to min_replicas=1.
+        assert len(downs) == 2
+
+    def test_bounded_by_max(self):
+        scaler = autoscalers.RequestRateAutoscaler(_spec(max_replicas=2))
+        scaler.collect_request_information([time.time()] * 100)
+        decisions = scaler.evaluate_scaling([_FakeReplica(1)])
+        assert len(decisions) == 1  # capped at max=2
+
+    def test_hysteresis_delays_scaling(self):
+        spec = _spec(upscale_delay_seconds=100)  # ≥ several intervals
+        scaler = autoscalers.RequestRateAutoscaler(spec)
+        scaler.collect_request_information([time.time()] * 10)
+        # First evaluations hold steady; only after threshold decisions
+        # does the upscale land.
+        assert scaler.evaluate_scaling([_FakeReplica(1)]) == []
+        assert scaler.scale_up_threshold > 1
+
+    def test_ready_replicas_scaled_down_last(self):
+        # Regression: the least-useful replica (PENDING) goes first; the
+        # READY replica serving traffic is the last to be retired.
+        scaler = autoscalers.RequestRateAutoscaler(_spec())
+        replicas = [
+            _FakeReplica(1, status=ReplicaStatus.READY),
+            _FakeReplica(2, status=ReplicaStatus.PENDING),
+            _FakeReplica(3, status=ReplicaStatus.STARTING),
+        ]
+        decisions = scaler.evaluate_scaling(replicas)
+        downs = [d.target for d in decisions]
+        assert downs == [2, 3]
+
+    def test_dying_replicas_do_not_count(self):
+        # Regression: a PREEMPTED/SHUTTING_DOWN replica must not satisfy
+        # min_replicas — its replacement launches during teardown.
+        scaler = autoscalers.RequestRateAutoscaler(_spec())
+        replicas = [_FakeReplica(1, status=ReplicaStatus.SHUTTING_DOWN)]
+        decisions = scaler.evaluate_scaling(replicas)
+        assert len(decisions) == 1
+        assert decisions[0].operator == \
+            autoscalers.AutoscalerDecisionOperator.SCALE_UP
+
+    def test_old_version_scaled_down_first(self):
+        scaler = autoscalers.RequestRateAutoscaler(_spec())
+        replicas = [
+            _FakeReplica(1, version=1),
+            _FakeReplica(2, version=2),
+            _FakeReplica(3, version=2),
+        ]
+        decisions = scaler.evaluate_scaling(replicas)
+        downs = [d.target for d in decisions]
+        assert downs[0] == 1  # v1 goes first
+
+
+class TestFallbackAutoscaler:
+
+    def test_base_ondemand_fallback(self):
+        spec = _spec(base_ondemand_fallback_replicas=1)
+        scaler = autoscalers.FallbackRequestRateAutoscaler(spec)
+        decisions = scaler.evaluate_scaling([])
+        spots = [d for d in decisions
+                 if d.operator.value == 'scale_up' and
+                 d.target.get('use_spot')]
+        ondemand = [d for d in decisions
+                    if d.operator.value == 'scale_up' and
+                    d.target.get('use_spot') is False]
+        assert len(spots) == 1  # min_replicas=1 spot
+        assert len(ondemand) == 1  # base fallback
+
+    def test_dynamic_fallback_covers_not_ready_spot(self):
+        spec = _spec(dynamic_ondemand_fallback=True)
+        scaler = autoscalers.FallbackRequestRateAutoscaler(spec)
+        replicas = [
+            _FakeReplica(1, status=ReplicaStatus.STARTING, is_spot=True),
+        ]
+        decisions = scaler.evaluate_scaling(replicas)
+        ondemand_ups = [
+            d for d in decisions if d.operator.value == 'scale_up' and
+            d.target.get('use_spot') is False
+        ]
+        assert len(ondemand_ups) == 1
+        # Once the spot replica is READY, the cover retires.
+        replicas = [
+            _FakeReplica(1, status=ReplicaStatus.READY, is_spot=True),
+            _FakeReplica(2, status=ReplicaStatus.READY, is_spot=False),
+        ]
+        decisions = scaler.evaluate_scaling(replicas)
+        downs = [d for d in decisions if d.operator.value == 'scale_down']
+        assert [d.target for d in downs] == [2]
+
+
+class TestServiceStatusAggregation:
+
+    def test_from_replica_statuses(self):
+        f = ServiceStatus.from_replica_statuses
+        assert f([ReplicaStatus.READY,
+                  ReplicaStatus.STARTING]) == ServiceStatus.READY
+        assert f([ReplicaStatus.PROVISIONING]) == ServiceStatus.REPLICA_INIT
+        assert f([ReplicaStatus.FAILED_PROBING]) == ServiceStatus.FAILED
+        assert f([]) == ServiceStatus.NO_REPLICA
+
+
+@pytest.mark.slow
+class TestServeEndToEnd:
+
+    def _service_task(self, replicas=1, run=None):
+        task = sky.Task(
+            name='svc',
+            run=run or
+            'exec python3 -m http.server $SKYTPU_REPLICA_PORT')
+        task.set_resources({
+            sky.Resources(cloud='fake', accelerators=_TPU, ports=[8124])
+        })
+        task.set_service(
+            SkyServiceSpec(readiness_path='/', initial_delay_seconds=60,
+                           min_replicas=replicas, max_replicas=replicas))
+        return task
+
+    def test_up_ready_proxy_down(self):
+        from skypilot_tpu.serve import core as serve_core
+        result = serve_core.up(self._service_task(), 'svc')
+        try:
+            endpoint = serve_core.wait_until_ready('svc', timeout=90)
+            assert endpoint == result['endpoint']
+            resp = requests.get(endpoint + '/', timeout=5)
+            assert resp.status_code == 200
+            records = serve_core.status('svc')
+            assert records[0]['status'] == ServiceStatus.READY
+            assert len(records[0]['replica_info']) == 1
+            assert records[0]['replica_info'][0]['status'] == 'READY'
+        finally:
+            serve_core.down('svc', purge=True)
+        assert serve_core.status('svc') == []
+        assert global_user_state.get_clusters() == []
+
+    def test_two_replicas_round_robin(self):
+        from skypilot_tpu.serve import core as serve_core
+        serve_core.up(self._service_task(replicas=2), 'svc2')
+        try:
+            endpoint = serve_core.wait_until_ready('svc2', timeout=120)
+            # Wait for BOTH replicas ready (wait_until_ready needs one).
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                recs = serve_core.status('svc2')[0]['replica_info']
+                if sum(r['status'] == 'READY' for r in recs) == 2:
+                    break
+                time.sleep(0.5)
+            recs = serve_core.status('svc2')[0]['replica_info']
+            assert sum(r['status'] == 'READY' for r in recs) == 2
+            # LB must answer from its pool after syncing both.
+            time.sleep(1.0)
+            for _ in range(4):
+                resp = requests.get(endpoint + '/', timeout=5)
+                assert resp.status_code == 200
+        finally:
+            serve_core.down('svc2', purge=True)
+        assert global_user_state.get_clusters() == []
