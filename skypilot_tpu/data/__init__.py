@@ -1,0 +1,18 @@
+"""Data layer: bucket storage attached to tasks (SURVEY §2.8).
+
+Reference parity: sky/data/ (4,910 LoC) — Storage objects, store
+implementations, FUSE mounting. GCS-first per the TPU-native plan;
+local:// buckets make the whole layer hermetically testable.
+"""
+from skypilot_tpu.data.storage import AbstractStore
+from skypilot_tpu.data.storage import GcsStore
+from skypilot_tpu.data.storage import LocalStore
+from skypilot_tpu.data.storage import Storage
+from skypilot_tpu.data.storage import StorageMode
+from skypilot_tpu.data.storage import StorageStatus
+from skypilot_tpu.data.storage import StoreType
+
+__all__ = [
+    'AbstractStore', 'GcsStore', 'LocalStore', 'Storage', 'StorageMode',
+    'StorageStatus', 'StoreType'
+]

@@ -1,0 +1,59 @@
+"""Mount-command builders: gcsfuse for GCS, symlink for local buckets.
+
+Reference parity: sky/data/mounting_utils.py (298 LoC) — FUSE mount
+command builders with install-and-retry wrapper scripts
+(mounting_utils.py:25-80). GCS-first: gcsfuse is the only FUSE binary
+(SURVEY §2.10); local:// buckets "mount" as symlinks, which is what makes
+MOUNT-mode storage testable without FUSE or a cloud.
+"""
+from __future__ import annotations
+
+GCSFUSE_VERSION = '2.4.0'
+
+# Matches the reference's install-then-mount script shape
+# (mounting_utils.py get_mounting_script): idempotent install, mkdir,
+# mount, verify.
+_GCSFUSE_INSTALL = (
+    'which gcsfuse >/dev/null 2>&1 || {{ '
+    'curl -sSL -o /tmp/gcsfuse.deb https://github.com/GoogleCloudPlatform/'
+    'gcsfuse/releases/download/v{version}/gcsfuse_{version}_amd64.deb && '
+    'sudo dpkg -i /tmp/gcsfuse.deb >/dev/null; }}')
+
+
+def get_gcsfuse_mount_cmd(bucket_name: str, mount_path: str,
+                          implicit_dirs: bool = True) -> str:
+    """(reference: mounting_utils.py GCS branch)"""
+    flags = '--implicit-dirs ' if implicit_dirs else ''
+    install = _GCSFUSE_INSTALL.format(version=GCSFUSE_VERSION)
+    return (f'{install} && '
+            f'mkdir -p {mount_path} && '
+            f'mountpoint -q {mount_path} || '
+            f'gcsfuse {flags}{bucket_name} {mount_path}')
+
+
+def get_gcsfuse_unmount_cmd(mount_path: str) -> str:
+    return (f'mountpoint -q {mount_path} && '
+            f'fusermount -u {mount_path} || true')
+
+
+def get_local_symlink_mount_cmd(bucket_dir: str, mount_path: str) -> str:
+    """local:// buckets: a symlink IS a mount — writes land in the bucket
+    dir immediately, exactly like FUSE semantics."""
+    return (f'mkdir -p {bucket_dir} && '
+            f'mkdir -p $(dirname {mount_path}) && '
+            f'rm -rf {mount_path} && '
+            f'ln -sfn {bucket_dir} {mount_path}')
+
+
+def get_copy_down_cmd(store_url: str, dst: str) -> str:
+    """COPY-mode download command for one host (reference: the
+    CloudStorage download interfaces, sky/cloud_stores.py)."""
+    if store_url.startswith('gs://'):
+        return (f'mkdir -p {dst} && '
+                f'(gcloud storage cp -r "{store_url}/*" {dst}/ 2>/dev/null '
+                f'|| gsutil -m cp -r "{store_url}/*" {dst}/)')
+    from skypilot_tpu.data import data_utils
+    bucket, _ = data_utils.split_local_bucket_path(store_url)
+    bucket_dir = data_utils.fake_bucket_dir(bucket)
+    return (f'mkdir -p {dst} && '
+            f'cp -a {bucket_dir}/. {dst}/')

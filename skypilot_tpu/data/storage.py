@@ -1,0 +1,378 @@
+"""Storage: named buckets attached to tasks as mounts or copies.
+
+Reference parity: sky/data/storage.py (3,501 LoC) — `Storage` lifecycle:
+validate source (local dir or URI, storage.py:567), `add_store` /
+`sync_all_stores` (:849,984), reconstruct from pickled metadata
+(from_metadata:822), `delete` (:940), YAML round trip (:1018,1054);
+`AbstractStore` interface (:197-353); `StorageMode` {MOUNT, COPY} (:192).
+
+GCS-first (SURVEY §2.10): `GcsStore` is the production store; `LocalStore`
+backs `local://` buckets with a plain directory — same lifecycle, no
+cloud — which is how storage tests and the fake cloud run hermetically.
+"""
+from __future__ import annotations
+
+import enum
+import logging
+import os
+import shutil
+import subprocess
+import typing
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.data import data_utils
+from skypilot_tpu.data import mounting_utils
+
+if typing.TYPE_CHECKING:
+    pass
+
+logger = logging.getLogger(__name__)
+
+
+class StoreType(enum.Enum):
+    """(reference: StoreType, storage.py:109)"""
+    GCS = 'GCS'
+    LOCAL = 'LOCAL'
+
+    @classmethod
+    def from_source(cls, source: str) -> 'StoreType':
+        if source.startswith(data_utils.GCS_PREFIX):
+            return cls.GCS
+        if source.startswith(data_utils.LOCAL_PREFIX):
+            return cls.LOCAL
+        raise exceptions.StorageSpecError(
+            f'Unknown storage URI scheme: {source!r}')
+
+    @classmethod
+    def from_store_name(cls, store: str) -> 'StoreType':
+        try:
+            return cls(store.upper())
+        except ValueError:
+            raise exceptions.StorageSpecError(
+                f'Unknown store type {store!r}; available: '
+                f'{[t.value.lower() for t in cls]}') from None
+
+
+class StorageMode(enum.Enum):
+    """(reference: StorageMode, storage.py:192)"""
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+
+
+class StorageStatus(enum.Enum):
+    """Lifecycle in the client db (reference: StorageStatus,
+    global_user_state.py)."""
+    INIT = 'INIT'
+    UPLOAD_FAILED = 'UPLOAD_FAILED'
+    UPLOADING = 'UPLOADING'
+    READY = 'READY'
+    DELETED = 'DELETED'
+
+
+class AbstractStore:
+    """One bucket in one store backend (reference: AbstractStore,
+    storage.py:197-353)."""
+
+    STORE_TYPE: StoreType
+
+    def __init__(self, name: str,
+                 source: Optional[str] = None) -> None:
+        data_utils.validate_bucket_name(name)
+        self.name = name
+        self.source = source
+
+    # -- lifecycle --
+    def initialize(self) -> None:
+        """Create the bucket if needed."""
+        raise NotImplementedError
+
+    def upload(self) -> None:
+        """Sync self.source (a local dir) into the bucket."""
+        raise NotImplementedError
+
+    def delete(self) -> None:
+        raise NotImplementedError
+
+    # -- consumption --
+    def url(self) -> str:
+        raise NotImplementedError
+
+    def mount_command(self, mount_path: str) -> str:
+        raise NotImplementedError
+
+    def copy_down_command(self, dst: str) -> str:
+        return mounting_utils.get_copy_down_cmd(self.url(), dst)
+
+    def __repr__(self) -> str:
+        return f'{type(self).__name__}({self.name!r})'
+
+
+class GcsStore(AbstractStore):
+    """(reference: GcsStore, storage.py:1497 — gsutil/`gcloud storage`
+    sync + gcsfuse mounts)"""
+
+    STORE_TYPE = StoreType.GCS
+
+    def url(self) -> str:
+        return f'gs://{self.name}'
+
+    def _run(self, cmd: str) -> None:
+        proc = subprocess.run(cmd, shell=True, capture_output=True,
+                              text=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.StorageUploadError(
+                f'Command failed ({cmd!r}):\n{proc.stderr}')
+
+    def initialize(self) -> None:
+        self._run(f'gcloud storage buckets describe gs://{self.name} '
+                  f'>/dev/null 2>&1 || '
+                  f'gcloud storage buckets create gs://{self.name}')
+
+    def upload(self) -> None:
+        assert self.source is not None and not \
+            data_utils.is_cloud_uri(self.source)
+        src = os.path.expanduser(self.source)
+        # rsync semantics like the reference's `gsutil -m rsync -r`.
+        self._run(f'gcloud storage rsync -r {src} gs://{self.name} '
+                  f'2>/dev/null || gsutil -m rsync -r {src} '
+                  f'gs://{self.name}')
+
+    def delete(self) -> None:
+        self._run(f'gcloud storage rm -r gs://{self.name} 2>/dev/null '
+                  f'|| gsutil -m rm -r gs://{self.name}')
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.get_gcsfuse_mount_cmd(self.name, mount_path)
+
+
+class LocalStore(AbstractStore):
+    """A directory pretending to be a bucket: local:// scheme. Same
+    lifecycle as GcsStore with filesystem transport; MOUNT mode is a
+    symlink (real shared-write semantics on one machine)."""
+
+    STORE_TYPE = StoreType.LOCAL
+
+    @property
+    def bucket_dir(self) -> str:
+        return data_utils.fake_bucket_dir(self.name)
+
+    def url(self) -> str:
+        return f'local://{self.name}'
+
+    def initialize(self) -> None:
+        os.makedirs(self.bucket_dir, exist_ok=True)
+
+    def upload(self) -> None:
+        assert self.source is not None and not \
+            data_utils.is_cloud_uri(self.source)
+        src = os.path.expanduser(self.source)
+        if not os.path.isdir(src):
+            raise exceptions.StorageUploadError(
+                f'Source {src!r} is not a directory.')
+        os.makedirs(self.bucket_dir, exist_ok=True)
+        shutil.copytree(src, self.bucket_dir, dirs_exist_ok=True)
+
+    def delete(self) -> None:
+        shutil.rmtree(self.bucket_dir, ignore_errors=True)
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.get_local_symlink_mount_cmd(
+            self.bucket_dir, mount_path)
+
+
+_STORE_CLASSES = {
+    StoreType.GCS: GcsStore,
+    StoreType.LOCAL: LocalStore,
+}
+
+
+class Storage:
+    """A named bucket + its stores + how tasks consume it (reference:
+    Storage, storage.py:384)."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        source: Optional[str] = None,
+        mode: StorageMode = StorageMode.MOUNT,
+        persistent: bool = True,
+        stores: Optional[Dict[StoreType, AbstractStore]] = None,
+    ) -> None:
+        """(reference: Storage.__init__ + _validate_storage_spec,
+        storage.py:384-567)
+
+        - name + local-dir source: upload the dir to the bucket.
+        - URI source (gs://... / local://...): use the existing bucket;
+          name defaults to the bucket name.
+        - name only: an empty "scratch" bucket (checkpoints land here).
+        """
+        if source is not None and data_utils.is_cloud_uri(source):
+            bucket = (data_utils.split_gcs_path(source)[0]
+                      if source.startswith(data_utils.GCS_PREFIX) else
+                      data_utils.split_local_bucket_path(source)[0])
+            if name is not None and name != bucket:
+                raise exceptions.StorageSpecError(
+                    f'name {name!r} conflicts with bucket URI {source!r}')
+            name = bucket
+        if name is None:
+            raise exceptions.StorageSpecError(
+                'Storage needs a name (or a bucket URI source).')
+        if source is not None and not data_utils.is_cloud_uri(source):
+            expanded = os.path.expanduser(source)
+            if not os.path.exists(expanded):
+                raise exceptions.StorageSpecError(
+                    f'Local source {source!r} does not exist.')
+        data_utils.validate_bucket_name(name)
+        self.name = name
+        self.source = source
+        self.mode = mode
+        self.persistent = persistent
+        self.stores: Dict[StoreType, AbstractStore] = stores or {}
+
+    # ---------------- store management ----------------
+
+    def add_store(self, store_type: 'StoreType | str') -> AbstractStore:
+        """(reference: add_store, storage.py:849)"""
+        if isinstance(store_type, str):
+            store_type = StoreType.from_store_name(store_type)
+        if store_type in self.stores:
+            return self.stores[store_type]
+        source_for_store = self.source
+        if self.source is not None and \
+                data_utils.is_cloud_uri(self.source):
+            if StoreType.from_source(self.source) != store_type:
+                raise exceptions.StorageSpecError(
+                    f'Source {self.source!r} is a '
+                    f'{StoreType.from_source(self.source).value} bucket; '
+                    f'cannot add a {store_type.value} store for it.')
+            source_for_store = None  # bucket already holds the data
+        store = _STORE_CLASSES[store_type](self.name, source_for_store)
+        store.initialize()
+        self.stores[store_type] = store
+        self._persist(StorageStatus.INIT)
+        return store
+
+    def sync_all_stores(self) -> None:
+        """Upload local source into every store (reference:
+        sync_all_stores, storage.py:984)."""
+        if self.source is None or data_utils.is_cloud_uri(self.source):
+            self._persist(StorageStatus.READY)
+            return
+        self._persist(StorageStatus.UPLOADING)
+        try:
+            for store in self.stores.values():
+                store.upload()
+        except exceptions.StorageUploadError:
+            self._persist(StorageStatus.UPLOAD_FAILED)
+            raise
+        self._persist(StorageStatus.READY)
+
+    def construct(self) -> None:
+        """Ensure at least one store exists and data is synced — the one
+        call sites use (reference: Storage handling inside
+        backend file-mount execution)."""
+        if not self.stores:
+            if self.source is not None and \
+                    data_utils.is_cloud_uri(self.source):
+                self.add_store(StoreType.from_source(self.source))
+            else:
+                self.add_store(_default_store_type())
+        self.sync_all_stores()
+
+    def delete(self, only_state: bool = False) -> None:
+        """(reference: Storage.delete, storage.py:940)"""
+        if not only_state:
+            for store in self.stores.values():
+                store.delete()
+        global_user_state.remove_storage(self.name)
+
+    # ---------------- consumption by the backend ----------------
+
+    def primary_store(self) -> AbstractStore:
+        assert self.stores, f'Storage {self.name!r} has no stores.'
+        for preferred in (StoreType.GCS, StoreType.LOCAL):
+            if preferred in self.stores:
+                return self.stores[preferred]
+        return next(iter(self.stores.values()))
+
+    def get_host_command(self, dst: str) -> str:
+        """The per-host bash that realizes this mount (reference: the
+        MOUNT/COPY branches of _execute_storage_mounts,
+        cloud_vm_ray_backend.py:4506)."""
+        store = self.primary_store()
+        if self.mode == StorageMode.MOUNT:
+            return store.mount_command(dst)
+        return store.copy_down_command(dst)
+
+    # ---------------- persistence / yaml ----------------
+
+    def _persist(self, status: StorageStatus) -> None:
+        global_user_state.add_or_update_storage(self.name, self.handle(),
+                                                status)
+
+    def handle(self) -> Dict[str, Any]:
+        """Pickle-safe metadata (reference: StorageMetadata,
+        storage.py:790)."""
+        return {
+            'name': self.name,
+            'source': self.source,
+            'mode': self.mode.value,
+            'persistent': self.persistent,
+            'store_types': [t.value for t in self.stores],
+        }
+
+    @classmethod
+    def from_metadata(cls, metadata: Dict[str, Any]) -> 'Storage':
+        """(reference: from_metadata, storage.py:822)"""
+        storage = cls(name=metadata['name'],
+                      source=metadata.get('source'),
+                      mode=StorageMode(metadata.get('mode', 'MOUNT')),
+                      persistent=metadata.get('persistent', True))
+        for type_name in metadata.get('store_types', []):
+            store_type = StoreType(type_name)
+            storage.stores[store_type] = _STORE_CLASSES[store_type](
+                storage.name, None)
+        return storage
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
+        """(reference: Storage.from_yaml_config, storage.py:1018)"""
+        from skypilot_tpu.utils import schemas
+        schemas.validate_storage(config)
+        storage = cls(
+            name=config.get('name'),
+            source=config.get('source'),
+            mode=StorageMode(config.get('mode', 'MOUNT').upper()),
+            persistent=config.get('persistent', True),
+        )
+        if config.get('store') is not None:
+            storage.add_store(config['store'])
+        return storage
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {'name': self.name}
+        if self.source is not None:
+            config['source'] = self.source
+        if self.mode != StorageMode.MOUNT:
+            config['mode'] = self.mode.value
+        if not self.persistent:
+            config['persistent'] = False
+        if self.stores:
+            config['store'] = self.primary_store().STORE_TYPE.value.lower()
+        return config
+
+    def __repr__(self) -> str:
+        return (f'Storage({self.name!r}, source={self.source!r}, '
+                f'mode={self.mode.value}, '
+                f'stores={list(self.stores)})')
+
+
+def _default_store_type() -> StoreType:
+    """LOCAL when the fake cloud is the only enabled cloud (hermetic
+    mode); GCS otherwise."""
+    enabled = global_user_state.get_enabled_clouds()
+    if enabled == ['fake']:
+        return StoreType.LOCAL
+    return StoreType.GCS

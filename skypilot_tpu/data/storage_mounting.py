@@ -1,0 +1,44 @@
+"""Realize a task's storage_mounts on every host of a cluster.
+
+Reference parity: the storage branch of the backend's file-mount stage
+(_execute_storage_mounts, sky/backends/cloud_vm_ray_backend.py:4506):
+client side creates/syncs the bucket, then each host runs the mount (FUSE)
+or copy-down command. Multi-host TPU slices mount on EVERY host — each
+host of a v5p slice sees the same checkpoint dir.
+"""
+from __future__ import annotations
+
+import logging
+import typing
+from typing import Any, Dict
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import subprocess_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.backends import cloud_tpu_backend
+
+logger = logging.getLogger(__name__)
+
+
+def mount_storage(handle: 'cloud_tpu_backend.CloudTpuResourceHandle',
+                  storage_mounts: Dict[str, Any]) -> None:
+    recs = handle.host_records()
+    for dst, storage in storage_mounts.items():
+        # Client side: bucket exists + local source uploaded.
+        storage.construct()
+
+        def _mount(rec, dst=dst, storage=storage):
+            runner = handle._make_runner(rec)  # pylint: disable=protected-access
+            rdst = handle.resolve_remote_path(rec, dst)
+            cmd = storage.get_host_command(rdst)
+            rc = runner.run(cmd, stream_logs=False)
+            if rc != 0:
+                raise exceptions.StorageError(
+                    f'Mounting {storage.name!r} at {dst!r} failed on host '
+                    f's{rec["slice"]}h{rec["host"]} (exit {rc}).')
+
+        subprocess_utils.run_in_parallel(_mount, recs)
+        logger.info('Storage %r %s at %s on %d hosts.', storage.name,
+                    'mounted' if storage.mode.value == 'MOUNT' else
+                    'copied', dst, len(recs))

@@ -94,6 +94,19 @@ SERVICE_SCHEMA: Dict[str, Any] = {
     },
 }
 
+STORAGE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': 'string'},
+        'source': {'type': 'string'},
+        'store': _case_insensitive_enum(['gcs', 'local']),
+        'mode': _case_insensitive_enum(['MOUNT', 'COPY']),
+        'persistent': {'type': 'boolean'},
+    },
+}
+
+
 TASK_SCHEMA: Dict[str, Any] = {
     'type': 'object',
     'additionalProperties': False,
@@ -176,11 +189,35 @@ CONFIG_SCHEMA: Dict[str, Any] = {
 def _validate(config: Dict[str, Any], schema: Dict[str, Any],
               what: str) -> None:
     import jsonschema
+
+    # Register the custom `case_insensitive_enum` keyword — plain
+    # jsonschema silently ignores unknown keywords (the reference extends
+    # its validator the same way, sky/utils/schemas.py).
+    def _check_ci_enum(validator, enum_values, instance, _schema):
+        del validator
+        lowered = [str(v).lower() for v in enum_values]
+        if not isinstance(instance, str) or \
+                instance.lower() not in lowered:
+            yield jsonschema.ValidationError(
+                f'{instance!r} is not one of {enum_values} '
+                '(case-insensitive)')
+
+    validator_cls = jsonschema.validators.extend(
+        jsonschema.validators.validator_for(schema),
+        {'case_insensitive_enum': _check_ci_enum})
     try:
-        jsonschema.validate(config, schema)
-    except jsonschema.ValidationError as e:
-        path = '.'.join(str(p) for p in e.absolute_path) or '<root>'
-        raise ValueError(f'Invalid {what} at {path}: {e.message}') from None
+        errors = sorted(validator_cls(schema).iter_errors(config),
+                        key=lambda e: list(e.absolute_path))
+        if errors:
+            e = errors[0]
+            path = '.'.join(str(p) for p in e.absolute_path) or '<root>'
+            raise ValueError(f'Invalid {what} at {path}: {e.message}')
+    except jsonschema.SchemaError as e:
+        raise ValueError(f'Bad schema for {what}: {e.message}') from None
+
+
+def validate_storage(config: Dict[str, Any]) -> None:
+    _validate(config, STORAGE_SCHEMA, 'storage spec')
 
 
 def validate_task(config: Dict[str, Any]) -> None:

@@ -1,0 +1,189 @@
+"""Data layer tests: Storage lifecycle on local:// buckets (same code
+path as GCS with filesystem transport), YAML round trip, command
+generation for the real GCS/gcsfuse path, and end-to-end MOUNT/COPY
+through the backend on the fake cloud — a checkpoint-dir write-through
+test the reference only covers in real-cloud smoke tests.
+"""
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import global_user_state
+from skypilot_tpu.data import (GcsStore, LocalStore, Storage, StorageMode,
+                               StorageStatus, StoreType)
+from skypilot_tpu.data import data_utils, mounting_utils
+
+
+@pytest.fixture(autouse=True)
+def storage_env(_isolate_state, tmp_path, monkeypatch):
+    global_user_state.set_enabled_clouds(['fake'])
+    monkeypatch.setenv('SKYTPU_FAKE_BUCKET_ROOT', str(tmp_path / 'buckets'))
+    yield
+
+
+class TestStorageObject:
+
+    def test_local_bucket_lifecycle(self, tmp_path):
+        src = tmp_path / 'data'
+        src.mkdir()
+        (src / 'a.txt').write_text('A')
+        storage = Storage(name='bkt-1', source=str(src))
+        storage.add_store(StoreType.LOCAL)
+        storage.sync_all_stores()
+        bucket_dir = data_utils.fake_bucket_dir('bkt-1')
+        assert (tmp_path / 'buckets' / 'bkt-1' / 'a.txt').exists()
+        assert os.path.isdir(bucket_dir)
+        records = core.storage_ls()
+        assert records[0]['name'] == 'bkt-1'
+        assert records[0]['status'] == StorageStatus.READY
+        core.storage_delete('bkt-1')
+        assert not os.path.exists(bucket_dir)
+        assert core.storage_ls() == []
+
+    def test_source_uri_infers_name(self):
+        storage = Storage(source='local://premade/sub')
+        assert storage.name == 'premade'
+        with pytest.raises(exceptions.StorageSpecError):
+            Storage(name='other', source='local://premade')
+
+    def test_scratch_bucket_no_source(self):
+        storage = Storage(name='scratch-ckpt')
+        storage.construct()
+        assert StoreType.LOCAL in storage.stores  # fake-only → LOCAL
+        assert os.path.isdir(data_utils.fake_bucket_dir('scratch-ckpt'))
+
+    def test_missing_local_source_raises(self):
+        with pytest.raises(exceptions.StorageSpecError, match='not exist'):
+            Storage(name='b', source='/nonexistent/path/xyz')
+
+    def test_bad_bucket_name(self):
+        with pytest.raises(exceptions.StorageSpecError, match='Invalid'):
+            Storage(name='UPPER_case!')
+
+    def test_yaml_round_trip(self, tmp_path):
+        src = tmp_path / 'd'
+        src.mkdir()
+        storage = Storage.from_yaml_config({
+            'name': 'bkt-yaml',
+            'source': str(src),
+            'mode': 'COPY',
+            'store': 'local',
+        })
+        assert storage.mode == StorageMode.COPY
+        config = storage.to_yaml_config()
+        assert config['mode'] == 'COPY'
+        assert config['store'] == 'local'
+        storage2 = Storage.from_yaml_config(config)
+        assert storage2.name == 'bkt-yaml'
+
+    def test_schema_rejects_bad_mode_and_store(self):
+        # Regression: the custom case_insensitive_enum keyword must be
+        # enforced, not silently ignored by jsonschema.
+        with pytest.raises(ValueError, match='Invalid storage spec'):
+            Storage.from_yaml_config({'name': 'b-1', 'mode': 'banana'})
+        with pytest.raises(ValueError, match='Invalid storage spec'):
+            Storage.from_yaml_config({'name': 'b-1', 'store': 'aws'})
+        # Case-insensitivity still works.
+        Storage.from_yaml_config({'name': 'b-ok', 'mode': 'mount'})
+
+    def test_metadata_round_trip(self, tmp_path):
+        src = tmp_path / 'd'
+        src.mkdir()
+        storage = Storage(name='bkt-meta', source=str(src),
+                          mode=StorageMode.COPY)
+        storage.add_store('local')
+        restored = Storage.from_metadata(storage.handle())
+        assert restored.name == 'bkt-meta'
+        assert restored.mode == StorageMode.COPY
+        assert StoreType.LOCAL in restored.stores
+
+
+class TestCommandGeneration:
+    """The real-GCS path, validated at the command-string level (shelling
+    to gcloud needs a cloud; the strings are the contract)."""
+
+    def test_gcsfuse_mount_cmd(self):
+        cmd = mounting_utils.get_gcsfuse_mount_cmd('my-bkt', '/ckpt')
+        assert 'gcsfuse' in cmd and 'my-bkt /ckpt' in cmd
+        assert '--implicit-dirs' in cmd
+        assert 'mkdir -p /ckpt' in cmd
+
+    def test_gcs_copy_down_cmd(self):
+        cmd = mounting_utils.get_copy_down_cmd('gs://my-bkt', '/data')
+        assert 'gcloud storage cp' in cmd and 'gsutil' in cmd
+
+    def test_gcs_store_url_and_mount(self):
+        store = GcsStore('gbkt')
+        assert store.url() == 'gs://gbkt'
+        assert 'gcsfuse' in store.mount_command('/mnt')
+
+    def test_local_symlink_mount(self, tmp_path):
+        store = LocalStore('lbkt')
+        cmd = store.mount_command(str(tmp_path / 'mnt'))
+        assert 'ln -sfn' in cmd
+
+
+@pytest.mark.slow
+class TestStorageEndToEnd:
+
+    def _launch(self, task, name='c1'):
+        job_id, _ = execution.launch(task, cluster_name=name,
+                                     quiet_optimizer=True, detach_run=True)
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            st = core.job_status(name, [job_id])[job_id]
+            if st in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP'):
+                return st
+            time.sleep(0.2)
+        raise AssertionError('job did not finish')
+
+    def test_mount_mode_write_through(self, tmp_path):
+        """The checkpoint contract: every host mounts the bucket; writes
+        are durable in the bucket after the job."""
+        task = sky.Task(name='ckpt-writer',
+                        run='echo step-100 > ~/ckpt/model.step')
+        task.set_resources({
+            sky.Resources(cloud='fake', accelerators='tpu-v5e-1')
+        })
+        task.set_storage_mounts(
+            {'~/ckpt': Storage(name='train-ckpts')})
+        assert self._launch(task) == 'SUCCEEDED'
+        bucket_dir = data_utils.fake_bucket_dir('train-ckpts')
+        with open(os.path.join(bucket_dir, 'model.step')) as f:
+            assert f.read().strip() == 'step-100'
+
+    def test_copy_mode_distributes_data(self, tmp_path):
+        src = tmp_path / 'dataset'
+        src.mkdir()
+        (src / 'shard0.txt').write_text('tokens')
+        task = sky.Task(name='reader', run='cat ~/data/shard0.txt')
+        task.set_resources({
+            sky.Resources(cloud='fake', accelerators='tpu-v5e-8')
+        })
+        task.set_storage_mounts({
+            '~/data':
+                Storage(name='dataset-bkt', source=str(src),
+                        mode=StorageMode.COPY)
+        })
+        assert self._launch(task) == 'SUCCEEDED'
+
+    def test_multihost_mount_all_hosts(self, tmp_path):
+        """v5e-32 = 4 hosts; every host writes its rank file into the
+        shared bucket."""
+        task = sky.Task(
+            name='multihost',
+            run='echo host-$SKYTPU_NODE_RANK > '
+                '~/shared/rank_$SKYTPU_NODE_RANK.txt')
+        task.set_resources({
+            sky.Resources(cloud='fake', accelerators='tpu-v5e-32')
+        })
+        task.set_storage_mounts({'~/shared': Storage(name='shared-bkt')})
+        assert self._launch(task, 'pod') == 'SUCCEEDED'
+        bucket_dir = data_utils.fake_bucket_dir('shared-bkt')
+        files = sorted(os.listdir(bucket_dir))
+        assert files == [f'rank_{i}.txt' for i in range(4)]
