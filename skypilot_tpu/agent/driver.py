@@ -109,20 +109,40 @@ class GangRun:
         self._rcs: List[Optional[int]] = [None] * len(spec['hosts'])
         self._lock = threading.Lock()
         self._failed = threading.Event()
+        self._mux = None
         self._combined = open(os.path.join(log_dir, 'run.log'), 'a',
                               buffering=1, encoding='utf-8')
 
     def _pump(self, rank: int, proc, prefix: str) -> None:
+        """Pure-Python fallback pump (one thread per rank)."""
         rank_log = os.path.join(self.log_dir, f'rank-{rank}.log')
         with open(rank_log, 'a', buffering=1, encoding='utf-8') as rf:
             for line in proc.stdout:
                 rf.write(line)
                 with self._lock:
                     self._combined.write(prefix + line)
+        self._reap(rank, proc)
+
+    def _reap(self, rank: int, proc) -> None:
         rc = proc.wait()
         self._rcs[rank] = rc
         if rc != 0:
             self._failed.set()
+
+    def _make_mux(self):
+        """Native fan-in (skypilot_tpu/native/logmux.cpp): one C++ thread
+        pumps every rank's pipe — the Ray-C++-replacement hot path
+        (SURVEY §2.10). None → per-rank Python threads."""
+        if os.environ.get('SKYTPU_DISABLE_NATIVE_LOGMUX') == '1':
+            return None
+        try:
+            from skypilot_tpu.native import logmux as logmux_lib
+            if logmux_lib.load_logmux_library() is None:
+                return None
+            return logmux_lib.LogMux(
+                os.path.join(self.log_dir, 'run.log'))
+        except Exception:  # pylint: disable=broad-except
+            return None
 
     def _cancel_stragglers(self) -> None:
         for rank, host in enumerate(self.spec['hosts']):
@@ -153,6 +173,7 @@ class GangRun:
     def run(self, cmd: str, base_env: Dict[str, str]) -> List[int]:
         hosts = self.spec['hosts']
         many = len(hosts) > 1
+        mux = self._make_mux()
         threads = []
         for rank, host in enumerate(hosts):
             env = dict(base_env)
@@ -162,10 +183,19 @@ class GangRun:
             proc = runner.popen(cmd, env=env)
             self._procs[rank] = proc
             prefix = f'(rank {rank}) ' if many else ''
-            t = threading.Thread(target=self._pump,
-                                 args=(rank, proc, prefix), daemon=True)
+            rank_log = os.path.join(self.log_dir, f'rank-{rank}.log')
+            if mux is not None:
+                mux.add_stream(proc.stdout.fileno(), rank_log, prefix)
+                t = threading.Thread(target=self._reap, args=(rank, proc),
+                                     daemon=True)
+            else:
+                t = threading.Thread(target=self._pump,
+                                     args=(rank, proc, prefix), daemon=True)
             t.start()
             threads.append(t)
+        if mux is not None:
+            mux.start()
+            self._mux = mux
         # Wait; on first failure cancel the rest (poll so we can react
         # before slow ranks finish).
         cancelled = False
@@ -190,6 +220,25 @@ class GangRun:
                         pass
             for t in threads:
                 t.join(timeout=5.0)
+        if self._mux is not None:
+            if cancelled:
+                # Orphans may hold pipe write-ends open forever; tell the
+                # native thread to stop at its next poll tick instead of
+                # waiting for EOFs that may never come. fds are closed only
+                # AFTER the join below (closing first would race the
+                # polling thread).
+                self._mux.stop()
+            # Drain the native mux so run.log is complete before the job
+            # status flips (tail_logs stops at terminal status).
+            self._mux.wait()
+            self._mux.close()
+            self._mux = None
+            for proc in self._procs:
+                if proc is not None and proc.stdout is not None:
+                    try:
+                        proc.stdout.close()
+                    except OSError:
+                        pass
         self._combined.flush()
         return [rc if rc is not None else 137 for rc in self._rcs]
 

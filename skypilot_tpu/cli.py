@@ -1,0 +1,530 @@
+"""The `skytpu` CLI.
+
+Reference parity: sky/cli.py (5,256 LoC, 32 click commands — SURVEY §2.1).
+Same command surface, TPU-native semantics: `launch, exec, status, queue,
+logs, cancel, stop, start, down, autostop, cost-report, check, show-tpus,
+storage ls/delete, jobs launch/queue/cancel/logs, serve up/status/down/
+logs`. Entry: `python -m skypilot_tpu.cli` (or the `skytpu` script).
+
+YAML-or-inline entrypoint parsing and resource override flags mirror
+cli.py:690,463; interactive confirm mirrors :532.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+import click
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+
+
+def _fail(message: str) -> None:
+    click.secho(f'Error: {message}', fg='red', err=True)
+    sys.exit(1)
+
+
+def _make_task(entrypoint: tuple, name: Optional[str],
+               workdir: Optional[str], cloud: Optional[str],
+               region: Optional[str], zone: Optional[str],
+               accelerators: Optional[str], num_slices: Optional[int],
+               use_spot: Optional[bool], env: tuple,
+               ports: tuple) -> 'sky.Task':
+    """YAML-file-or-inline-command entrypoint (reference:
+    _make_task_or_dag_from_entrypoint_with_overrides, cli.py:690)."""
+    entry = ' '.join(entrypoint)
+    is_yaml = entry.endswith(('.yaml', '.yml')) and os.path.exists(
+        os.path.expanduser(entry))
+    if is_yaml:
+        task = sky.Task.from_yaml(entry)
+    else:
+        if not entry:
+            _fail('ENTRYPOINT required: a task YAML or an inline command.')
+        task = sky.Task(run=entry)
+    if name is not None:
+        task.name = name
+    if workdir is not None:
+        task.workdir = workdir
+    task.update_envs([e.split('=', 1) if '=' in e else (e, '')
+                      for e in env])
+
+    overrides: Dict[str, Any] = {}
+    if cloud is not None:
+        overrides['cloud'] = cloud
+    if region is not None:
+        overrides['region'] = region
+    if zone is not None:
+        overrides['zone'] = zone
+    if accelerators is not None:
+        overrides['accelerators'] = accelerators
+    if num_slices is not None:
+        overrides['num_slices'] = num_slices
+    if use_spot is not None:
+        overrides['use_spot'] = use_spot
+    if ports:
+        overrides['ports'] = list(ports)
+    if overrides:
+        if task.resources:
+            task.set_resources(
+                {r.copy(**overrides) for r in task.resources})
+        else:
+            task.set_resources({sky.Resources(**overrides)})
+    elif not task.resources:
+        task.set_resources({sky.Resources()})
+    return task
+
+
+def _confirm(prompt: str, yes: bool) -> None:
+    if not yes and not click.confirm(prompt, default=True):
+        sys.exit(0)
+
+
+def _print_table(rows: List[List[str]], headers: List[str]) -> None:
+    widths = [
+        max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows else
+        len(str(h)) for i, h in enumerate(headers)
+    ]
+    line = '  '.join(h.ljust(w) for h, w in zip(headers, widths))
+    click.secho(line, bold=True)
+    for row in rows:
+        click.echo('  '.join(
+            str(c).ljust(w) for c, w in zip(row, widths)))
+
+
+_TASK_OPTIONS = [
+    click.option('--name', '-n', default=None, help='Task/cluster name.'),
+    click.option('--workdir', default=None,
+                 help='Directory synced to every host.'),
+    click.option('--cloud', default=None, help='gcp | kubernetes | fake.'),
+    click.option('--region', default=None),
+    click.option('--zone', default=None),
+    click.option('--accelerators', '--gpus', '--tpus', 'accelerators',
+                 default=None,
+                 help='TPU slice, e.g. tpu-v5e-8 or tpu-v5p-64.'),
+    click.option('--num-slices', type=int, default=None,
+                 help='Multislice: number of slices (DCN-connected).'),
+    click.option('--use-spot/--no-use-spot', default=None,
+                 help='Preemptible capacity.'),
+    click.option('--env', multiple=True, help='KEY=VALUE (repeatable).'),
+    click.option('--ports', multiple=True, help='Ports to open.'),
+]
+
+
+def _with_task_options(fn):
+    for option in reversed(_TASK_OPTIONS):
+        fn = option(fn)
+    return fn
+
+
+@click.group()
+@click.version_option(sky.__version__, prog_name='skytpu')
+def cli() -> None:
+    """skytpu: launch, manage, and serve TPU workloads."""
+
+
+# ---------------- core lifecycle ----------------
+
+
+@cli.command()
+@click.argument('entrypoint', nargs=-1)
+@_with_task_options
+@click.option('--cluster', '-c', default=None, help='Cluster to (re)use.')
+@click.option('--dryrun', is_flag=True, default=False)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--down', is_flag=True, default=False,
+              help='Tear down when the job finishes.')
+@click.option('--retry-until-up', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def launch(entrypoint, name, workdir, cloud, region, zone, accelerators,
+           num_slices, use_spot, env, ports, cluster, dryrun, detach_run,
+           idle_minutes_to_autostop, down, retry_until_up, yes):
+    """Provision a TPU slice (with failover) and run ENTRYPOINT on it."""
+    task = _make_task(entrypoint, name, workdir, cloud, region, zone,
+                      accelerators, num_slices, use_spot, env, ports)
+    cluster = cluster or task.name
+    if not dryrun:
+        _confirm(f'Launching on cluster {cluster!r}. Proceed?', yes)
+    try:
+        job_id, handle = sky.launch(
+            task, cluster_name=cluster, dryrun=dryrun,
+            detach_run=detach_run, down=down,
+            idle_minutes_to_autostop=idle_minutes_to_autostop,
+            retry_until_up=retry_until_up)
+    except (exceptions.ResourcesUnavailableError, ValueError) as e:
+        _fail(str(e))
+    if dryrun:
+        return
+    click.echo(f'Job {job_id} on cluster {handle.cluster_name!r}.')
+
+
+@cli.command('exec')
+@click.argument('cluster')
+@click.argument('entrypoint', nargs=-1)
+@click.option('--env', multiple=True)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+def exec_cmd(cluster, entrypoint, env, detach_run):
+    """Fast path: run ENTRYPOINT on an existing cluster (no provision)."""
+    task = _make_task(entrypoint, None, None, None, None, None, None, None,
+                      None, env, ())
+    try:
+        job_id, _ = sky.exec(task, cluster_name=cluster,
+                             detach_run=detach_run)
+    except exceptions.ClusterNotUpError as e:
+        _fail(str(e))
+    click.echo(f'Job {job_id} submitted to {cluster!r}.')
+
+
+@cli.command()
+@click.option('--refresh', '-r', is_flag=True, default=False,
+              help='Reconcile with cloud state first.')
+def status(refresh):
+    """Cluster table (reference: sky status, cli.py:1507)."""
+    records = sky.status(refresh=refresh)
+    if not records:
+        click.echo('No clusters.')
+        return
+    rows = []
+    for r in records:
+        handle = r['handle']
+        resources = (str(handle.launched_resources)
+                     if handle is not None else '-')
+        rows.append([
+            r['name'], r['status'].value, resources,
+            r.get('autostop', -1) if r.get('autostop', -1) >= 0 else '-'
+        ])
+    _print_table(rows, ['NAME', 'STATUS', 'RESOURCES', 'AUTOSTOP(min)'])
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--skip-finished', '-s', is_flag=True, default=False)
+def queue(cluster, skip_finished):
+    """Job queue of a cluster."""
+    try:
+        jobs = sky.queue(cluster, skip_finished=skip_finished)
+    except exceptions.ClusterNotUpError as e:
+        _fail(str(e))
+    rows = [[j['job_id'], j.get('job_name') or '-', j['status'],
+             j.get('submitted_at') or '-'] for j in jobs]
+    _print_table(rows, ['ID', 'NAME', 'STATUS', 'SUBMITTED'])
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', type=int, required=False)
+@click.option('--no-follow', is_flag=True, default=False)
+def logs(cluster, job_id, no_follow):
+    """Stream a job's combined (rank-prefixed) log."""
+    try:
+        sys.exit(sky.tail_logs(cluster, job_id, follow=not no_follow))
+    except (exceptions.ClusterNotUpError, exceptions.JobNotFoundError) as e:
+        _fail(str(e))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_ids', type=int, nargs=-1)
+@click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def cancel(cluster, job_ids, all_jobs, yes):
+    """Cancel jobs on a cluster."""
+    if not job_ids and not all_jobs:
+        _fail('Specify JOB_IDS or --all.')
+    _confirm(f'Cancel jobs on {cluster!r}?', yes)
+    cancelled = sky.cancel(cluster, list(job_ids) or None,
+                           all_jobs=all_jobs)
+    click.echo(f'Cancelled: {cancelled or "none"}')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def stop(clusters, yes):
+    """Stop clusters (single-host, on-demand only — TPU pods/spot must
+    use `down`; reference: clouds/gcp.py:184-190)."""
+    _confirm(f'Stop {", ".join(clusters)}?', yes)
+    for cluster in clusters:
+        try:
+            sky.stop(cluster)
+            click.echo(f'Stopped {cluster!r}.')
+        except (exceptions.NotSupportedError,
+                exceptions.ClusterNotUpError) as e:
+            _fail(str(e))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--retry-until-up', is_flag=True, default=False)
+def start(cluster, retry_until_up):
+    """Restart a stopped cluster."""
+    try:
+        sky.start(cluster, retry_until_up=retry_until_up)
+    except exceptions.SkyTpuError as e:
+        _fail(str(e))
+    click.echo(f'Cluster {cluster!r} is UP.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+@click.option('--purge', is_flag=True, default=False,
+              help='Remove state even if the cloud call fails.')
+def down(clusters, yes, purge):
+    """Terminate clusters (TPU slices are deleted, not stopped)."""
+    _confirm(f'Terminate {", ".join(clusters)}?', yes)
+    for cluster in clusters:
+        try:
+            sky.down(cluster, purge=purge)
+            click.echo(f'Terminated {cluster!r}.')
+        except exceptions.SkyTpuError as e:
+            _fail(str(e))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, default=None)
+@click.option('--cancel', 'cancel_autostop', is_flag=True, default=False)
+@click.option('--down', 'autodown', is_flag=True, default=False)
+def autostop(cluster, idle_minutes, cancel_autostop, autodown):
+    """Arm/disarm idleness autostop for a cluster."""
+    if cancel_autostop:
+        idle_minutes = -1
+    elif idle_minutes is None:
+        idle_minutes = 5
+    try:
+        sky.autostop(cluster, idle_minutes, down=autodown)
+    except exceptions.SkyTpuError as e:
+        _fail(str(e))
+    state = 'disarmed' if idle_minutes < 0 else f'{idle_minutes} min'
+    click.echo(f'Autostop for {cluster!r}: {state}.')
+
+
+@cli.command('cost-report')
+def cost_report():
+    """Accumulated cost per cluster (reference: cli.py cost-report)."""
+    rows = []
+    for r in sky.cost_report():
+        hours = r['duration'] / 3600
+        rows.append([
+            r['name'], r['status'].value if r['status'] else 'TERMINATED',
+            str(r['launched_resources'] or '-'), f'{hours:.1f}h',
+            f"${r['total_cost']:.2f}"
+        ])
+    _print_table(rows, ['NAME', 'STATUS', 'RESOURCES', 'DURATION', 'COST'])
+
+
+@cli.command()
+def check():
+    """Probe cloud credentials; cache the enabled-cloud list."""
+    # Not sky.check(): the skypilot_tpu.check SUBMODULE shadows the lazy
+    # function attr once imported (optimizer imports it).
+    from skypilot_tpu import check as check_lib
+    enabled = check_lib.check()
+    if not enabled:
+        _fail('No cloud is enabled. Configure GCP credentials or a '
+              'kubeconfig, then rerun `skytpu check`.')
+    click.echo(f'Enabled clouds: {", ".join(enabled)}')
+
+
+@cli.command('show-tpus')
+@click.option('--all', '-a', 'show_all', is_flag=True, default=False)
+def show_tpus(show_all):
+    """TPU catalog: generations, slice shapes, pricing (reference:
+    show-gpus, cli.py:2332)."""
+    from skypilot_tpu import catalog
+    rows = []
+    for name, offerings in sorted(catalog.list_accelerators().items()):
+        best = min(offerings, key=lambda o: o.price or 1e9)
+        if not show_all and best.hosts > 16:
+            continue
+        rows.append([
+            name, best.chips, best.hosts, best.topology,
+            f'${best.price:.2f}' if best.price else '-',
+            f'${best.spot_price:.2f}' if best.spot_price else '-',
+            len(offerings),
+        ])
+    _print_table(rows, [
+        'ACCELERATOR', 'CHIPS', 'HOSTS', 'TOPOLOGY', '$/HR', 'SPOT$/HR',
+        'ZONES'
+    ])
+
+
+# ---------------- storage ----------------
+
+
+@cli.group()
+def storage():
+    """Bucket storage objects."""
+
+
+@storage.command('ls')
+def storage_ls():
+    rows = [[s['name'], s['status'].value,
+             s['handle']['source'] if s['handle'] else '-']
+            for s in sky.storage_ls()]
+    _print_table(rows, ['NAME', 'STATUS', 'SOURCE'])
+
+
+@storage.command('delete')
+@click.argument('names', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def storage_delete(names, yes):
+    _confirm(f'Delete storage {", ".join(names)}?', yes)
+    for name in names:
+        try:
+            sky.storage_delete(name)
+            click.echo(f'Deleted {name!r}.')
+        except exceptions.StorageError as e:
+            _fail(str(e))
+
+
+# ---------------- managed jobs ----------------
+
+
+@cli.group()
+def jobs():
+    """Managed jobs: auto-recovering (spot-friendly) jobs."""
+
+
+@jobs.command('launch')
+@click.argument('entrypoint', nargs=-1)
+@_with_task_options
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_launch(entrypoint, name, workdir, cloud, region, zone,
+                accelerators, num_slices, use_spot, env, ports, yes):
+    """Launch a managed job (provision + monitor + recover)."""
+    task = _make_task(entrypoint, name, workdir, cloud, region, zone,
+                      accelerators, num_slices, use_spot, env, ports)
+    _confirm(f'Launching managed job {task.name!r}. Proceed?', yes)
+    job_id = sky.jobs.launch(task, name=task.name)
+    click.echo(f'Managed job {job_id} submitted. '
+               f'`skytpu jobs logs {job_id}` to stream.')
+
+
+@jobs.command('queue')
+@click.option('--skip-finished', '-s', is_flag=True, default=False)
+def jobs_queue(skip_finished):
+    records = sky.jobs.queue(skip_finished=skip_finished)
+    rows = [[
+        r['job_id'], r['task_id'], r['job_name'] or '-',
+        r['status'].value, r['recovery_count'],
+        r['cluster_name'] or '-'
+    ] for r in records]
+    _print_table(
+        rows, ['ID', 'TASK', 'NAME', 'STATUS', 'RECOVERIES', 'CLUSTER'])
+
+
+@jobs.command('cancel')
+@click.argument('job_ids', type=int, nargs=-1)
+@click.option('--name', '-n', default=None)
+@click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_cancel(job_ids, name, all_jobs, yes):
+    _confirm('Cancel managed jobs?', yes)
+    try:
+        cancelled = sky.jobs.cancel(name=name,
+                                    job_ids=list(job_ids) or None,
+                                    all_jobs=all_jobs)
+    except (ValueError, exceptions.JobNotFoundError) as e:
+        _fail(str(e))
+    click.echo(f'Cancel signal sent: {cancelled or "none"}')
+
+
+@jobs.command('logs')
+@click.argument('job_id', type=int, required=False)
+@click.option('--name', '-n', default=None)
+@click.option('--controller', is_flag=True, default=False)
+@click.option('--no-follow', is_flag=True, default=False)
+def jobs_logs(job_id, name, controller, no_follow):
+    try:
+        sys.exit(
+            sky.jobs.tail_logs(name=name, job_id=job_id,
+                               follow=not no_follow,
+                               controller=controller))
+    except (exceptions.JobNotFoundError, ValueError) as e:
+        _fail(str(e))
+
+
+# ---------------- serve ----------------
+
+
+@cli.group()
+def serve():
+    """Serve: autoscaled replica fleets behind a load balancer."""
+
+
+@serve.command('up')
+@click.argument('entrypoint', nargs=-1)
+@click.option('--service-name', '-n', default=None)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_up(entrypoint, service_name, yes):
+    """Bring up a service from a task YAML with a `service:` section."""
+    task = _make_task(entrypoint, None, None, None, None, None, None, None,
+                      None, (), ())
+    if task.service is None:
+        _fail('Task YAML needs a `service:` section for serve up.')
+    _confirm(f'Starting service {service_name or task.name!r}. Proceed?',
+             yes)
+    try:
+        result = sky.serve.up(task, service_name)
+    except (ValueError, exceptions.ServeUserTerminatedError) as e:
+        _fail(str(e))
+    click.echo(f"Service {result['name']!r} starting; endpoint: "
+               f"{result['endpoint']}")
+
+
+@serve.command('status')
+@click.argument('service_name', required=False)
+def serve_status(service_name):
+    records = sky.serve.status(service_name)
+    if not records:
+        click.echo('No services.')
+        return
+    for r in records:
+        click.secho(f"{r['name']}  [{r['status'].value}]  "
+                    f"endpoint: {r['endpoint'] or '-'}", bold=True)
+        rows = [[i['replica_id'], i['status'], i['url'] or '-',
+                 'spot' if i['is_spot'] else 'on-demand', i['version']]
+                for i in r['replica_info']]
+        _print_table(rows,
+                     ['REPLICA', 'STATUS', 'URL', 'CAPACITY', 'VERSION'])
+
+
+@serve.command('down')
+@click.argument('service_names', nargs=-1, required=True)
+@click.option('--purge', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_down(service_names, purge, yes):
+    _confirm(f'Tear down {", ".join(service_names)}?', yes)
+    for service_name in service_names:
+        try:
+            sky.serve.down(service_name, purge=purge)
+            click.echo(f'Service {service_name!r} torn down.')
+        except exceptions.ServeUserTerminatedError as e:
+            _fail(str(e))
+
+
+@serve.command('logs')
+@click.argument('service_name')
+@click.option('--replica-id', type=int, default=None)
+def serve_logs(service_name, replica_id):
+    try:
+        sys.exit(
+            sky.serve.tail_logs(
+                service_name,
+                target='replica' if replica_id is not None else
+                'controller',
+                replica_id=replica_id))
+    except exceptions.ServeUserTerminatedError as e:
+        _fail(str(e))
+
+
+def main() -> None:
+    cli()  # pylint: disable=no-value-for-parameter
+
+
+if __name__ == '__main__':
+    main()

@@ -5,8 +5,14 @@ command builders with install-and-retry wrapper scripts
 (mounting_utils.py:25-80). GCS-first: gcsfuse is the only FUSE binary
 (SURVEY §2.10); local:// buckets "mount" as symlinks, which is what makes
 MOUNT-mode storage testable without FUSE or a cloud.
+
+All interpolated paths are shell-quoted; mounts never delete existing
+data — a non-empty destination fails the mount loudly (real FUSE shadows
+a non-empty dir; it never destroys it).
 """
 from __future__ import annotations
+
+import shlex
 
 GCSFUSE_VERSION = '2.4.0'
 
@@ -25,35 +31,43 @@ def get_gcsfuse_mount_cmd(bucket_name: str, mount_path: str,
     """(reference: mounting_utils.py GCS branch)"""
     flags = '--implicit-dirs ' if implicit_dirs else ''
     install = _GCSFUSE_INSTALL.format(version=GCSFUSE_VERSION)
+    mnt = shlex.quote(mount_path)
     return (f'{install} && '
-            f'mkdir -p {mount_path} && '
-            f'mountpoint -q {mount_path} || '
-            f'gcsfuse {flags}{bucket_name} {mount_path}')
+            f'mkdir -p {mnt} && '
+            f'{{ mountpoint -q {mnt} || '
+            f'gcsfuse {flags}{shlex.quote(bucket_name)} {mnt}; }}')
 
 
 def get_gcsfuse_unmount_cmd(mount_path: str) -> str:
-    return (f'mountpoint -q {mount_path} && '
-            f'fusermount -u {mount_path} || true')
+    mnt = shlex.quote(mount_path)
+    return (f'mountpoint -q {mnt} && fusermount -u {mnt} || true')
 
 
 def get_local_symlink_mount_cmd(bucket_dir: str, mount_path: str) -> str:
     """local:// buckets: a symlink IS a mount — writes land in the bucket
-    dir immediately, exactly like FUSE semantics."""
-    return (f'mkdir -p {bucket_dir} && '
-            f'mkdir -p $(dirname {mount_path}) && '
-            f'rm -rf {mount_path} && '
-            f'ln -sfn {bucket_dir} {mount_path}')
+    dir immediately, like FUSE semantics. Replaces an existing symlink
+    (remount) and removes an existing EMPTY dir; a non-empty dir fails
+    loudly (rmdir refuses) rather than destroying data."""
+    bkt = shlex.quote(bucket_dir)
+    mnt = shlex.quote(mount_path)
+    return (f'mkdir -p {bkt} && '
+            f'mkdir -p "$(dirname {mnt})" && '
+            f'{{ [ -L {mnt} ] || [ ! -e {mnt} ] || rmdir {mnt}; }} && '
+            f'ln -sfn {bkt} {mnt}')
 
 
 def get_copy_down_cmd(store_url: str, dst: str) -> str:
     """COPY-mode download command for one host (reference: the
     CloudStorage download interfaces, sky/cloud_stores.py)."""
+    quoted_dst = shlex.quote(dst)
     if store_url.startswith('gs://'):
-        return (f'mkdir -p {dst} && '
-                f'(gcloud storage cp -r "{store_url}/*" {dst}/ 2>/dev/null '
-                f'|| gsutil -m cp -r "{store_url}/*" {dst}/)')
+        src_glob = shlex.quote(store_url + '/*')
+        return (f'mkdir -p {quoted_dst} && '
+                f'(gcloud storage cp -r {src_glob} {quoted_dst}/ '
+                f'2>/dev/null || gsutil -m cp -r {src_glob} '
+                f'{quoted_dst}/)')
     from skypilot_tpu.data import data_utils
     bucket, _ = data_utils.split_local_bucket_path(store_url)
-    bucket_dir = data_utils.fake_bucket_dir(bucket)
-    return (f'mkdir -p {dst} && '
-            f'cp -a {bucket_dir}/. {dst}/')
+    bucket_dir = shlex.quote(data_utils.fake_bucket_dir(bucket))
+    return (f'mkdir -p {quoted_dst} && '
+            f'cp -a {bucket_dir}/. {quoted_dst}/')

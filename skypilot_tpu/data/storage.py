@@ -209,9 +209,20 @@ class Storage:
         - name only: an empty "scratch" bucket (checkpoints land here).
         """
         if source is not None and data_utils.is_cloud_uri(source):
-            bucket = (data_utils.split_gcs_path(source)[0]
-                      if source.startswith(data_utils.GCS_PREFIX) else
-                      data_utils.split_local_bucket_path(source)[0])
+            bucket, key = (
+                data_utils.split_gcs_path(source)
+                if source.startswith(data_utils.GCS_PREFIX) else
+                data_utils.split_local_bucket_path(source))
+            if key:
+                # Silently mounting/copying the WHOLE bucket when the user
+                # named a prefix would read wrong data; prefixes belong in
+                # plain file_mounts (dst: gs://bucket/prefix), which
+                # download exactly the prefix.
+                raise exceptions.StorageSpecError(
+                    f'Storage source {source!r} has an object prefix; '
+                    f'storage mounts operate on whole buckets. Use a '
+                    f'plain file mount for a prefix, or source='
+                    f'{source.split("://")[0]}://{bucket}.')
             if name is not None and name != bucket:
                 raise exceptions.StorageSpecError(
                     f'name {name!r} conflicts with bucket URI {source!r}')

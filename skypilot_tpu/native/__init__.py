@@ -1,0 +1,11 @@
+"""Native components (C++), loaded via ctypes with pure-Python fallbacks.
+
+The reference delegates its native-performance needs to Ray's C++ core
+(SURVEY §2.10); this framework ships its own. Components build on demand
+with g++ (present on dev boxes and TPU VM images) and cache next to the
+source; every consumer has a Python fallback, so a box without a compiler
+still works — just slower on the hot paths.
+"""
+from skypilot_tpu.native.logmux import LogMux, load_logmux_library
+
+__all__ = ['LogMux', 'load_logmux_library']

@@ -1,0 +1,219 @@
+// logmux: high-throughput fan-in of N rank output streams.
+//
+// The TPU-native replacement for the role Ray's C++ core plays in the
+// reference's log path (SURVEY §2.10: log streaming is a Ray-internal hot
+// loop there). One native thread poll()s every rank's pipe, splits lines,
+// and writes (a) the rank's own log file and (b) a combined, prefixed
+// stream — no GIL, no per-line Python locking. The gang driver
+// (skypilot_tpu/agent/driver.py) loads this via ctypes and falls back to
+// pure-Python threads when the library isn't built.
+//
+// C ABI:
+//   logmux_create(combined_path)            -> handle
+//   logmux_add_stream(h, fd, rank_path, prefix) -> stream index
+//   logmux_start(h)                          -> 0 ok (spawns the thread)
+//   logmux_wait(h)                           -> blocks until all EOF
+//   logmux_lines(h)                          -> total lines muxed
+//   logmux_destroy(h)
+//
+// Lines longer than 1 MiB are flushed in chunks (prefix appears once).
+
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr size_t kReadChunk = 1 << 16;     // 64 KiB per read()
+constexpr size_t kMaxCarry = 1 << 20;      // 1 MiB partial-line cap
+
+struct Stream {
+  int fd = -1;
+  int rank_fd = -1;
+  std::string prefix;
+  std::string carry;  // partial line accumulated across reads
+  bool eof = false;
+};
+
+struct Mux {
+  std::vector<Stream> streams;
+  int combined_fd = -1;
+  pthread_t thread{};
+  bool started = false;
+  std::atomic<bool> stop{false};
+  long lines = 0;
+};
+
+void write_all(int fd, const char* buf, size_t n) {
+  while (n > 0) {
+    ssize_t w = write(fd, buf, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return;  // best-effort: a closed log target must not kill the mux
+    }
+    buf += w;
+    n -= static_cast<size_t>(w);
+  }
+}
+
+// Emit [data, data+n): rank file gets it verbatim; the combined fd gets
+// only COMPLETE lines (prefixed), so concurrent ranks never interleave
+// mid-line — the same guarantee the Python line-reader gives.
+void emit(Mux* m, Stream* s, const char* data, size_t n) {
+  write_all(s->rank_fd, data, n);
+  s->carry.append(data, n);
+  size_t start = 0;
+  while (true) {
+    size_t nl = s->carry.find('\n', start);
+    if (nl == std::string::npos) break;
+    if (!s->prefix.empty()) {
+      write_all(m->combined_fd, s->prefix.data(), s->prefix.size());
+    }
+    write_all(m->combined_fd, s->carry.data() + start, nl - start + 1);
+    m->lines++;
+    start = nl + 1;
+  }
+  s->carry.erase(0, start);
+  if (s->carry.size() > kMaxCarry) {
+    // Pathological no-newline stream: force-flush with a synthesized
+    // newline so memory stays bounded (rank file still has exact bytes).
+    if (!s->prefix.empty()) {
+      write_all(m->combined_fd, s->prefix.data(), s->prefix.size());
+    }
+    write_all(m->combined_fd, s->carry.data(), s->carry.size());
+    write_all(m->combined_fd, "\n", 1);
+    m->lines++;
+    s->carry.clear();
+  }
+}
+
+void flush_carry(Mux* m, Stream* s) {
+  if (s->carry.empty()) return;
+  if (!s->prefix.empty()) {
+    write_all(m->combined_fd, s->prefix.data(), s->prefix.size());
+  }
+  write_all(m->combined_fd, s->carry.data(), s->carry.size());
+  write_all(m->combined_fd, "\n", 1);
+  m->lines++;
+  s->carry.clear();
+}
+
+void* pump_loop(void* arg) {
+  Mux* m = static_cast<Mux*>(arg);
+  std::vector<char> buf(kReadChunk);
+  while (!m->stop.load(std::memory_order_relaxed)) {
+    std::vector<pollfd> fds;
+    std::vector<size_t> idx;
+    for (size_t i = 0; i < m->streams.size(); i++) {
+      if (!m->streams[i].eof) {
+        fds.push_back({m->streams[i].fd, POLLIN, 0});
+        idx.push_back(i);
+      }
+    }
+    if (fds.empty()) break;
+    int rv = poll(fds.data(), fds.size(), 200 /* ms */);
+    if (rv < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    for (size_t j = 0; j < fds.size(); j++) {
+      Stream* s = &m->streams[idx[j]];
+      if (fds[j].revents & POLLNVAL) {
+        // fd closed out from under us: treat as EOF (the Python side
+        // should call logmux_stop first, but never spin on it).
+        flush_carry(m, s);
+        s->eof = true;
+        continue;
+      }
+      if (!(fds[j].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      ssize_t r = read(s->fd, buf.data(), buf.size());
+      if (r > 0) {
+        emit(m, s, buf.data(), static_cast<size_t>(r));
+      } else if (r == 0 || (r < 0 && errno != EINTR && errno != EAGAIN)) {
+        // EOF or hard error (incl. EBADF): flush any unterminated final
+        // line so the next rank's line starts clean, then retire.
+        flush_carry(m, s);
+        s->eof = true;
+      }
+    }
+  }
+  // Stopped early (cancellation): flush partials so nothing is lost.
+  for (auto& s : m->streams) {
+    if (!s.eof) flush_carry(m, &s);
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* logmux_create(const char* combined_path) {
+  Mux* m = new Mux();
+  m->combined_fd = open(combined_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (m->combined_fd < 0) {
+    delete m;
+    return nullptr;
+  }
+  return m;
+}
+
+int logmux_add_stream(void* handle, int fd, const char* rank_log_path,
+                      const char* prefix) {
+  Mux* m = static_cast<Mux*>(handle);
+  if (m->started) return -1;
+  Stream s;
+  s.fd = fd;
+  s.rank_fd = open(rank_log_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (s.rank_fd < 0) return -1;
+  s.prefix = prefix ? prefix : "";
+  m->streams.push_back(std::move(s));
+  return static_cast<int>(m->streams.size()) - 1;
+}
+
+int logmux_start(void* handle) {
+  Mux* m = static_cast<Mux*>(handle);
+  if (m->started) return -1;
+  m->started = true;
+  return pthread_create(&m->thread, nullptr, pump_loop, m);
+}
+
+// Ask the pump thread to exit at its next poll tick (≤200 ms). Call
+// before closing stream fds from another thread — joining first avoids
+// both the POLLNVAL spin and cross-thread fd-reuse races.
+void logmux_stop(void* handle) {
+  static_cast<Mux*>(handle)->stop.store(true, std::memory_order_relaxed);
+}
+
+void logmux_wait(void* handle) {
+  Mux* m = static_cast<Mux*>(handle);
+  if (m->started) {
+    pthread_join(m->thread, nullptr);
+    m->started = false;
+  }
+}
+
+long logmux_lines(void* handle) {
+  return static_cast<Mux*>(handle)->lines;
+}
+
+void logmux_destroy(void* handle) {
+  Mux* m = static_cast<Mux*>(handle);
+  logmux_wait(m);
+  for (auto& s : m->streams) {
+    if (s.rank_fd >= 0) close(s.rank_fd);
+  }
+  if (m->combined_fd >= 0) close(m->combined_fd);
+  delete m;
+}
+
+}  // extern "C"

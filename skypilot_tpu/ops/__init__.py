@@ -1,3 +1,9 @@
 from skypilot_tpu.ops.flash_attention import flash_attention
+from skypilot_tpu.ops.ring_attention import (ring_attention,
+                                             ring_attention_ambient,
+                                             ring_attention_sharded)
 
-__all__ = ['flash_attention']
+__all__ = [
+    'flash_attention', 'ring_attention', 'ring_attention_ambient',
+    'ring_attention_sharded'
+]

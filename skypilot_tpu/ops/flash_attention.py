@@ -193,6 +193,15 @@ def flash_attention(q: jax.Array,
         n_rep = h // k.shape[2]
         return _reference_attention(q, _repeat_kv(k, n_rep),
                                     _repeat_kv(v, n_rep), causal, sm_scale)
+    if impl == 'ring':
+        # Context parallelism: sequence sharded on the `sp` mesh axis,
+        # K/V rotating around the ring (ops/ring_attention.py). Requires
+        # an ambient mesh (jax.set_mesh) with an `sp` axis.
+        from skypilot_tpu.ops.ring_attention import ring_attention_ambient
+        n_rep = h // k.shape[2]
+        return ring_attention_ambient(
+            q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), causal=causal,
+            sm_scale=sm_scale)
     if impl in ('pallas', 'pallas_interpret'):
         if s % block_q or s % block_k:
             raise ValueError(f'seq {s} must tile by block_q={block_q}, '

@@ -1,0 +1,174 @@
+"""Ring attention: exact attention over sequence shards on the `sp` axis.
+
+Long-context is first-class in this framework (SURVEY §5: the reference
+has NO sequence/context parallelism anywhere — it delegates to the engines
+it launches). Here it is a core op: sequences shard across devices on the
+`sp` mesh axis, and attention runs as a ring over ICI.
+
+Algorithm (Ring Attention, Liu et al. 2023 — blockwise parallel
+transformers on a device ring):
+- Every device holds Q/K/V shards of its sequence chunk.
+- For `sp` steps: compute blockwise attention of the local Q chunk against
+  the currently-held K/V chunk with *online softmax* accumulation (the
+  flash-attention recurrence across devices), then rotate K/V one hop
+  around the ring with `jax.lax.ppermute`.
+- ICI makes the rotation latency hide under the chunk matmul: the permute
+  of step i+1 overlaps the compute of step i (XLA schedules the
+  collective-permute async on TPU).
+
+Causality is handled at the chunk level:
+- kv_chunk > q_chunk (strictly future): the whole step is skipped with
+  `lax.cond` — half the FLOPs, like block-skipping in the pallas kernel.
+- kv_chunk == q_chunk: intra-chunk causal mask.
+- kv_chunk < q_chunk: full (unmasked) chunk attention.
+
+This op composes with the mesh: `tp` shards heads inside each step's
+matmuls; `fsdp/dp` shard batch. Called under `shard_map` (see
+`ring_attention_sharded`) or any SPMD context where `axis_name` exists.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+_NEG_INF = -1e30
+
+
+def _chunk_update(q, k, v, o, m, l, *, sm_scale, mask_mode, q_offset,
+                  k_offset):
+    """One online-softmax accumulation step of local Q against one K/V
+    chunk. Shapes: q (B,Sq,H,D); k/v (B,Sk,H,D); o (B,Sq,H,D) f32;
+    m/l (B,H,Sq) f32. mask_mode: 0=full attend, 1=causal within chunk."""
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if mask_mode == 1:
+        s_q, s_k = s.shape[-2], s.shape[-1]
+        rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+        cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        s = jnp.where(cols <= rows, s, _NEG_INF)
+    m_cur = jnp.max(s, axis=-1)                      # (B,H,Sq)
+    m_new = jnp.maximum(m, m_cur)
+    # Guard fully-masked rows: exp(-inf - -inf) → use stable max.
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m - m_new)                       # (B,H,Sq)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = (o * alpha.transpose(0, 2, 1)[..., None] +
+             jnp.einsum('bhqk,bkhd->bqhd', p.astype(v.dtype), v
+                        ).astype(jnp.float32))
+    return o_new, m_new, l_new
+
+
+def ring_attention(q: jax.Array,
+                   k: jax.Array,
+                   v: jax.Array,
+                   *,
+                   axis_name: str = 'sp',
+                   causal: bool = True,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Exact attention over a sequence-sharded ring. Call inside
+    shard_map/SPMD with `axis_name` bound.
+
+    Args: q/k/v (B, S_local, H, D) — the local sequence chunk, kv heads
+    already folded to match q heads (GQA folding happens in the caller,
+    like ops/flash_attention.py). Returns (B, S_local, H, D) in q.dtype.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1]**-0.5
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    batch, s_local, heads, head_dim = q.shape
+
+    o0 = jnp.zeros((batch, s_local, heads, head_dim), jnp.float32)
+    m0 = jnp.full((batch, heads, s_local), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((batch, heads, s_local), jnp.float32)
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def step(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        # After i rotations, this device holds the K/V chunk originally on
+        # device (my_idx - i) mod sp.
+        src_idx = (my_idx - i) % axis_size
+        q_offset = my_idx * s_local
+        k_offset = src_idx * s_local
+
+        def attend_full(args):
+            o, m, l = args
+            return _chunk_update(q, k_cur, v_cur, o, m, l,
+                                 sm_scale=sm_scale, mask_mode=0,
+                                 q_offset=q_offset, k_offset=k_offset)
+
+        def attend_causal(args):
+            o, m, l = args
+            return _chunk_update(q, k_cur, v_cur, o, m, l,
+                                 sm_scale=sm_scale, mask_mode=1,
+                                 q_offset=q_offset, k_offset=k_offset)
+
+        def skip(args):
+            return args
+
+        if causal:
+            # Future chunk → skip compute entirely; same chunk → masked;
+            # past chunk → full. Nested cond keeps all branches
+            # collective-free (the permute below runs unconditionally, so
+            # the SPMD program stays uniform across devices).
+            o, m, l = jax.lax.cond(
+                src_idx > my_idx, skip,
+                lambda args: jax.lax.cond(src_idx == my_idx, attend_causal,
+                                          attend_full, args), (o, m, l))
+        else:
+            o, m, l = attend_full((o, m, l))
+
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return o, m, l, k_nxt, v_nxt
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, axis_size, step,
+                                      (o0, m0, l0, k, v))
+    del m
+    # Normalize; fully-masked rows (can't happen with causal self-attn on
+    # aligned chunks, but guard anyway) produce 0.
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def ring_attention_ambient(q: jax.Array,
+                           k: jax.Array,
+                           v: jax.Array,
+                           *,
+                           causal: bool = True,
+                           sm_scale: Optional[float] = None) -> jax.Array:
+    """Ring attention over the ambient mesh (callers enter it with
+    `jax.set_mesh(mesh)`): the form model code uses, so Flax modules don't
+    thread Mesh objects. Specs follow the canonical activation layout."""
+    spec = PartitionSpec(('dp', 'fsdp'), 'sp', 'tp', None)
+    fn = functools.partial(ring_attention, axis_name='sp', causal=causal,
+                           sm_scale=sm_scale)
+    return jax.shard_map(fn, in_specs=(spec, spec, spec), out_specs=spec,
+                         check_vma=False)(q, k, v)
+
+
+def ring_attention_sharded(mesh: Mesh,
+                           q: jax.Array,
+                           k: jax.Array,
+                           v: jax.Array,
+                           *,
+                           causal: bool = True,
+                           sm_scale: Optional[float] = None) -> jax.Array:
+    """Convenience wrapper: shard_map over the framework mesh with the
+    canonical activation layout (batch on dp/fsdp, sequence on sp, heads
+    on tp). Inputs are global arrays; XLA inserts the resharding."""
+    spec = PartitionSpec(('dp', 'fsdp'), 'sp', 'tp', None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    def _sharded(q, k, v):
+        return ring_attention(q, k, v, axis_name='sp', causal=causal,
+                              sm_scale=sm_scale)
+
+    return _sharded(q, k, v)

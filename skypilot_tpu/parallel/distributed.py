@@ -1,0 +1,110 @@
+"""Multi-host bootstrap: coordinator election + topology env contract.
+
+This replaces the reference's rank-wiring exports
+(SKYPILOT_NODE_RANK/NODE_IPS/NUM_NODES at
+sky/backends/cloud_vm_ray_backend.py:570-637 + NCCL inside user scripts)
+with the JAX-native contract (SURVEY §2.9, §5):
+
+- ICI within a slice needs no wiring at all — every host of a slice runs
+  the same program and libtpu discovers the torus.
+- Across hosts, `jax.distributed.initialize(coordinator, num_processes,
+  process_id)` wires the control plane; the agent exports the inputs as
+  env vars (agent/constants.py ENV_*), with host 0 of slice 0 as the
+  elected coordinator.
+- Across slices (multislice/DCN), MEGASCALE_* env vars configure the DCN
+  transport; mesh axis `dp` (outermost) rides DCN by construction
+  (parallel/mesh.py).
+
+`initialize()` is what user programs (and the in-tree trainer) call first;
+it is a no-op under a single process so the same script runs on one chip,
+a CPU test mesh, or a v5p-512 pod.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Dict, List, Optional
+
+from skypilot_tpu.agent import constants as agent_constants
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessTopology:
+    """One process's place in the job (parsed from the agent's env)."""
+    num_slices: int
+    slice_index: int
+    num_hosts: int          # total across slices
+    host_rank: int          # global
+    host_index: int         # within its slice
+    chips_per_host: int
+    node_ips: List[str]
+    coordinator_address: Optional[str]
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.host_rank == 0
+
+    @property
+    def multihost(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def multislice(self) -> bool:
+        return self.num_slices > 1
+
+
+def topology_from_env(env: Optional[Dict[str, str]] = None
+                      ) -> ProcessTopology:
+    e = dict(os.environ if env is None else env)
+    c = agent_constants
+    num_hosts = int(e.get(c.ENV_NUM_NODES, '1'))
+    ips = [ip for ip in e.get(c.ENV_NODE_IPS, '').split('\n') if ip]
+    coordinator = e.get(c.ENV_JAX_COORDINATOR)
+    if coordinator is None and ips:
+        coordinator = f'{ips[0]}:{c.JAX_COORDINATOR_PORT}'
+    return ProcessTopology(
+        num_slices=int(e.get(c.ENV_NUM_SLICES, '1')),
+        slice_index=int(e.get(c.ENV_SLICE_INDEX, '0')),
+        num_hosts=num_hosts,
+        host_rank=int(e.get(c.ENV_NODE_RANK, '0')),
+        host_index=int(e.get(c.ENV_HOST_INDEX, '0')),
+        chips_per_host=int(e.get(c.ENV_CHIPS_PER_HOST, '1')),
+        node_ips=ips,
+        coordinator_address=coordinator,
+    )
+
+
+# The export side of this contract lives in agent/driver.py (every rank's
+# env is built there, including MEGASCALE_* for multislice); this module is
+# the consumer.
+_initialized = False
+
+
+def initialize(topology: Optional[ProcessTopology] = None,
+               timeout_seconds: int = 300) -> ProcessTopology:
+    """Wire this process into the job's JAX distributed runtime.
+
+    No-op for single-process jobs. Idempotent. Returns the topology so
+    callers can branch on rank (e.g. only rank 0 writes checkpoints
+    metadata).
+    """
+    global _initialized
+    if topology is None:
+        topology = topology_from_env()
+    if not topology.multihost or _initialized:
+        return topology
+    import jax
+    logger.info(
+        'jax.distributed.initialize(coordinator=%s, num_processes=%d, '
+        'process_id=%d)', topology.coordinator_address, topology.num_hosts,
+        topology.host_rank)
+    jax.distributed.initialize(
+        coordinator_address=topology.coordinator_address,
+        num_processes=topology.num_hosts,
+        process_id=topology.host_rank,
+        initialization_timeout=timeout_seconds)
+    _initialized = True
+    return topology

@@ -1,0 +1,144 @@
+"""CLI tests via click's CliRunner against the fake cloud — the runner-
+invoked CLI tier of the reference's test strategy (SURVEY §4.1,
+tests/test_cli.py there), plus real end-to-end launch through the CLI.
+"""
+import time
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import cli as cli_mod
+from skypilot_tpu import global_user_state
+
+
+@pytest.fixture(autouse=True)
+def cli_env(_isolate_state):
+    global_user_state.set_enabled_clouds(['fake'])
+    yield
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+def _invoke(runner, args, **kwargs):
+    result = runner.invoke(cli_mod.cli, args, catch_exceptions=False,
+                           **kwargs)
+    return result
+
+
+class TestBasicCommands:
+
+    def test_help_lists_commands(self, runner):
+        result = _invoke(runner, ['--help'])
+        for command in ('launch', 'exec', 'status', 'queue', 'logs',
+                        'cancel', 'stop', 'start', 'down', 'autostop',
+                        'cost-report', 'check', 'show-tpus', 'storage',
+                        'jobs', 'serve'):
+            assert command in result.output
+
+    def test_status_empty(self, runner):
+        result = _invoke(runner, ['status'])
+        assert result.exit_code == 0
+        assert 'No clusters' in result.output
+
+    def test_show_tpus(self, runner):
+        result = _invoke(runner, ['show-tpus'])
+        assert result.exit_code == 0
+        assert 'tpu-v5e-8' in result.output
+        assert 'ACCELERATOR' in result.output
+
+    def test_show_tpus_all_includes_pods(self, runner):
+        result = _invoke(runner, ['show-tpus', '--all'])
+        assert 'tpu-v5p-256' in result.output
+
+    def test_check(self, runner, monkeypatch):
+        monkeypatch.setenv('SKYTPU_ENABLE_FAKE_CLOUD', '1')
+        result = _invoke(runner, ['check'])
+        assert result.exit_code == 0
+        assert 'fake' in result.output
+
+    def test_check_no_clouds_fails(self, runner):
+        result = runner.invoke(cli_mod.cli, ['check'])
+        assert result.exit_code == 1
+
+    def test_launch_dryrun(self, runner):
+        result = _invoke(runner, [
+            'launch', '--dryrun', '--cloud', 'fake', '--accelerators',
+            'tpu-v5e-8', '--name', 't', 'echo hi'
+        ])
+        assert result.exit_code == 0
+
+    def test_launch_requires_entrypoint(self, runner):
+        result = runner.invoke(cli_mod.cli, ['launch', '--dryrun'])
+        assert result.exit_code == 1
+        assert 'ENTRYPOINT' in result.output
+
+    def test_cancel_requires_selector(self, runner):
+        result = runner.invoke(cli_mod.cli, ['cancel', 'c1'])
+        assert result.exit_code == 1
+
+
+@pytest.mark.slow
+class TestCliEndToEnd:
+
+    def test_launch_status_queue_logs_down(self, runner, capfd):
+        result = _invoke(runner, [
+            'launch', '-y', '-d', '--cloud', 'fake', '--accelerators',
+            'tpu-v5e-1', '--name', 'clitest', 'echo cli-ran-here'
+        ])
+        assert result.exit_code == 0, result.output
+        assert 'Job 1' in result.output
+
+        from skypilot_tpu import core
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            if core.job_status('clitest', [1])[1] == 'SUCCEEDED':
+                break
+            time.sleep(0.3)
+
+        result = _invoke(runner, ['status'])
+        assert 'clitest' in result.output and 'UP' in result.output
+
+        result = _invoke(runner, ['queue', 'clitest'])
+        assert 'SUCCEEDED' in result.output
+
+        # Log streaming goes to the process stdout (subprocess tail), not
+        # click's captured stream — check the fd-level capture.
+        _invoke(runner, ['logs', 'clitest', '1', '--no-follow'])
+        assert 'cli-ran-here' in capfd.readouterr().out
+
+        result = _invoke(runner, ['exec', 'clitest', 'echo exec-path'])
+        assert 'Job 2' in result.output
+
+        result = _invoke(runner, ['autostop', 'clitest', '-i', '10'])
+        assert '10 min' in result.output
+
+        result = _invoke(runner, ['down', '-y', 'clitest'])
+        assert result.exit_code == 0
+        result = _invoke(runner, ['status'])
+        assert 'No clusters' in result.output
+
+        result = _invoke(runner, ['cost-report'])
+        assert 'clitest' in result.output
+
+    def test_jobs_cli(self, runner, monkeypatch):
+        monkeypatch.setenv('SKYTPU_JOBS_POLL_SECONDS', '0.2')
+        from skypilot_tpu.jobs import state as jobs_state
+        jobs_state._db = None  # pylint: disable=protected-access
+        result = _invoke(runner, [
+            'jobs', 'launch', '-y', '--cloud', 'fake', '--accelerators',
+            'tpu-v5e-1', '--name', 'mjob', 'echo managed-cli'
+        ])
+        assert result.exit_code == 0, result.output
+        assert 'Managed job 1' in result.output
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            status = jobs_state.get_status(1)
+            if status is not None and status.is_terminal():
+                break
+            time.sleep(0.3)
+        result = _invoke(runner, ['jobs', 'queue'])
+        assert 'mjob' in result.output
+        assert 'SUCCEEDED' in result.output

@@ -46,10 +46,32 @@ class TestStorageObject:
         assert core.storage_ls() == []
 
     def test_source_uri_infers_name(self):
-        storage = Storage(source='local://premade/sub')
+        storage = Storage(source='local://premade')
         assert storage.name == 'premade'
         with pytest.raises(exceptions.StorageSpecError):
             Storage(name='other', source='local://premade')
+
+    def test_keyed_bucket_uri_rejected(self):
+        # Regression: a prefix inside a bucket must not silently become a
+        # whole-bucket mount.
+        with pytest.raises(exceptions.StorageSpecError, match='prefix'):
+            Storage(source='gs://my-bucket/train-data')
+        with pytest.raises(exceptions.StorageSpecError, match='prefix'):
+            Storage(source='local://premade/sub')
+
+    def test_mount_never_deletes_existing_data(self, tmp_path):
+        # Regression: mounting over a non-empty dir must fail loudly, not
+        # rm -rf the user's data.
+        from skypilot_tpu.data import mounting_utils
+        dst = tmp_path / 'precious'
+        dst.mkdir()
+        (dst / 'keep.txt').write_text('irreplaceable')
+        cmd = mounting_utils.get_local_symlink_mount_cmd(
+            str(tmp_path / 'bucket'), str(dst))
+        import subprocess
+        proc = subprocess.run(cmd, shell=True, capture_output=True)
+        assert proc.returncode != 0
+        assert (dst / 'keep.txt').read_text() == 'irreplaceable'
 
     def test_scratch_bucket_no_source(self):
         storage = Storage(name='scratch-ckpt')
