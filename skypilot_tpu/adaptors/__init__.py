@@ -1,0 +1,9 @@
+"""Lazy adaptors for heavy/optional SDK imports (SURVEY §2.1).
+
+Reference parity: sky/adaptors/ (1,560 LoC) — `LazyImport` so an
+unconfigured cloud costs nothing at import time (adaptors/common.py:7);
+one module per cloud SDK.
+"""
+from skypilot_tpu.adaptors.common import LazyImport
+
+__all__ = ['LazyImport']

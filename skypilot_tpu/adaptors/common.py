@@ -1,0 +1,33 @@
+"""LazyImport: defer module import until first attribute access.
+
+Reference parity: sky/adaptors/common.py:7 — keeps `import skypilot_tpu`
+fast and lets boxes without a given SDK still use every other part of the
+framework (the error surfaces only when the SDK is actually used).
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Any, Optional
+
+
+class LazyImport:
+
+    def __init__(self, module_name: str,
+                 import_error_message: Optional[str] = None) -> None:
+        self._module_name = module_name
+        self._module: Any = None
+        self._import_error_message = import_error_message
+
+    def _load(self) -> Any:
+        if self._module is None:
+            try:
+                self._module = importlib.import_module(self._module_name)
+            except ImportError as e:
+                message = self._import_error_message or (
+                    f'Failed to import {self._module_name!r}. Install it '
+                    'to use this feature.')
+                raise ImportError(message) from e
+        return self._module
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._load(), name)

@@ -1,0 +1,28 @@
+"""GCP SDK adaptor: lazy google-auth / googleapiclient access.
+
+Reference parity: sky/adaptors/gcp.py. The TPU REST client
+(provision/gcp/tpu_api.py) talks HTTP directly with google-auth
+credentials; this adaptor centralizes the lazy import + common error
+types so unconfigured boxes import cleanly.
+"""
+from __future__ import annotations
+
+from skypilot_tpu.adaptors import common
+
+_IMPORT_ERROR = ('google-auth is required for GCP access: '
+                 'pip install google-auth google-auth-httplib2')
+
+google_auth = common.LazyImport('google.auth', _IMPORT_ERROR)
+google_auth_requests = common.LazyImport('google.auth.transport.requests',
+                                         _IMPORT_ERROR)
+
+
+def get_credentials(scopes=None):
+    scopes = scopes or ['https://www.googleapis.com/auth/cloud-platform']
+    return google_auth.default(scopes=scopes)
+
+
+def http_error_types():
+    """Exception types callers should treat as GCP API errors."""
+    import requests
+    return (requests.RequestException,)

@@ -1,0 +1,110 @@
+"""SSH keypair management + per-cloud public-key injection.
+
+Reference parity: sky/authentication.py (473 LoC) — generates the
+`~/.sky/sky-key` RSA pair once per user (authentication.py:68-127) and
+injects the public key per cloud (GCP metadata `ssh-keys` :148, k8s secret
+:359). Here the GCP TPU provisioner injects via instance metadata
+(provision/gcp/instance.py ssh-keys), so this module owns generation and
+formatting only.
+"""
+from __future__ import annotations
+
+import functools
+import getpass
+import logging
+import os
+import subprocess
+from typing import Tuple
+
+import filelock
+
+logger = logging.getLogger(__name__)
+
+_KEY_NAME = 'sky-key'
+
+
+def _key_dir() -> str:
+    from skypilot_tpu.agent import constants as agent_constants
+    return agent_constants.agent_home()
+
+
+def get_private_key_path() -> str:
+    return os.path.join(_key_dir(), _KEY_NAME)
+
+
+def get_public_key_path() -> str:
+    return get_private_key_path() + '.pub'
+
+
+@functools.lru_cache(maxsize=1)
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Returns (private_path, public_path), generating once under a lock
+    (reference: get_or_generate_keys, authentication.py:95-127)."""
+    private_path = get_private_key_path()
+    public_path = get_public_key_path()
+    os.makedirs(_key_dir(), exist_ok=True)
+    lock = filelock.FileLock(private_path + '.lock', timeout=60)
+    with lock:
+        if not os.path.exists(private_path):
+            _generate_keypair(private_path, public_path)
+            logger.info('Generated SSH keypair at %s.', private_path)
+        elif not os.path.exists(public_path):
+            _rederive_public_key(private_path, public_path)
+    return private_path, public_path
+
+
+def _comment() -> str:
+    return f'skytpu-{getpass.getuser()}'
+
+
+def _generate_keypair(private_path: str, public_path: str) -> None:
+    """RSA-2048 via the cryptography library (reference generates with
+    cryptography too, authentication.py:68-94 — no ssh-keygen binary
+    dependency)."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    private_pem = key.private_bytes(
+        encoding=serialization.Encoding.PEM,
+        format=serialization.PrivateFormat.OpenSSH,
+        encryption_algorithm=serialization.NoEncryption())
+    public_ssh = key.public_key().public_bytes(
+        encoding=serialization.Encoding.OpenSSH,
+        format=serialization.PublicFormat.OpenSSH)
+    flags = os.O_WRONLY | os.O_CREAT | os.O_TRUNC
+    with os.fdopen(os.open(private_path, flags, 0o600), 'wb') as f:
+        f.write(private_pem)
+    with open(public_path, 'wb') as f:
+        f.write(public_ssh + f' {_comment()}\n'.encode())
+
+
+def _rederive_public_key(private_path: str, public_path: str) -> None:
+    """Private exists, public lost: re-derive (prefer ssh-keygen, fall
+    back to cryptography)."""
+    try:
+        proc = subprocess.run(['ssh-keygen', '-y', '-f', private_path],
+                              capture_output=True, text=True, check=False)
+        if proc.returncode == 0:
+            with open(public_path, 'w', encoding='utf-8') as f:
+                f.write(proc.stdout)
+            return
+    except OSError:
+        pass  # no ssh-keygen on this box → cryptography below
+    from cryptography.hazmat.primitives import serialization
+    with open(private_path, 'rb') as f:
+        key = serialization.load_ssh_private_key(f.read(), password=None)
+    public_ssh = key.public_key().public_bytes(
+        encoding=serialization.Encoding.OpenSSH,
+        format=serialization.PublicFormat.OpenSSH)
+    with open(public_path, 'wb') as f:
+        f.write(public_ssh + f' {_comment()}\n'.encode())
+
+
+def gcp_ssh_keys_metadata(user: str = 'skytpu') -> str:
+    """The `ssh-keys` instance-metadata value GCP expects
+    ('<user>:<pubkey>'; reference: setup_gcp_authentication,
+    authentication.py:148)."""
+    _, public_path = get_or_generate_keys()
+    with open(public_path, encoding='utf-8') as f:
+        public_key = f.read().strip()
+    return f'{user}:{public_key}'

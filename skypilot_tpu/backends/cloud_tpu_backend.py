@@ -68,12 +68,17 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
                  launched_resources: 'resources_lib.Resources',
                  cluster_info: provision_common.ClusterInfo,
                  ssh_user: str = 'skytpu',
-                 ssh_key_path: str = '~/.skytpu/sky-key') -> None:
+                 ssh_key_path: Optional[str] = None) -> None:
         self._version = self._VERSION
         self.cluster_name = cluster_name
         self.launched_resources = launched_resources
         self.cluster_info = cluster_info
         self.ssh_user = ssh_user
+        if ssh_key_path is None:
+            # The same SKYTPU_HOME-aware path whose public half the
+            # provisioner injected (authentication.py).
+            from skypilot_tpu import authentication
+            ssh_key_path = authentication.get_private_key_path()
         self.ssh_key_path = ssh_key_path
         # Cached (internal, external) IPs in rank order, so `status` works
         # without a cloud query (reference: stable_internal_external_ips).
@@ -265,11 +270,17 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
             to_provision = launched
 
         engine = provisioner_lib.FailoverEngine()
+        # Real clouds SSH in with the framework keypair; generate it once
+        # per user (authentication.py). Only the fake cloud (local
+        # processes) skips keys — an unresolved (None) cloud defaults to
+        # real GCP in the provisioner, so it MUST get a key.
+        needs_keys = to_provision.cloud_name != 'fake'
         while True:
             try:
                 result = engine.provision_with_retries(
                     cluster_name, [to_provision],
-                    authorized_key=self._authorized_key())
+                    authorized_key=self._authorized_key(
+                        generate=needs_keys))
                 break
             except exceptions.ResourcesUnavailableError:
                 if not retry_until_up:
@@ -290,11 +301,16 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
         return handle
 
     @staticmethod
-    def _authorized_key() -> Optional[str]:
-        pub = os.path.expanduser('~/.skytpu/sky-key.pub')
+    def _authorized_key(generate: bool = False) -> Optional[str]:
+        """GCP `ssh-keys` metadata value ('<user>:<pubkey>' — the raw key
+        alone would authorize nobody; authentication.py:gcp_ssh_keys_
+        metadata owns the format)."""
+        from skypilot_tpu import authentication
+        pub = authentication.get_public_key_path()
+        if generate and not os.path.exists(pub):
+            authentication.get_or_generate_keys()
         if os.path.exists(pub):
-            with open(pub, encoding='utf-8') as f:
-                return f.read().strip()
+            return authentication.gcp_ssh_keys_metadata(user='skytpu')
         return None
 
     def _post_provision_setup(self, handle: 'CloudTpuResourceHandle') -> None:

@@ -1,0 +1,17 @@
+"""Benchmark: launch one task across candidate slice shapes, compare
+$/step and time-to-K-steps.
+
+Reference parity: sky/benchmark/ (891 LoC; SURVEY §2.1) — `sky bench
+launch` starts N candidate clusters in parallel with step-logging enabled
+(benchmark_utils.py:73,488), collects the callback summaries, and reports
+cost/step (:274,584). Chips (slice shapes) are the unit here, not VMs.
+"""
+from skypilot_tpu.benchmark.benchmark_state import BenchmarkStatus
+from skypilot_tpu.benchmark.benchmark_utils import (down_benchmark,
+                                                    launch_benchmark,
+                                                    update_benchmark_results)
+
+__all__ = [
+    'BenchmarkStatus', 'down_benchmark', 'launch_benchmark',
+    'update_benchmark_results'
+]

@@ -1,0 +1,123 @@
+"""Benchmark state: sqlite tables for benchmarks and per-candidate
+results (reference parity: sky/benchmark/benchmark_state.py)."""
+from __future__ import annotations
+
+import enum
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import db_utils
+
+
+class BenchmarkStatus(enum.Enum):
+    INIT = 'INIT'
+    RUNNING = 'RUNNING'
+    FINISHED = 'FINISHED'
+
+
+def _db_path() -> str:
+    from skypilot_tpu.agent import constants as agent_constants
+    return os.path.join(agent_constants.agent_home(), 'benchmark.db')
+
+
+def _create_table(cursor: sqlite3.Cursor, conn: sqlite3.Connection) -> None:
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS benchmark (
+            name TEXT PRIMARY KEY,
+            task_name TEXT,
+            launched_at REAL)""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS benchmark_results (
+            benchmark TEXT,
+            cluster TEXT,
+            accelerator TEXT,
+            hourly_cost REAL,
+            status TEXT,
+            num_steps INTEGER,
+            seconds_per_step REAL,
+            first_step_ts REAL,
+            last_step_ts REAL,
+            PRIMARY KEY (benchmark, cluster))""")
+    conn.commit()
+
+
+_db: Optional[db_utils.SQLiteConn] = None
+_path: Optional[str] = None
+
+
+def _get_db() -> db_utils.SQLiteConn:
+    global _db, _path
+    path = _db_path()
+    if _db is None or _path != path:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        _db = db_utils.SQLiteConn(path, _create_table)
+        _path = path
+    return _db
+
+
+def add_benchmark(name: str, task_name: str) -> None:
+    with _get_db().cursor() as cur:
+        cur.execute(
+            'INSERT OR REPLACE INTO benchmark VALUES (?, ?, ?)',
+            (name, task_name, time.time()))
+
+
+def add_candidate(benchmark: str, cluster: str, accelerator: str,
+                  hourly_cost: float) -> None:
+    with _get_db().cursor() as cur:
+        cur.execute(
+            'INSERT OR REPLACE INTO benchmark_results '
+            '(benchmark, cluster, accelerator, hourly_cost, status) '
+            'VALUES (?, ?, ?, ?, ?)',
+            (benchmark, cluster, accelerator, hourly_cost,
+             BenchmarkStatus.INIT.value))
+
+
+def update_result(benchmark: str, cluster: str, status: BenchmarkStatus,
+                  num_steps: Optional[int],
+                  seconds_per_step: Optional[float],
+                  first_step_ts: Optional[float],
+                  last_step_ts: Optional[float]) -> None:
+    with _get_db().cursor() as cur:
+        cur.execute(
+            'UPDATE benchmark_results SET status = ?, num_steps = ?, '
+            'seconds_per_step = ?, first_step_ts = ?, last_step_ts = ? '
+            'WHERE benchmark = ? AND cluster = ?',
+            (status.value, num_steps, seconds_per_step, first_step_ts,
+             last_step_ts, benchmark, cluster))
+
+
+_COLS = ('benchmark', 'cluster', 'accelerator', 'hourly_cost', 'status',
+         'num_steps', 'seconds_per_step', 'first_step_ts', 'last_step_ts')
+
+
+def get_results(benchmark: str) -> List[Dict[str, Any]]:
+    with _get_db().cursor() as cur:
+        rows = cur.execute(
+            f'SELECT {", ".join(_COLS)} FROM benchmark_results '
+            'WHERE benchmark = ? ORDER BY cluster', (benchmark,)).fetchall()
+    out = []
+    for row in rows:
+        rec = dict(zip(_COLS, row))
+        rec['status'] = BenchmarkStatus(rec['status'])
+        out.append(rec)
+    return out
+
+
+def get_benchmarks() -> List[Dict[str, Any]]:
+    with _get_db().cursor() as cur:
+        rows = cur.execute(
+            'SELECT name, task_name, launched_at FROM benchmark '
+            'ORDER BY launched_at DESC').fetchall()
+    return [
+        dict(zip(('name', 'task_name', 'launched_at'), row)) for row in rows
+    ]
+
+
+def remove_benchmark(name: str) -> None:
+    with _get_db().cursor() as cur:
+        cur.execute('DELETE FROM benchmark WHERE name = ?', (name,))
+        cur.execute('DELETE FROM benchmark_results WHERE benchmark = ?',
+                    (name,))

@@ -1,0 +1,154 @@
+"""Benchmark orchestration: parallel candidate launches + result harvest.
+
+Reference parity: sky/benchmark/benchmark_utils.py — launch N candidate
+clusters in parallel with the step-logging callback enabled (:73,488),
+pull summaries, report $/step and time-to-K-steps (:274,584). The
+callback contract is skypilot_tpu/callbacks (summary.json on the head
+host).
+"""
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import os
+import tempfile
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.benchmark import benchmark_state
+from skypilot_tpu.benchmark.benchmark_state import BenchmarkStatus
+from skypilot_tpu.utils import subprocess_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+logger = logging.getLogger(__name__)
+
+_CALLBACK_DIR = '~/skytpu-callback'
+
+
+def cluster_name_for(benchmark: str, index: int) -> str:
+    return f'skytpu-bench-{benchmark}-{index}'
+
+
+def launch_benchmark(benchmark: str, task: 'task_lib.Task',
+                     candidates: List[str]) -> List[str]:
+    """Launch one cluster per candidate accelerator, all in parallel
+    (reference: launch_benchmark_clusters, benchmark_utils.py:488).
+    Returns the cluster names."""
+    from skypilot_tpu import execution
+    from skypilot_tpu import resources as resources_lib
+
+    if not task.resources:
+        raise ValueError('Benchmark task needs base resources.')
+    base = next(iter(task.resources))
+    benchmark_state.add_benchmark(benchmark, task.name or 'task')
+
+    launch_args = []
+    for index, accelerator in enumerate(candidates):
+        resources = base.copy(accelerators=accelerator)
+        candidate_task = copy.copy(task)
+        candidate_task.set_resources({resources})
+        candidate_task.update_envs(
+            {'SKYTPU_CALLBACK_LOG_DIR': _CALLBACK_DIR})
+        cluster = cluster_name_for(benchmark, index)
+        try:
+            hourly = resources.get_hourly_cost()
+        except Exception:  # pylint: disable=broad-except
+            hourly = 0.0
+        benchmark_state.add_candidate(benchmark, cluster, accelerator,
+                                      hourly)
+        launch_args.append((candidate_task, cluster))
+
+    def _launch(args):
+        candidate_task, cluster = args
+        execution.launch(candidate_task, cluster_name=cluster,
+                         detach_run=True, stream_logs=False,
+                         quiet_optimizer=True)
+        benchmark_state.update_result(benchmark, cluster,
+                                      BenchmarkStatus.RUNNING, None, None,
+                                      None, None)
+        return cluster
+
+    results = subprocess_utils.run_in_parallel(_launch, launch_args)
+    return list(results)
+
+
+def _fetch_summary(cluster: str) -> Optional[Dict[str, Any]]:
+    """Pull the callback summary from the head host."""
+    from skypilot_tpu import global_user_state
+    record = global_user_state.get_cluster_from_name(cluster)
+    if record is None or record['handle'] is None:
+        return None
+    handle = record['handle']
+    rec = handle.host_records()[0]
+    runner = handle._make_runner(rec)  # pylint: disable=protected-access
+    remote = handle.resolve_remote_path(
+        rec, f'{_CALLBACK_DIR}/summary.json'.replace('~/', '~/'))
+    with tempfile.TemporaryDirectory() as tmp:
+        local = os.path.join(tmp, 'summary.json')
+        try:
+            runner.rsync(remote, local, up=False)
+            with open(local, encoding='utf-8') as f:
+                return json.load(f)
+        except (exceptions.CommandError, OSError, ValueError):
+            return None
+
+
+def update_benchmark_results(benchmark: str) -> List[Dict[str, Any]]:
+    """Harvest summaries from every candidate cluster; returns fresh
+    result records (reference: update_benchmark_state,
+    benchmark_utils.py:274)."""
+    results = benchmark_state.get_results(benchmark)
+
+    def _update(rec):
+        summary = _fetch_summary(rec['cluster'])
+        if summary is None or not summary.get('num_steps'):
+            return
+        benchmark_state.update_result(
+            benchmark, rec['cluster'],
+            BenchmarkStatus.FINISHED if summary.get('total_steps') and
+            summary['num_steps'] >= summary['total_steps'] else
+            BenchmarkStatus.RUNNING, summary['num_steps'],
+            summary.get('mean_step_seconds'),
+            summary.get('first_step_begin'), summary.get('last_step_end'))
+
+    subprocess_utils.run_in_parallel(_update, results)
+    return benchmark_state.get_results(benchmark)
+
+
+def report(benchmark: str,
+           steps_target: Optional[int] = None) -> List[Dict[str, Any]]:
+    """$/step and time-to-K-steps per candidate."""
+    out = []
+    for rec in benchmark_state.get_results(benchmark):
+        row = dict(rec)
+        sps = rec['seconds_per_step']
+        if sps:
+            row['cost_per_step'] = rec['hourly_cost'] * sps / 3600.0
+            if steps_target:
+                row['seconds_to_target'] = sps * steps_target
+                row['cost_to_target'] = (row['cost_per_step'] *
+                                         steps_target)
+        out.append(row)
+    return out
+
+
+def down_benchmark(benchmark: str) -> None:
+    """Terminate every candidate cluster and drop state."""
+    from skypilot_tpu import core
+    from skypilot_tpu import global_user_state
+
+    def _down(rec):
+        if global_user_state.get_cluster_from_name(
+                rec['cluster']) is not None:
+            try:
+                core.down(rec['cluster'], purge=True)
+            except exceptions.SkyTpuError as e:
+                logger.warning('down %s: %s', rec['cluster'], e)
+
+    subprocess_utils.run_in_parallel(_down,
+                                     benchmark_state.get_results(benchmark))
+    benchmark_state.remove_benchmark(benchmark)

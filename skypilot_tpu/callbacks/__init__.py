@@ -1,0 +1,19 @@
+"""In-training callback: step timestamps for the benchmark subsystem.
+
+Reference parity: sky/callbacks/ (653 LoC) — `BaseCallback`
+(sky_callback/base.py:20) with an async summary-writer thread (:73) and
+Keras/Lightning/HF integrations writing step timestamps the benchmark
+reads. Here the integration targets JAX/Flax training loops (the in-tree
+trainer and any user loop).
+"""
+from skypilot_tpu.callbacks.base import BaseCallback
+from skypilot_tpu.callbacks.base import SkyTpuCallback
+from skypilot_tpu.callbacks.base import init
+from skypilot_tpu.callbacks.base import on_step_begin
+from skypilot_tpu.callbacks.base import on_step_end
+from skypilot_tpu.callbacks.base import step
+
+__all__ = [
+    'BaseCallback', 'SkyTpuCallback', 'init', 'on_step_begin',
+    'on_step_end', 'step'
+]

@@ -522,6 +522,80 @@ def serve_logs(service_name, replica_id):
         _fail(str(e))
 
 
+# ---------------- benchmark ----------------
+
+
+@cli.group()
+def bench():
+    """Benchmark a task across candidate TPU slice shapes."""
+
+
+@bench.command('launch')
+@click.argument('entrypoint', nargs=-1)
+@click.option('--benchmark', '-b', required=True, help='Benchmark name.')
+@click.option('--candidate', '-k', 'candidates', multiple=True,
+              required=True,
+              help='Candidate accelerator (repeatable), e.g. tpu-v5e-8.')
+@click.option('--cloud', default=None)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def bench_launch(entrypoint, benchmark, candidates, cloud, yes):
+    """Launch ENTRYPOINT on every candidate slice shape in parallel."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    task = _make_task(entrypoint, None, None, cloud, None, None,
+                      candidates[0], None, None, (), ())
+    _confirm(
+        f'Launching {len(candidates)} benchmark clusters '
+        f'({", ".join(candidates)}). Proceed?', yes)
+    try:
+        clusters = benchmark_utils.launch_benchmark(benchmark, task,
+                                                    list(candidates))
+    except (exceptions.SkyTpuError, ValueError) as e:
+        _fail(str(e))
+    click.echo(f'Benchmark {benchmark!r}: launched {", ".join(clusters)}. '
+               f'`skytpu bench show {benchmark}` to compare.')
+
+
+@bench.command('show')
+@click.argument('benchmark')
+@click.option('--steps', type=int, default=None,
+              help='Report time/cost to reach this step count.')
+def bench_show(benchmark, steps):
+    from skypilot_tpu.benchmark import benchmark_utils
+    try:
+        benchmark_utils.update_benchmark_results(benchmark)
+    except exceptions.SkyTpuError as e:
+        _fail(str(e))
+    rows = []
+    for r in benchmark_utils.report(benchmark, steps_target=steps):
+        rows.append([
+            r['cluster'], r['accelerator'], r['status'].value,
+            r['num_steps'] or '-',
+            f"{r['seconds_per_step']:.3f}s" if r['seconds_per_step']
+            else '-',
+            f"${r['cost_per_step']:.6f}" if r.get('cost_per_step')
+            else '-',
+            f"{r['seconds_to_target']/3600:.2f}h"
+            if r.get('seconds_to_target') else '-',
+        ])
+    _print_table(rows, [
+        'CLUSTER', 'ACCELERATOR', 'STATUS', 'STEPS', 'SEC/STEP', '$/STEP',
+        'TIME-TO-TARGET'
+    ])
+
+
+@bench.command('down')
+@click.argument('benchmark')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def bench_down(benchmark, yes):
+    from skypilot_tpu.benchmark import benchmark_utils
+    _confirm(f'Tear down benchmark {benchmark!r} clusters?', yes)
+    try:
+        benchmark_utils.down_benchmark(benchmark)
+    except exceptions.SkyTpuError as e:
+        _fail(str(e))
+    click.echo(f'Benchmark {benchmark!r} torn down.')
+
+
 def main() -> None:
     cli()  # pylint: disable=no-value-for-parameter
 

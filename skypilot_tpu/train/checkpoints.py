@@ -1,0 +1,72 @@
+"""Orbax checkpointing: the in-tree checkpoint/resume contract.
+
+The reference leaves checkpointing entirely to recipes writing into
+MOUNT-mode buckets (SURVEY §5: "not a framework feature"); TPU-native it
+becomes first-party: Orbax async saves into a (bucket-mounted) directory,
+restore-on-start, so a preempted managed job resumes from the last step.
+
+Works sharded: save/restore preserve each array's NamedSharding, so a
+resumed job on the same mesh shape restores without resharding traffic.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax.checkpoint.CheckpointManager with the
+    framework's defaults (async save, keep-3, step-numbered dirs)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 100) -> None:
+        import orbax.checkpoint as ocp
+        self.directory = os.path.abspath(os.path.expanduser(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Async save; returns whether a save was initiated."""
+        import orbax.checkpoint as ocp
+        return self._manager.save(
+            step, args=ocp.args.StandardSave(state), force=force)
+
+    def restore(self, state: Any, step: Optional[int] = None) -> Any:
+        """Restore into the sharding/structure of `state` (an abstract or
+        concrete template). Returns the restored pytree."""
+        import orbax.checkpoint as ocp
+        if step is None:
+            step = self.latest_step()
+        assert step is not None, 'no checkpoint to restore'
+        return self._manager.restore(step,
+                                     args=ocp.args.StandardRestore(state))
+
+    def maybe_restore(self, state: Any) -> tuple:
+        """(state, start_step): restores when a checkpoint exists, else
+        returns the input untouched — the resume-on-preemption entry."""
+        step = self.latest_step()
+        if step is None:
+            return state, 0
+        logger.info('Restoring checkpoint step %d from %s', step,
+                    self.directory)
+        return self.restore(state, step), step
+
+    def wait(self) -> None:
+        self._manager.wait_until_finished()
+
+    def close(self) -> None:
+        self._manager.wait_until_finished()
+        self._manager.close()

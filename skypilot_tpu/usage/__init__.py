@@ -1,0 +1,3 @@
+from skypilot_tpu.usage.usage_lib import entrypoint
+
+__all__ = ['entrypoint']

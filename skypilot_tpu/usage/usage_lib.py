@@ -1,0 +1,107 @@
+"""Usage telemetry: redacted per-entrypoint run records.
+
+Reference parity: sky/usage/usage_lib.py (487 LoC) — `@entrypoint`
+wraps every public API call (usage_lib.py:446), collects a redacted
+record (entrypoint name, runtime, outcome, anonymous user hash) and POSTs
+it to a collector (the reference ships a Loki endpoint,
+usage/constants.py:3). Same mechanism here with our own endpoint knob —
+and DISABLED unless an endpoint is configured: there is no default
+collector, so nothing ever leaves the machine out of the box.
+
+Config: `usage.enabled` + `usage.endpoint` in ~/.skytpu/config.yaml, or
+SKYTPU_USAGE_ENDPOINT / SKYTPU_DISABLE_USAGE_COLLECTION env vars.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import logging
+import os
+import threading
+import time
+import traceback
+from typing import Any, Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+_TIMEOUT_SECONDS = 2
+
+
+def _endpoint() -> Optional[str]:
+    if os.environ.get('SKYTPU_DISABLE_USAGE_COLLECTION') == '1':
+        return None
+    env = os.environ.get('SKYTPU_USAGE_ENDPOINT')
+    if env:
+        return env
+    try:
+        from skypilot_tpu import sky_config
+        if sky_config.get_nested(('usage', 'enabled'), False):
+            return sky_config.get_nested(('usage', 'endpoint'), None)
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return None
+
+
+def _post(record: dict, endpoint: str) -> None:
+    try:
+        import requests
+        requests.post(endpoint, json=record, timeout=_TIMEOUT_SECONDS)
+    except Exception:  # pylint: disable=broad-except
+        # Telemetry must never break or slow the actual operation.
+        pass
+
+
+def _send(record: dict) -> None:
+    endpoint = _endpoint()
+    if endpoint is None:
+        return
+    threading.Thread(target=_post, args=(record, endpoint),
+                     daemon=True).start()
+
+
+def entrypoint(fn: Callable) -> Callable:
+    """Decorator recording {entrypoint, runtime, outcome} per call
+    (reference: usage_lib.entrypoint, :446). Redaction: only the function
+    name and coarse outcome are recorded — never arguments, YAML
+    contents, names, or paths."""
+
+    @functools.wraps(fn)
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        start = time.time()
+        outcome = 'success'
+        exception_name = None
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as e:
+            outcome = 'failure'
+            exception_name = type(e).__name__
+            raise
+        finally:
+            from skypilot_tpu.utils import common_utils
+            _send({
+                'schema_version': 1,
+                'entrypoint': fn.__qualname__,
+                'outcome': outcome,
+                'exception': exception_name,
+                'runtime_seconds': round(time.time() - start, 3),
+                'user_hash': common_utils.get_user_hash(),
+                'ts': time.time(),
+            })
+
+    return wrapper
+
+
+def record_exception(context: str) -> None:
+    """Best-effort crash reporting hook (redacted: exception type only)."""
+    exc = traceback.format_exc(limit=0).strip().split('\n')[-1]
+    _send({
+        'schema_version': 1,
+        'entrypoint': context,
+        'outcome': 'crash',
+        'exception': exc.split(':')[0],
+        'ts': time.time(),
+    })
+
+
+def dump_record_for_debug(record: dict) -> str:
+    return json.dumps(record, indent=2)

@@ -1,0 +1,206 @@
+"""Aux subsystems: callbacks, benchmark over candidate slice shapes,
+authentication keypair, usage telemetry redaction, Orbax checkpointing,
+and the train entrypoint's resume path.
+"""
+import json
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import authentication, global_user_state
+from skypilot_tpu import callbacks as callbacks_pkg
+from skypilot_tpu.callbacks.base import BaseCallback
+
+
+@pytest.fixture(autouse=True)
+def aux_env(_isolate_state):
+    global_user_state.set_enabled_clouds(['fake'])
+    yield
+
+
+class TestCallbacks:
+
+    def test_summary_written(self, tmp_path):
+        cb = BaseCallback(log_dir=str(tmp_path), total_steps=5)
+        for _ in range(5):
+            with cb.step():
+                time.sleep(0.01)
+        cb.close()
+        with open(tmp_path / 'summary.json') as f:
+            summary = json.load(f)
+        assert summary['num_steps'] == 5
+        assert summary['total_steps'] == 5
+        assert summary['mean_step_seconds'] > 0
+
+    def test_module_level_api_noop_without_init(self):
+        # Using the hooks without init() must be a clean no-op.
+        callbacks_pkg.on_step_begin()
+        callbacks_pkg.on_step_end()
+        with callbacks_pkg.step():
+            pass
+
+
+class TestAuthentication:
+
+    def test_keypair_generated_once(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYTPU_HOME', str(tmp_path))
+        authentication.get_or_generate_keys.cache_clear()
+        private, public = authentication.get_or_generate_keys()
+        assert os.path.exists(private) and os.path.exists(public)
+        assert oct(os.stat(private).st_mode & 0o777) == '0o600'
+        mtime = os.path.getmtime(private)
+        authentication.get_or_generate_keys.cache_clear()
+        authentication.get_or_generate_keys()
+        assert os.path.getmtime(private) == mtime  # not regenerated
+        metadata = authentication.gcp_ssh_keys_metadata('user1')
+        assert metadata.startswith('user1:ssh-rsa ')
+        authentication.get_or_generate_keys.cache_clear()
+
+    def test_backend_injects_user_prefixed_metadata(self, tmp_path,
+                                                    monkeypatch):
+        # Regression: GCP parses ssh-keys metadata as USER:KEY — a raw
+        # public key authorizes nobody.
+        monkeypatch.setenv('SKYTPU_HOME', str(tmp_path))
+        authentication.get_or_generate_keys.cache_clear()
+        from skypilot_tpu.backends import cloud_tpu_backend
+        value = cloud_tpu_backend.CloudTpuBackend._authorized_key(  # pylint: disable=protected-access
+            generate=True)
+        assert value.startswith('skytpu:ssh-rsa ')
+        authentication.get_or_generate_keys.cache_clear()
+
+    def test_public_key_rederived(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYTPU_HOME', str(tmp_path))
+        authentication.get_or_generate_keys.cache_clear()
+        _, public = authentication.get_or_generate_keys()
+        original = open(public).read()
+        os.remove(public)
+        authentication.get_or_generate_keys.cache_clear()
+        authentication.get_or_generate_keys()
+        assert open(public).read().split()[:2] == original.split()[:2]
+        authentication.get_or_generate_keys.cache_clear()
+
+
+class TestUsage:
+
+    def test_disabled_by_default(self):
+        from skypilot_tpu.usage import usage_lib
+        assert usage_lib._endpoint() is None  # pylint: disable=protected-access
+
+    def test_entrypoint_records_redacted(self, monkeypatch):
+        from skypilot_tpu.usage import usage_lib
+        sent = []
+        monkeypatch.setenv('SKYTPU_USAGE_ENDPOINT', 'http://collector')
+        monkeypatch.setattr(usage_lib, '_post',
+                            lambda record, endpoint: sent.append(record))
+        # _send spawns a thread; patch to synchronous.
+        monkeypatch.setattr(
+            usage_lib, '_send', lambda record: usage_lib._post(  # pylint: disable=protected-access
+                record, usage_lib._endpoint()))  # pylint: disable=protected-access
+
+        @usage_lib.entrypoint
+        def sample_api(secret_path):
+            del secret_path
+            return 42
+
+        assert sample_api('/home/user/secret.yaml') == 42
+        record = sent[0]
+        assert record['entrypoint'].endswith('sample_api')
+        assert record['outcome'] == 'success'
+        # Redaction: no argument values anywhere in the record.
+        assert 'secret' not in json.dumps(record)
+
+    def test_entrypoint_failure_outcome(self, monkeypatch):
+        from skypilot_tpu.usage import usage_lib
+        sent = []
+        monkeypatch.setattr(
+            usage_lib, '_send', lambda record: sent.append(record))
+
+        @usage_lib.entrypoint
+        def bad_api():
+            raise ValueError('user-visible detail')
+
+        with pytest.raises(ValueError):
+            bad_api()
+        assert sent[0]['outcome'] == 'failure'
+        assert sent[0]['exception'] == 'ValueError'
+        assert 'user-visible detail' not in json.dumps(sent[0])
+
+
+class TestCheckpoints:
+
+    def test_save_restore_resume(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        from skypilot_tpu.train.checkpoints import CheckpointManager
+
+        state = {
+            'params': jnp.arange(8.0),
+            'step': jnp.asarray(3),
+        }
+        manager = CheckpointManager(str(tmp_path / 'ckpt'),
+                                    save_interval_steps=1)
+        assert manager.latest_step() is None
+        restored, start = manager.maybe_restore(state)
+        assert start == 0 and restored is state
+        manager.save(5, state, force=True)
+        manager.wait()
+        assert manager.latest_step() == 5
+
+        template = jax.tree.map(jnp.zeros_like, state)
+        restored, start = manager.maybe_restore(template)
+        assert start == 5
+        assert jnp.allclose(restored['params'], state['params'])
+        manager.close()
+
+
+@pytest.mark.slow
+class TestBenchmarkEndToEnd:
+
+    def test_bench_two_candidates(self, tmp_path):
+        """Two candidate slice shapes run the same 'training' task (which
+        reports steps via the callback); the report ranks by $/step."""
+        from skypilot_tpu.benchmark import (benchmark_utils,
+                                            launch_benchmark,
+                                            update_benchmark_results,
+                                            down_benchmark)
+        from skypilot_tpu import core
+
+        # The task emits a callback summary like a real training loop.
+        run = ('python3 -c "'
+               'from skypilot_tpu.callbacks.base import BaseCallback\n'
+               'import time\n'
+               'cb = BaseCallback(total_steps=10)\n'
+               'for _ in range(10):\n'
+               '    cb.on_step_begin(); time.sleep(0.02); cb.on_step_end()\n'
+               'cb.close()"')
+        task = sky.Task(name='benchtask', run=run)
+        task.set_resources({sky.Resources(cloud='fake')})
+
+        clusters = launch_benchmark('b1', task,
+                                    ['tpu-v5e-1', 'tpu-v5e-8'])
+        assert len(clusters) == 2
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            statuses = [
+                core.job_status(c, [1])[1] for c in clusters
+            ]
+            if all(s == 'SUCCEEDED' for s in statuses):
+                break
+            time.sleep(0.5)
+        assert all(s == 'SUCCEEDED' for s in statuses), statuses
+
+        results = update_benchmark_results('b1')
+        assert all(r['num_steps'] == 10 for r in results), results
+        report = benchmark_utils.report('b1', steps_target=1000)
+        for row in report:
+            assert row['cost_per_step'] > 0
+            assert row['seconds_to_target'] > 0
+        # v5e-8 costs 8x more per step at identical step time.
+        by_acc = {r['accelerator']: r for r in report}
+        assert by_acc['tpu-v5e-8']['hourly_cost'] > \
+            by_acc['tpu-v5e-1']['hourly_cost']
+
+        down_benchmark('b1')
+        assert global_user_state.get_clusters() == []
