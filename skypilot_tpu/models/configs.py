@@ -34,9 +34,13 @@ class ModelConfig:
     # matmul outputs (fewer recomputed FLOPs; measured +3.3 MFU pts on
     # llama3-1b/v5e vs 'full').
     remat_policy: str = 'dots'
-    attention_impl: str = 'auto'      # 'auto'|'pallas'|'xla'
+    attention_impl: str = 'auto'      # 'auto'|'pallas'|'xla'|'ring'
     dtype: str = 'bfloat16'           # activation/compute dtype
     param_dtype: str = 'float32'
+    # Autoregressive decode mode: Attention reads/writes a KV cache (the
+    # 'cache' variable collection) instead of full-sequence attention.
+    # Same parameter tree as training — flip with dataclasses.replace.
+    decode: bool = False
 
     @property
     def head_dim(self) -> int:

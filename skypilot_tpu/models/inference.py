@@ -1,0 +1,199 @@
+"""Inference engine: prefill + autoregressive decode over the KV cache.
+
+The reference serves LLMs by launching external engines (vLLM/TGI —
+SURVEY §2.9); here the engine is in-tree and TPU-native: the same
+Transformer (same checkpoint tree) flips to `decode=True`, the KV cache
+shards over the mesh (kv heads on tp, batch on dp/fsdp), prefill is one
+jitted call over the whole prompt, and decode is one jitted
+single-token step — two compilations total, static shapes throughout.
+
+This is the engine behind serve replicas (skypilot_tpu/serve/server.py)
+and the TTFT benchmark.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from skypilot_tpu.models.configs import ModelConfig, get_config
+from skypilot_tpu.models.transformer import Transformer
+
+logger = logging.getLogger(__name__)
+
+
+def greedy_sample(logits: jax.Array, rng: jax.Array,
+                  temperature: float) -> jax.Array:
+    """(B, vocab) → (B,) next token. temperature<=0 ⇒ argmax."""
+    del rng
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def temperature_sample(logits: jax.Array, rng: jax.Array,
+                       temperature: float) -> jax.Array:
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+
+
+class InferenceEngine:
+    """One loaded model + its compiled prefill/decode steps.
+
+    Batch is a fixed `batch_size` (continuous batching is a later
+    optimization); prompts are right-padded token id arrays.
+    """
+
+    def __init__(self, cfg: 'ModelConfig | str',
+                 params: Optional[Any] = None,
+                 batch_size: int = 1,
+                 max_seq_len: Optional[int] = None,
+                 rng_seed: int = 0) -> None:
+        if isinstance(cfg, str):
+            cfg = get_config(cfg)
+        if max_seq_len is not None:
+            cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
+        self.cfg = dataclasses.replace(cfg, decode=True, remat=False)
+        self.batch_size = batch_size
+        self.model = Transformer(self.cfg)
+        if params is None:
+            # Random weights (bring-up / load-testing); real deployments
+            # restore from an Orbax checkpoint (train/checkpoints.py).
+            logger.info('Initializing random weights for %s', cfg.name)
+            init_cfg = dataclasses.replace(self.cfg, decode=False)
+            params = nn.unbox(
+                Transformer(init_cfg).init(
+                    jax.random.PRNGKey(rng_seed),
+                    jnp.ones((1, 8), jnp.int32)))['params']
+        self.params = params
+        self._rng = jax.random.PRNGKey(rng_seed)
+
+        self._prefill = jax.jit(self._prefill_impl,
+                                static_argnames=('prompt_len',))
+        self._decode_step = jax.jit(self._decode_impl,
+                                    donate_argnames=('cache',))
+
+    # ---------------- cache ----------------
+
+    def init_cache(self) -> Any:
+        """Fresh zeroed KV cache for one batch."""
+        shapes = jax.eval_shape(
+            lambda: self.model.init(
+                jax.random.PRNGKey(0),
+                jnp.ones((self.batch_size, 1), jnp.int32),
+                jnp.zeros((self.batch_size, 1), jnp.int32),
+            )['cache'])
+        return nn.unbox(
+            jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes,
+                         is_leaf=lambda x: hasattr(x, 'shape')))
+
+    # ---------------- steps ----------------
+
+    def _prefill_impl(self, params, cache, tokens, prompt_len: int):
+        """Run the whole (padded) prompt through the model; returns
+        (logits at the last real prompt token, cache)."""
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :],
+            tokens.shape)
+        logits, mutated = self.model.apply(
+            {'params': params, 'cache': cache}, tokens, positions,
+            mutable=['cache'])
+        return logits[:, prompt_len - 1, :], mutated['cache']
+
+    def _decode_impl(self, params, cache, token, index):
+        """One decode step: (B, 1) token at position `index`."""
+        positions = jnp.full((token.shape[0], 1), index, jnp.int32)
+        logits, mutated = self.model.apply(
+            {'params': params, 'cache': cache}, token, positions,
+            mutable=['cache'])
+        return logits[:, -1, :], mutated['cache']
+
+    # ---------------- generation ----------------
+
+    def generate(self,
+                 prompt: jnp.ndarray,
+                 max_new_tokens: int = 32,
+                 temperature: float = 0.0,
+                 eos_id: Optional[int] = None
+                 ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+        """prompt: (B, prompt_len) int32. Returns
+        ((B, <=max_new_tokens) generated ids, stats)."""
+        import time
+        assert prompt.ndim == 2 and prompt.shape[0] == self.batch_size, (
+            f'prompt must be ({self.batch_size}, L); got {prompt.shape}')
+        prompt_len = int(prompt.shape[1])
+        assert prompt_len + max_new_tokens <= self.cfg.max_seq_len, (
+            f'{prompt_len}+{max_new_tokens} exceeds max_seq_len '
+            f'{self.cfg.max_seq_len}')
+        sampler = (greedy_sample
+                   if temperature <= 0 else temperature_sample)
+
+        cache = self.init_cache()
+        t0 = time.time()
+        logits, cache = self._prefill(self.params, cache,
+                                      prompt.astype(jnp.int32),
+                                      prompt_len=prompt_len)
+        self._rng, rng = jax.random.split(self._rng)
+        token = sampler(logits, rng, temperature)
+        token.block_until_ready()
+        ttft = time.time() - t0
+
+        out = [token]
+        for step in range(1, max_new_tokens):
+            self._rng, rng = jax.random.split(self._rng)
+            logits, cache = self._decode_step(
+                self.params, cache, out[-1][:, None],
+                jnp.asarray(prompt_len + step - 1, jnp.int32))
+            token = sampler(logits, rng, temperature)
+            out.append(token)
+            if eos_id is not None and bool((token == eos_id).all()):
+                break
+        generated = jnp.stack(out, axis=1)
+        generated.block_until_ready()
+        total = time.time() - t0
+        num_tokens = int(generated.shape[1])
+        stats = {
+            'ttft_s': ttft,
+            'total_s': total,
+            'new_tokens': num_tokens,
+            'decode_tokens_per_s':
+                ((num_tokens - 1) / (total - ttft)
+                 if num_tokens > 1 and total > ttft else None),
+        }
+        return generated, stats
+
+
+def load_params_from_checkpoint(cfg: ModelConfig,
+                                checkpoint_dir: str) -> Any:
+    """Restore trained params from an Orbax checkpoint written by
+    train/run.py (the TrainState tree; params live under 'params')."""
+    from skypilot_tpu.train.checkpoints import CheckpointManager
+    from skypilot_tpu.train.trainer import (TrainConfig,
+                                            create_sharded_state)
+    from skypilot_tpu.parallel import build_mesh, infer_mesh_config
+    mesh = build_mesh(infer_mesh_config(jax.device_count()))
+    state, _ = create_sharded_state(cfg, mesh, jax.random.PRNGKey(0),
+                                    TrainConfig())
+    manager = CheckpointManager(checkpoint_dir)
+    restored, step = manager.maybe_restore(state)
+    if step == 0:
+        raise FileNotFoundError(
+            f'No checkpoint found in {checkpoint_dir!r}.')
+    logger.info('Loaded checkpoint step %d from %s', step, checkpoint_dir)
+    return restored.params
+
+
+@functools.lru_cache(maxsize=2)
+def get_engine(model_name: str, batch_size: int = 1,
+               max_seq_len: Optional[int] = None,
+               checkpoint_dir: Optional[str] = None) -> InferenceEngine:
+    """Process-wide engine cache (the serve server's accessor)."""
+    params = None
+    if checkpoint_dir:
+        cfg = get_config(model_name)
+        params = load_params_from_checkpoint(cfg, checkpoint_dir)
+    return InferenceEngine(model_name, params=params,
+                           batch_size=batch_size, max_seq_len=max_seq_len)

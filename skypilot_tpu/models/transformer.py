@@ -92,8 +92,11 @@ class Attention(nn.Module):
         v = sharding.constrain(v, 'batch', 'seq', 'act_heads', None)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        out = flash_attention(q, k, v, causal=True,
-                              impl=cfg.attention_impl)
+        if cfg.decode:
+            out = self._decode_attention(q, k, v)
+        else:
+            out = flash_attention(q, k, v, causal=True,
+                                  impl=cfg.attention_impl)
         out = nn.DenseGeneral(
             features=cfg.d_model, axis=(-2, -1), use_bias=False,
             dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
@@ -102,6 +105,79 @@ class Attention(nn.Module):
                 ('heads', 'qkv_dim', 'embed')),
             name='o_proj')(out)
         return sharding.constrain(out, 'batch', 'seq', 'act_embed')
+
+    def _decode_attention(self, q: jax.Array, k: jax.Array,
+                          v: jax.Array) -> jax.Array:
+        """KV-cached attention for prefill + autoregressive decode.
+
+        The cache (`'cache'` variable collection) holds K/V over a static
+        max_seq_len window (kv heads sharded on tp, batch on dp/fsdp) plus
+        a fill index. One call appends the current chunk — the whole
+        prompt at prefill, one token per decode step — and attends q to
+        everything cached so far. Static shapes keep a single compiled
+        step; masking hides unfilled slots. (The reference delegates this
+        machinery to vLLM's paged attention — SURVEY §2.9; here it is the
+        in-tree engine behind serve replicas.)
+        """
+        cfg = self.cfg
+        batch, cur_len, _, _ = q.shape
+        if cur_len > cfg.max_seq_len:
+            raise ValueError(
+                f'prompt chunk {cur_len} exceeds max_seq_len '
+                f'{cfg.max_seq_len}')
+        # INVARIANT (caller-enforced — see InferenceEngine.generate's
+        # length assert): cache_index + cur_len <= max_seq_len. The fill
+        # index is traced, so it cannot be checked here; past the window,
+        # dynamic_update_slice clamps and silently overwrites old slots.
+        kv_heads = k.shape[2]
+        cache_shape = (batch, cfg.max_seq_len, kv_heads, cfg.head_dim)
+        cached_key = self.variable(
+            'cache', 'cached_key',
+            lambda: nn.with_logical_partitioning(
+                jnp.zeros, ('batch', None, 'kv_heads', None))(
+                    cache_shape, k.dtype))
+        cached_value = self.variable(
+            'cache', 'cached_value',
+            lambda: nn.with_logical_partitioning(
+                jnp.zeros, ('batch', None, 'kv_heads', None))(
+                    cache_shape, v.dtype))
+        cache_index = self.variable(
+            'cache', 'cache_index', lambda: jnp.zeros((), jnp.int32))
+
+        index = cache_index.value
+        key_box = cached_key.value
+        value_box = cached_value.value
+        key_arr = key_box.unbox() if hasattr(key_box, 'unbox') else key_box
+        value_arr = (value_box.unbox()
+                     if hasattr(value_box, 'unbox') else value_box)
+        key_arr = jax.lax.dynamic_update_slice(key_arr, k, (0, index, 0, 0))
+        value_arr = jax.lax.dynamic_update_slice(value_arr, v,
+                                                 (0, index, 0, 0))
+        if hasattr(key_box, 'replace_boxed'):
+            cached_key.value = key_box.replace_boxed(key_arr)
+            cached_value.value = value_box.replace_boxed(value_arr)
+        else:
+            cached_key.value = key_arr
+            cached_value.value = value_arr
+        cache_index.value = index + cur_len
+
+        # Grouped-query attention directly against the unrepeated KV
+        # cache: repeating kv→num_heads over the whole window would 4x
+        # (n_rep x) the HBM traffic of the op that dominates decode cost.
+        # q groups as (B, Q, KV, rep, D).
+        n_rep = cfg.num_heads // kv_heads
+        q_grouped = q.reshape(batch, cur_len, kv_heads, n_rep,
+                              cfg.head_dim)
+        scores = jnp.einsum('bqkrd,bskd->bkrqs', q_grouped, key_arr,
+                            preferred_element_type=jnp.float32)
+        scores = scores * (cfg.head_dim**-0.5)
+        q_pos = index + jnp.arange(cur_len)[:, None]          # (q, 1)
+        k_pos = jnp.arange(cfg.max_seq_len)[None, :]          # (1, s)
+        mask = k_pos <= q_pos                                  # causal+fill
+        scores = jnp.where(mask[None, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(value_arr.dtype)
+        out = jnp.einsum('bkrqs,bskd->bqkrd', probs, value_arr)
+        return out.reshape(batch, cur_len, cfg.num_heads, cfg.head_dim)
 
 
 class SwiGLU(nn.Module):
@@ -184,7 +260,7 @@ class Transformer(nn.Module):
                                      policy=policy)
             scanned = nn.scan(
                 layer_cls,
-                variable_axes={'params': 0},
+                variable_axes={'params': 0, 'cache': 0},
                 split_rngs={'params': True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: 'layers'},
