@@ -12,6 +12,7 @@ from __future__ import annotations
 import shlex
 from typing import Any, List, Optional
 
+from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.utils import command_runner as runner_lib
 from skypilot_tpu.utils import common_utils
 
@@ -19,10 +20,10 @@ _PREFIX = (
     'from skypilot_tpu.agent import job_lib, autostop_lib; '
     'from skypilot_tpu.utils import common_utils; ')
 
-
 def _build(code: List[str]) -> str:
     body = _PREFIX + '; '.join(code)
-    return f'python3 -u -c {shlex.quote(body)}'
+    return (f'{agent_constants.RUNTIME_PY_RESOLVER}'
+            f'"$_SKYPY" -u -c {shlex.quote(body)}')
 
 
 class JobCodeGen:

@@ -36,6 +36,18 @@ def job_log_dir(run_timestamp: str) -> str:
     return os.path.join(logs_dir(), run_timestamp)
 
 
+# Shipped-runtime layout (backends/wheel_utils.py installs it; codegen RPCs
+# and the agent-start command resolve it). One definition so the install
+# path and the lookup path cannot drift.
+RUNTIME_SUBDIR = 'runtime'
+# Bash prelude: prefer the provision-time-shipped runtime python; plain
+# python3 keeps working for fake-cloud hosts where the runner injects
+# PYTHONPATH instead.
+RUNTIME_PY_RESOLVER = (
+    '_SKYPY="${SKYTPU_HOME:-$HOME/.skytpu}/' + RUNTIME_SUBDIR +
+    '/python"; [ -x "$_SKYPY" ] || _SKYPY=python3; ')
+
+
 # ---------------- rank-wiring env contract ----------------
 # Exported to every rank of every job (replacing the reference's
 # SKYPILOT_NODE_RANK/NODE_IPS/NUM_NODES/NUM_GPUS_PER_NODE exports at

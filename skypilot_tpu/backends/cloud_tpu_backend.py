@@ -36,6 +36,7 @@ from skypilot_tpu.agent import codegen
 from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.backends import backend as backend_lib
 from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.backends import wheel_utils
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.provision import provisioner as provisioner_lib
 from skypilot_tpu.utils import command_runner
@@ -158,10 +159,14 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
             # per-host home exactly as it would on a real TPU host.
             env = {'SKYTPU_HOME': rec['home'], 'HOME': rec['home']}
             # Local "hosts" need the in-repo package importable for codegen
-            # RPCs (real hosts get it installed at provision time).
-            pypath = os.environ.get('PYTHONPATH', '')
-            env['PYTHONPATH'] = (_repo_root() + os.pathsep +
-                                 pypath if pypath else _repo_root())
+            # RPCs. With SKYTPU_SHIP_RUNTIME=1 the injection is dropped and
+            # the host relies on the provision-time runtime install exactly
+            # like a real TPU host — the hermetic test mode for the
+            # wheel-shipping path.
+            if os.environ.get('SKYTPU_SHIP_RUNTIME') != '1':
+                pypath = os.environ.get('PYTHONPATH', '')
+                env['PYTHONPATH'] = (_repo_root() + os.pathsep +
+                                     pypath if pypath else _repo_root())
             return command_runner.LocalCommandRunner(env)
         return command_runner.SSHCommandRunner(
             rec['ip'], rec['ssh_user'], rec['ssh_key'],
@@ -230,7 +235,9 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
                   dryrun: bool,
                   stream_logs: bool,
                   cluster_name: Optional[str] = None,
-                  retry_until_up: bool = False
+                  retry_until_up: bool = False,
+                  blocked_resources: Optional[List] = None,
+                  candidate_resources: Optional[List] = None,
                   ) -> Optional['CloudTpuResourceHandle']:
         if cluster_name is None:
             cluster_name = common_utils.generate_cluster_name()
@@ -241,12 +248,16 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
             'to_provision must be set (run the optimizer first)')
         with backend_utils.cluster_lock(cluster_name):
             return self._provision_locked(task, to_provision, cluster_name,
-                                          retry_until_up)
+                                          retry_until_up, blocked_resources,
+                                          candidate_resources)
 
     def _provision_locked(self, task: 'task_lib.Task',
                           to_provision: 'resources_lib.Resources',
                           cluster_name: str,
-                          retry_until_up: bool) -> 'CloudTpuResourceHandle':
+                          retry_until_up: bool,
+                          blocked_resources: Optional[List] = None,
+                          candidate_resources: Optional[List] = None
+                          ) -> 'CloudTpuResourceHandle':
         # Reuse an existing cluster when it satisfies the request
         # (reference: Resources.less_demanding_than check on reuse,
         # resources.py:1085).
@@ -268,8 +279,17 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
             # cluster lives — run_instances is idempotent and resumes
             # stopped slices (provision/fake, provision/gcp semantics).
             to_provision = launched
+            candidate_resources = None
 
-        engine = provisioner_lib.FailoverEngine()
+        # Failover order: the optimizer's pick first, then its remaining
+        # candidates (other regions/clouds) — the reference walks the same
+        # list on ResourcesUnavailableError (cloud_vm_ray_backend.py:1911).
+        candidates = [to_provision]
+        for cand in candidate_resources or []:
+            if cand is not to_provision:
+                candidates.append(cand)
+        engine = provisioner_lib.FailoverEngine(
+            blocked_resources=blocked_resources)
         # Real clouds SSH in with the framework keypair; generate it once
         # per user (authentication.py). Only the fake cloud (local
         # processes) skips keys — an unresolved (None) cloud defaults to
@@ -278,7 +298,7 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
         while True:
             try:
                 result = engine.provision_with_retries(
-                    cluster_name, [to_provision],
+                    cluster_name, candidates,
                     authorized_key=self._authorized_key(
                         generate=needs_keys))
                 break
@@ -290,7 +310,8 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
                     'sleeping %ss before the next sweep.', cluster_name,
                     _RETRY_UNTIL_UP_GAP_SECONDS)
                 time.sleep(_RETRY_UNTIL_UP_GAP_SECONDS)
-                engine = provisioner_lib.FailoverEngine()
+                engine = provisioner_lib.FailoverEngine(
+                    blocked_resources=blocked_resources)
 
         handle = CloudTpuResourceHandle(cluster_name, result.resources,
                                         result.cluster_info)
@@ -318,9 +339,11 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
         provisioner.post_provision_runtime_setup → _post_provision_setup,
         sky/provision/provisioner.py:404-557: wait ssh, file mounts, deps,
         start runtime, start skylet). TPU hosts ship with python3; the
-        agent is pure stdlib, so bootstrap = create state dirs + launch the
-        agent daemon on the head host."""
+        agent is pure stdlib, so bootstrap = create state dirs + install the
+        framework runtime + launch the agent daemon on the head host."""
         recs = handle.host_records()
+        ship = (not handle.is_local or
+                os.environ.get('SKYTPU_SHIP_RUNTIME') == '1')
 
         def _bootstrap(rec):
             runner = handle._make_runner(rec)  # pylint: disable=protected-access
@@ -331,9 +354,23 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
             if rc != 0:
                 raise exceptions.ClusterSetUpError(
                     f'Host bootstrap failed on {rec["ip"]} (rc={rc}).')
+            if ship:
+                # Every host runs the same code as the client (reference:
+                # wheel install on all nodes, instance_setup.py:170-240).
+                # Version-checked: a warm host is one `cat` away from done.
+                wheel_utils.install_runtime(
+                    runner, self._runtime_dir(rec))
 
         subprocess_utils.run_in_parallel(_bootstrap, recs)
         self._maybe_start_agent(handle)
+
+    @staticmethod
+    def _runtime_dir(rec: Dict[str, Any]) -> str:
+        """Host-side runtime root, matching where the codegen resolver
+        looks: ${SKYTPU_HOME:-$HOME/.skytpu}/runtime."""
+        if rec.get('runner') == 'local':
+            return os.path.join(rec['home'], wheel_utils.RUNTIME_SUBDIR)
+        return '~/.skytpu/' + wheel_utils.RUNTIME_SUBDIR
 
     def _maybe_start_agent(self, handle: 'CloudTpuResourceHandle') -> None:
         """Start the agent daemon (autostop ticks, queue reconciliation) on
@@ -346,7 +383,8 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
         head = handle.host_records()[0]
         runner = handle._make_runner(head)  # pylint: disable=protected-access
         runner.run(
-            'nohup python3 -m skypilot_tpu.agent.agent '
+            wheel_utils.RUNTIME_PY_RESOLVER +
+            'nohup "$_SKYPY" -m skypilot_tpu.agent.agent '
             f'--cluster {handle.cluster_name} '
             f'--provider {handle.cluster_info.provider_name} '
             '>> "${SKYTPU_HOME:-$HOME/.skytpu}/agent.log" 2>&1 '

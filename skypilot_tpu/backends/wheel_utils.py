@@ -1,0 +1,178 @@
+"""Ship the framework runtime to cluster hosts.
+
+Reference parity: sky/backends/wheel_utils.py:1-60 (build the skypilot
+wheel locally, content-hashed, cached) + sky/provision/instance_setup.py:
+170-240 (install it on every node so the cluster runs the same code as the
+client). Without this, every codegen RPC (`python3 -c "from skypilot_tpu
+..."`) and the agent daemon would only work where the package happens to
+be importable — i.e. nowhere but the dev machine.
+
+TPU-native simplification: instead of a pip wheel + venv (which needs pip,
+network access, and a build backend on the host), we ship a content-hashed
+source tarball and install it as `$SKYTPU_HOME/runtime/<version>/` with a
+tiny `python` wrapper script that prepends the runtime to PYTHONPATH. TPU
+VM hosts ship with python3; the agent is pure stdlib, so this is a
+complete install. Re-installs are version-checked and skipped (`exec`
+fast path stays fast, reference: wheel-hash check in
+backend_utils.write_cluster_config, backend_utils.py:751).
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import logging
+import os
+import shlex
+import tarfile
+import threading
+import typing
+
+from skypilot_tpu.agent import constants as agent_constants
+
+logger = logging.getLogger(__name__)
+
+# File types that make up the runtime: sources, native sources, catalog
+# data. Compiled artifacts (.so) are host-specific and rebuilt on demand
+# by native/logmux.py's lazy compile (with a pure-Python fallback).
+_SHIP_SUFFIXES = ('.py', '.cpp', '.h', '.csv', '.json')
+
+# Remote layout, rooted at the host's SKYTPU_HOME:
+#   runtime/<version>/skypilot_tpu/...   the package tree
+#   runtime/<version>/VERSION            the content hash
+#   runtime/current -> <version>         atomic switch
+#   runtime/python                       PYTHONPATH-injecting wrapper
+# The layout contract (subdir name + resolver) lives in agent/constants so
+# the install path and the codegen lookup path cannot drift.
+RUNTIME_SUBDIR = agent_constants.RUNTIME_SUBDIR
+RUNTIME_PY_RESOLVER = agent_constants.RUNTIME_PY_RESOLVER
+
+_build_lock = threading.Lock()
+
+_PY_WRAPPER = """#!/bin/sh
+# Auto-generated: run python3 with the shipped skypilot_tpu runtime
+# importable. Keeps the host's own PYTHONPATH after ours.
+d="$(CDPATH= cd -- "$(dirname -- "$0")" && pwd)/current"
+export PYTHONPATH="$d${PYTHONPATH:+:$PYTHONPATH}"
+exec python3 "$@"
+"""
+
+_cached_tarball: 'typing.Optional[typing.Tuple[str, str]]' = None
+
+
+def _package_dir() -> str:
+    import skypilot_tpu
+    return os.path.dirname(os.path.abspath(skypilot_tpu.__file__))
+
+
+def _iter_ship_files() -> 'typing.Iterator[typing.Tuple[str, str]]':
+    """(abs_path, archive_relpath) for every shipped file, sorted."""
+    pkg = _package_dir()
+    entries = []
+    for root, dirs, files in os.walk(pkg):
+        dirs[:] = sorted(d for d in dirs if d != '__pycache__')
+        for f in sorted(files):
+            if f.endswith(_SHIP_SUFFIXES):
+                abs_path = os.path.join(root, f)
+                rel = os.path.join('skypilot_tpu',
+                                   os.path.relpath(abs_path, pkg))
+                entries.append((abs_path, rel))
+    return iter(entries)
+
+
+def _local_cache_dir() -> str:
+    home = os.path.expanduser(os.environ.get('SKYTPU_HOME', '~/.skytpu'))
+    d = os.path.join(home, 'runtime_pkg')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def build_runtime_tarball() -> 'typing.Tuple[str, str]':
+    """Build (or reuse) the content-hashed runtime tarball.
+
+    Returns (tarball_path, version). Version is the sha256 over every
+    shipped file's relpath+content, so any source edit produces a new
+    version and a fresh install on the next provision (reference:
+    wheel_utils.build_sky_wheel caching by content hash).
+    """
+    global _cached_tarball
+    # Serialized: _post_provision_setup installs per-host from a thread
+    # pool, and concurrent builders writing one temp file would corrupt
+    # the gzip stream.
+    with _build_lock:
+        hasher = hashlib.sha256()
+        files = list(_iter_ship_files())
+        for abs_path, rel in files:
+            hasher.update(rel.encode())
+            with open(abs_path, 'rb') as f:
+                hasher.update(f.read())
+        version = hasher.hexdigest()[:16]
+        if _cached_tarball is not None and _cached_tarball[1] == version \
+                and os.path.exists(_cached_tarball[0]):
+            return _cached_tarball
+        tar_path = os.path.join(_local_cache_dir(),
+                                f'skypilot_tpu-{version}.tar.gz')
+        if not os.path.exists(tar_path):
+            # Unique temp name: other *processes* (e.g. concurrent
+            # launches) may race too; os.replace publishes atomically.
+            tmp = f'{tar_path}.{os.getpid()}.tmp'
+            with tarfile.open(tmp, 'w:gz') as tar:
+                for abs_path, rel in files:
+                    tar.add(abs_path, arcname=rel)
+                data = version.encode()
+                info = tarfile.TarInfo('VERSION')
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+            os.replace(tmp, tar_path)
+            logger.debug('Built runtime tarball %s (%d files).', tar_path,
+                         len(files))
+        _cached_tarball = (tar_path, version)
+        return _cached_tarball
+
+
+def install_runtime(runner, runtime_dir: str) -> bool:
+    """Install the runtime onto one host; returns True if work was done.
+
+    `runtime_dir` is the host-side path of the runtime root (for SSH
+    hosts `~/.skytpu/runtime`; for fake-cloud local hosts the per-host
+    home's `runtime/`). Version-checked: a host already at the current
+    version is a no-op (one cheap `cat`), which keeps `exec` fast.
+    """
+    tar_path, version = build_runtime_tarball()
+    q = shlex.quote
+    if runtime_dir.startswith('~/'):
+        # SSH hosts: keep `~` unquoted so the remote shell expands it;
+        # the fixed suffix (.skytpu/runtime) needs no quoting.
+        rd = '~/' + q(runtime_dir[2:])
+    else:
+        rd = q(runtime_dir)
+    check = runner.run(
+        f'[ "$(cat {rd}/current/VERSION 2>/dev/null)" = {q(version)} ]',
+        stream_logs=False)
+    if check == 0:
+        return False
+    tar_name = os.path.basename(tar_path)
+    rc = runner.run(f'mkdir -p {rd}', stream_logs=False)
+    if rc != 0:
+        from skypilot_tpu import exceptions
+        raise exceptions.ClusterSetUpError(
+            f'Failed to create runtime dir {runtime_dir} (rc={rc}).')
+    # rsync takes the RAW path (it is not a shell command: the local
+    # runner mirrors with python, the ssh runner hands the path to rsync).
+    runner.rsync(tar_path, f'{runtime_dir}/{tar_name}', up=True)
+    wrapper = shlex.quote(_PY_WRAPPER)
+    rc, stdout, stderr = runner.run(
+        f'cd {rd} && rm -rf {q(version)}.tmp && '
+        f'mkdir -p {q(version)}.tmp && '
+        f'tar -xzf {q(tar_name)} -C {q(version)}.tmp && '
+        f'rm -rf {q(version)} && mv {q(version)}.tmp {q(version)} && '
+        f'ln -sfn {q(version)} current && '
+        f'printf %s {wrapper} > python && chmod +x python && '
+        f'rm -f {q(tar_name)}',
+        require_outputs=True, stream_logs=False)
+    if rc != 0:
+        from skypilot_tpu import exceptions
+        raise exceptions.ClusterSetUpError(
+            f'Runtime install failed in {runtime_dir} (rc={rc}): '
+            f'{stderr or stdout}')
+    logger.debug('Installed runtime %s into %s.', version, runtime_dir)
+    return True

@@ -8,7 +8,6 @@ host).
 """
 from __future__ import annotations
 
-import copy
 import json
 import logging
 import os
@@ -49,7 +48,7 @@ def launch_benchmark(benchmark: str, task: 'task_lib.Task',
     launch_args = []
     for index, accelerator in enumerate(candidates):
         resources = base.copy(accelerators=accelerator)
-        candidate_task = copy.copy(task)
+        candidate_task = task.copy()
         candidate_task.set_resources({resources})
         candidate_task.update_envs(
             {'SKYTPU_CALLBACK_LOG_DIR': _CALLBACK_DIR})

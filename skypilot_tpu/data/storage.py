@@ -111,37 +111,68 @@ class AbstractStore:
 
 class GcsStore(AbstractStore):
     """(reference: GcsStore, storage.py:1497 — gsutil/`gcloud storage`
-    sync + gcsfuse mounts)"""
+    sync + gcsfuse mounts)
+
+    Commands run as argv lists (no shell), so user-controlled paths and
+    bucket names cannot inject shell syntax; when the primary tool fails
+    and the fallback also fails, BOTH stderrs are surfaced."""
 
     STORE_TYPE = StoreType.GCS
 
     def url(self) -> str:
         return f'gs://{self.name}'
 
-    def _run(self, cmd: str) -> None:
-        proc = subprocess.run(cmd, shell=True, capture_output=True,
-                              text=True, check=False)
-        if proc.returncode != 0:
-            raise exceptions.StorageUploadError(
-                f'Command failed ({cmd!r}):\n{proc.stderr}')
+    @staticmethod
+    def _run_first_ok(argv_attempts: list, what: str) -> None:
+        """Run each argv until one succeeds; on total failure raise with
+        every attempt's stderr (the old `a 2>/dev/null || b` pattern
+        silently discarded the primary tool's diagnostics)."""
+        errors = []
+        for argv in argv_attempts:
+            try:
+                proc = subprocess.run(argv, capture_output=True,
+                                      text=True, check=False)
+            except FileNotFoundError as e:
+                errors.append(f'{argv[0]}: {e}')
+                continue
+            if proc.returncode == 0:
+                return
+            errors.append(f'$ {" ".join(argv)}\n'
+                          f'[rc={proc.returncode}] {proc.stderr.strip()}')
+        raise exceptions.StorageUploadError(
+            f'{what} failed; all attempts:\n' + '\n'.join(errors))
 
     def initialize(self) -> None:
-        self._run(f'gcloud storage buckets describe gs://{self.name} '
-                  f'>/dev/null 2>&1 || '
-                  f'gcloud storage buckets create gs://{self.name}')
+        try:
+            probe = subprocess.run(
+                ['gcloud', 'storage', 'buckets', 'describe',
+                 f'gs://{self.name}'],
+                capture_output=True, text=True, check=False)
+            if probe.returncode == 0:
+                return
+        except FileNotFoundError:
+            pass  # no gcloud binary: the create attempt reports it
+        self._run_first_ok(
+            [['gcloud', 'storage', 'buckets', 'create',
+              f'gs://{self.name}']],
+            what=f'Creating bucket gs://{self.name}')
 
     def upload(self) -> None:
         assert self.source is not None and not \
             data_utils.is_cloud_uri(self.source)
         src = os.path.expanduser(self.source)
         # rsync semantics like the reference's `gsutil -m rsync -r`.
-        self._run(f'gcloud storage rsync -r {src} gs://{self.name} '
-                  f'2>/dev/null || gsutil -m rsync -r {src} '
-                  f'gs://{self.name}')
+        self._run_first_ok(
+            [['gcloud', 'storage', 'rsync', '-r', src,
+              f'gs://{self.name}'],
+             ['gsutil', '-m', 'rsync', '-r', src, f'gs://{self.name}']],
+            what=f'Uploading {src!r} to gs://{self.name}')
 
     def delete(self) -> None:
-        self._run(f'gcloud storage rm -r gs://{self.name} 2>/dev/null '
-                  f'|| gsutil -m rm -r gs://{self.name}')
+        self._run_first_ok(
+            [['gcloud', 'storage', 'rm', '-r', f'gs://{self.name}'],
+             ['gsutil', '-m', 'rm', '-r', f'gs://{self.name}']],
+            what=f'Deleting gs://{self.name}')
 
     def mount_command(self, mount_path: str) -> str:
         return mounting_utils.get_gcsfuse_mount_cmd(self.name, mount_path)

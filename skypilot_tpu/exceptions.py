@@ -73,6 +73,13 @@ class ClusterSetUpError(SkyTpuError):
     """Runtime bootstrap (agent start, env setup) failed on the slice."""
 
 
+class ClusterTeardownError(SkyTpuError):
+    """Teardown retries exhausted; the cluster may still be live.
+
+    Managed-job recovery must NOT relaunch after this — doing so risks a
+    double provision (two billed slices under one job)."""
+
+
 class CloudUserIdentityError(SkyTpuError):
     """Failed to determine the active cloud identity."""
 

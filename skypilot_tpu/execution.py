@@ -58,8 +58,15 @@ def _execute(
     retry_until_up: bool = False,
     minimize: optimizer.OptimizeTarget = optimizer.OptimizeTarget.COST,
     quiet_optimizer: bool = False,
+    blocked_resources: Optional[List] = None,
 ):
-    """(reference: _execute, sky/execution.py:95)"""
+    """(reference: _execute, sky/execution.py:95)
+
+    `blocked_resources` filters optimizer candidates AND seeds the
+    failover engine's blocklist — managed-job recovery passes the zone
+    that just preempted the task so relaunch avoids it (reference:
+    EAGER_NEXT_REGION blocking the launched region first,
+    sky/jobs/recovery_strategy.py:458-543)."""
     dag = _as_dag(task_or_dag)
     if len(dag.tasks) != 1:
         raise exceptions.NotSupportedError(
@@ -87,21 +94,28 @@ def _execute(
         # does not exist yet).
         record = (global_user_state.get_cluster_from_name(cluster_name)
                   if cluster_name else None)
+        candidates = None
         if record is not None and record['handle'] is not None:
+            # Existing cluster pins the placement: no failover candidates.
             to_provision = record['handle'].launched_resources
         elif Stage.OPTIMIZE in stages:
             dag = optimizer.optimize(dag, minimize=minimize,
+                                     blocked_resources=blocked_resources,
                                      quiet=quiet_optimizer or dryrun)
             to_provision = task.best_resources()
+            candidates = task.ordered_candidates()
         else:
             to_provision = task.best_resources()
+            candidates = task.ordered_candidates()
         if dryrun:
             logger.info('Dryrun: would provision %s.', to_provision)
             return None, None
         handle = backend.provision(task, to_provision, dryrun=False,
                                    stream_logs=stream_logs,
                                    cluster_name=cluster_name,
-                                   retry_until_up=retry_until_up)
+                                   retry_until_up=retry_until_up,
+                                   blocked_resources=blocked_resources,
+                                   candidate_resources=candidates)
     else:
         assert cluster_name is not None
         handle = backend_utils.check_cluster_available(cluster_name, 'exec')
@@ -136,6 +150,7 @@ def launch(
     retry_until_up: bool = False,
     minimize: optimizer.OptimizeTarget = optimizer.OptimizeTarget.COST,
     quiet_optimizer: bool = False,
+    blocked_resources: Optional[List] = None,
 ):
     """Provision (or reuse) a cluster and run the task on it
     (reference: sky.launch, execution.py:347). Returns (job_id, handle)."""
@@ -144,7 +159,8 @@ def launch(
                     detach_run=detach_run,
                     idle_minutes_to_autostop=idle_minutes_to_autostop,
                     retry_until_up=retry_until_up, minimize=minimize,
-                    quiet_optimizer=quiet_optimizer)
+                    quiet_optimizer=quiet_optimizer,
+                    blocked_resources=blocked_resources)
 
 
 @timeline.event

@@ -70,6 +70,20 @@ class JobsController:
                 exceptions.JobNotFoundError):
             return None
 
+    def _best_effort_teardown(self) -> None:
+        """Terminal-state cleanup (job already succeeded/failed/cancelled):
+        a teardown failure must not corrupt the final job status. Only the
+        recovery path treats teardown failure as fatal (relaunching over a
+        possibly-live slice risks a double provision)."""
+        assert self.strategy is not None
+        try:
+            self.strategy.terminate_cluster()
+        except exceptions.ClusterTeardownError as e:
+            logger.warning(
+                'Best-effort teardown of %s failed (job status is already '
+                'terminal; the slice may need manual cleanup): %s',
+                self.strategy.cluster_name, e)
+
     def _cluster_is_up(self, cluster_name: str) -> bool:
         try:
             status, _ = backend_utils.refresh_cluster_status_handle(
@@ -123,7 +137,7 @@ class JobsController:
         while True:
             if self._cancelled():
                 jobs_state.set_cancelling(job_id)
-                self.strategy.terminate_cluster()
+                self._best_effort_teardown()
                 jobs_state.set_cancelled(job_id)
                 return False
             time.sleep(gap)
@@ -131,7 +145,7 @@ class JobsController:
 
             if status == 'SUCCEEDED':
                 jobs_state.set_succeeded(job_id, task_id)
-                self.strategy.terminate_cluster()
+                self._best_effort_teardown()
                 return True
 
             # Cloud truth trumps the job-status RPC: a TPU slice can lose
@@ -153,7 +167,7 @@ class JobsController:
                     jobs_state.set_failed(
                         job_id, task_id, failure,
                         f'Task exited with status {status}.')
-                    self.strategy.terminate_cluster()
+                    self._best_effort_teardown()
                     return False
                 self._recover(task_id)
                 continue
@@ -161,7 +175,7 @@ class JobsController:
             if status == 'CANCELLED':
                 # Cancelled out-of-band on the cluster itself.
                 jobs_state.set_cancelling(job_id)
-                self.strategy.terminate_cluster()
+                self._best_effort_teardown()
                 jobs_state.set_cancelled(job_id)
                 return False
             # None (transient RPC failure on a healthy cluster) or

@@ -91,8 +91,14 @@ class StrategyExecutor:
     def terminate_cluster(self, max_retry: int = 3) -> None:
         """Delete the task cluster (TPU slices cannot stop — full delete;
         reference: recovery_strategy.py terminate_cluster + TPU cleanup at
-        jobs/controller.py:305-315)."""
+        jobs/controller.py:305-315).
+
+        Raises ClusterTeardownError when every retry fails: relaunching
+        while the old slice may still exist risks a double provision (two
+        live clusters billing under one managed job), so the caller must
+        see the failure rather than proceed."""
         from skypilot_tpu import core
+        last_error: Optional[Exception] = None
         for attempt in range(max_retry):
             try:
                 record = global_user_state.get_cluster_from_name(
@@ -105,12 +111,18 @@ class StrategyExecutor:
             except exceptions.ClusterNotUpError:
                 return
             except Exception as e:  # pylint: disable=broad-except
+                last_error = e
                 logger.warning('Failed to terminate %s (attempt %d): %s',
                                self.cluster_name, attempt, e)
                 time.sleep(min(2 ** attempt, 10))
+        raise exceptions.ClusterTeardownError(
+            f'Failed to terminate cluster {self.cluster_name!r} after '
+            f'{max_retry} attempts; refusing to relaunch over a possibly '
+            f'live slice.') from last_error
 
     def _launch(self, raise_on_failure: bool = True,
-                resources_override: Optional[dict] = None
+                resources_override: Optional[dict] = None,
+                blocked_resources: Optional[list] = None
                 ) -> Optional[float]:
         """One launch attempt cycle: walk the optimizer's candidates via
         execution.launch (which itself fails over across zones/regions),
@@ -123,8 +135,7 @@ class StrategyExecutor:
             new_resources = {
                 r.copy(**resources_override) for r in task.resources
             }
-            import copy
-            task = copy.copy(task)
+            task = task.copy()
             task.set_resources(new_resources)
 
         backoff = constants.recovery_wait_seconds()
@@ -135,7 +146,8 @@ class StrategyExecutor:
                     cluster_name=self.cluster_name,
                     detach_run=True,
                     stream_logs=False,
-                    quiet_optimizer=True)
+                    quiet_optimizer=True,
+                    blocked_resources=blocked_resources)
                 assert job_id is not None and handle is not None
                 return time.time()
             except exceptions.ProvisionPrechecksError:
@@ -219,11 +231,31 @@ class EagerFailoverStrategyExecutor(FailoverStrategyExecutor):
     NAME = 'EAGER_NEXT_REGION'
 
     def recover(self) -> float:
-        # Terminate first, then relaunch with no location pin: the
-        # optimizer+failover engine walks every candidate zone, and the
-        # preempting zone naturally sorts last once its capacity error
-        # lands in the failover blocklist.
+        # Terminate first, then relaunch with the zone that just preempted
+        # us explicitly blocked: it is the least likely to have capacity,
+        # and without an explicit block nothing would stop the optimizer
+        # from picking it right back (the failover engine is constructed
+        # fresh per launch, so no state persists across recover() calls).
+        # Reference: sky/jobs/recovery_strategy.py:458-543 blocks the
+        # launched region before moving on. If every OTHER zone is
+        # exhausted, fall back to an unconstrained launch — the preempting
+        # zone is a long shot but better than giving up.
         self.terminate_cluster()
-        launched = self._launch(raise_on_failure=True)
+        blocked = []
+        if self._launched_zone is not None or \
+                self._launched_region is not None:
+            from skypilot_tpu import resources as resources_lib
+            base = next(iter(self.task.resources))
+            blocked.append(resources_lib.Resources(
+                cloud=base.cloud_name,
+                region=self._launched_region,
+                zone=self._launched_zone))
+        launched = self._launch(raise_on_failure=blocked == [],
+                                blocked_resources=blocked or None)
+        if launched is None:
+            logger.info(
+                'No capacity outside the preempting zone %s; retrying '
+                'without the block.', self._launched_zone)
+            launched = self._launch(raise_on_failure=True)
         self._record_location()
         return launched

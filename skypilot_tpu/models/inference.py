@@ -40,11 +40,34 @@ def temperature_sample(logits: jax.Array, rng: jax.Array,
     return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
 
 
+def _resolve_cfg_and_params(cfg: 'ModelConfig | str',
+                            params: Optional[Any],
+                            max_seq_len: Optional[int],
+                            rng_seed: int):
+    """Shared engine bring-up: normalize config to decode mode and init
+    random weights when no checkpoint is given (bring-up / load-testing;
+    real deployments restore via train/checkpoints.py)."""
+    if isinstance(cfg, str):
+        cfg = get_config(cfg)
+    if max_seq_len is not None:
+        cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
+    cfg = dataclasses.replace(cfg, decode=True, remat=False)
+    if params is None:
+        logger.info('Initializing random weights for %s', cfg.name)
+        init_cfg = dataclasses.replace(cfg, decode=False)
+        params = nn.unbox(
+            Transformer(init_cfg).init(
+                jax.random.PRNGKey(rng_seed),
+                jnp.ones((1, 8), jnp.int32)))['params']
+    return cfg, params
+
+
 class InferenceEngine:
     """One loaded model + its compiled prefill/decode steps.
 
-    Batch is a fixed `batch_size` (continuous batching is a later
-    optimization); prompts are right-padded token id arrays.
+    Batch is a fixed `batch_size`; prompts are right-padded token id
+    arrays. For slot-based continuous batching use
+    ContinuousBatchingEngine below.
     """
 
     def __init__(self, cfg: 'ModelConfig | str',
@@ -52,23 +75,10 @@ class InferenceEngine:
                  batch_size: int = 1,
                  max_seq_len: Optional[int] = None,
                  rng_seed: int = 0) -> None:
-        if isinstance(cfg, str):
-            cfg = get_config(cfg)
-        if max_seq_len is not None:
-            cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
-        self.cfg = dataclasses.replace(cfg, decode=True, remat=False)
+        self.cfg, self.params = _resolve_cfg_and_params(
+            cfg, params, max_seq_len, rng_seed)
         self.batch_size = batch_size
         self.model = Transformer(self.cfg)
-        if params is None:
-            # Random weights (bring-up / load-testing); real deployments
-            # restore from an Orbax checkpoint (train/checkpoints.py).
-            logger.info('Initializing random weights for %s', cfg.name)
-            init_cfg = dataclasses.replace(self.cfg, decode=False)
-            params = nn.unbox(
-                Transformer(init_cfg).init(
-                    jax.random.PRNGKey(rng_seed),
-                    jnp.ones((1, 8), jnp.int32)))['params']
-        self.params = params
         self._rng = jax.random.PRNGKey(rng_seed)
 
         self._prefill = jax.jit(self._prefill_impl,
@@ -164,6 +174,308 @@ class InferenceEngine:
                  if num_tokens > 1 and total > ttft else None),
         }
         return generated, stats
+
+
+class _Request:
+    """One in-flight generation (continuous-batching bookkeeping)."""
+
+    __slots__ = ('ids', 'max_new_tokens', 'temperature', 'eos_id',
+                 'future', 'submit_time', 'first_token_time', 'tokens',
+                 'next_pos')
+
+    def __init__(self, ids, max_new_tokens, temperature, eos_id, future):
+        import time
+        self.ids = list(ids)
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.eos_id = eos_id
+        self.future = future
+        self.submit_time = time.time()
+        self.first_token_time: Optional[float] = None
+        self.tokens: list = []
+        self.next_pos = 0  # cache position the NEXT input token writes to
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching (JetStream-style, simplified).
+
+    The decode batch is `num_slots` persistent slots over one shared KV
+    cache; a scheduler thread admits queued prompts into free slots
+    BETWEEN decode ticks, so new requests do not wait for in-flight ones
+    to finish — the defining property of continuous batching. Prefill is
+    one jitted call per power-of-two prompt bucket; decode is one jitted
+    all-slots step. Rows sit at different depths via the per-row cache
+    positions in Attention._decode_attention.
+
+    (The reference gets this from vLLM — SURVEY §2.9; here it is the
+    in-tree TTFT-critical path behind serve replicas and
+    `bench.py --serve`.)
+    """
+
+    def __init__(self, cfg: 'ModelConfig | str',
+                 params: Optional[Any] = None,
+                 num_slots: int = 4,
+                 max_seq_len: Optional[int] = None,
+                 rng_seed: int = 0,
+                 mesh: Optional[Any] = None) -> None:
+        import queue as queue_lib
+        import threading
+        self.cfg, self.params = _resolve_cfg_and_params(
+            cfg, params, max_seq_len, rng_seed)
+        self.num_slots = num_slots
+        self.mesh = mesh
+        self.model = Transformer(self.cfg)
+        self._rng = jax.random.PRNGKey(rng_seed)
+
+        self._prefill = jax.jit(self._prefill_impl)
+        self._insert = jax.jit(self._insert_impl,
+                               donate_argnames=('cache',))
+        self._decode = jax.jit(self._decode_impl,
+                               donate_argnames=('cache',))
+
+        self._queue: 'queue_lib.Queue[_Request]' = queue_lib.Queue()
+        self._slots: list = [None] * num_slots  # _Request or None
+        self._cache = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._thread_lock = threading.Lock()
+        # (decode_step, frozenset(active slot ids)) history — lets tests
+        # assert that requests really interleaved.
+        self.step_log: list = []
+        self._decode_steps = 0
+
+    # ---------------- jitted pieces ----------------
+
+    def _single_cache_shapes(self):
+        return jax.eval_shape(
+            lambda: self.model.init(
+                jax.random.PRNGKey(0), jnp.ones((1, 1), jnp.int32),
+                jnp.zeros((1, 1), jnp.int32))['cache'])
+
+    def _init_slot_cache(self) -> Any:
+        """Zeroed cache with batch == num_slots."""
+        shapes = jax.eval_shape(
+            lambda: self.model.init(
+                jax.random.PRNGKey(0),
+                jnp.ones((self.num_slots, 1), jnp.int32),
+                jnp.zeros((self.num_slots, 1), jnp.int32))['cache'])
+        return nn.unbox(
+            jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes,
+                         is_leaf=lambda x: hasattr(x, 'shape')))
+
+    def _prefill_impl(self, params, tokens, true_len):
+        """tokens: (1, bucket) right-padded; returns (logits at token
+        true_len-1, a fresh batch-1 cache holding the prompt KV)."""
+        cache1 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            nn.unbox(self._single_cache_shapes()),
+            is_leaf=lambda x: hasattr(x, 'shape'))
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :],
+            tokens.shape)
+        logits, mutated = self.model.apply(
+            {'params': params, 'cache': cache1}, tokens, positions,
+            mutable=['cache'])
+        last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
+                                            keepdims=False)
+        return last[0], nn.unbox(mutated['cache'])
+
+    def _insert_impl(self, cache, cache1, slot):
+        """Copy a batch-1 prefilled cache into slot `slot` of the big
+        cache. Cache leaves are (batch, S, KV, D) or, under scanned
+        layers, (layers, batch, S, KV, D): the batch axis is ndim-4."""
+
+        def ins(full, one):
+            start = [jnp.zeros((), jnp.int32)] * full.ndim
+            start[full.ndim - 4] = slot
+            return jax.lax.dynamic_update_slice(full, one, tuple(start))
+
+        return jax.tree.map(ins, cache, cache1)
+
+    def _decode_impl(self, params, cache, tokens, positions, temps, rng):
+        """One all-slots decode tick WITH in-jit sampling (one host sync
+        per tick instead of one per slot — the difference between ~ms and
+        ~100ms ticks over a remote-chip tunnel). tokens/positions:
+        (num_slots, 1); temps: (num_slots,) — <=0 means greedy."""
+        logits, mutated = self.model.apply(
+            {'params': params, 'cache': cache}, tokens, positions,
+            mutable=['cache'])
+        last = logits[:, -1, :].astype(jnp.float32)
+        greedy = jnp.argmax(last, axis=-1)
+        sampled = jax.random.categorical(
+            rng, last / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
+        out = jnp.where(temps <= 0, greedy, sampled).astype(jnp.int32)
+        return out, nn.unbox(mutated['cache'])
+
+    # ---------------- scheduler ----------------
+
+    def _ensure_thread(self) -> None:
+        import threading
+        with self._thread_lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True,
+                                                name='cbatch-engine')
+                self._thread.start()
+
+    def _sample(self, logits_row, temperature: float) -> int:
+        if temperature <= 0:
+            return int(jnp.argmax(logits_row))
+        self._rng, rng = jax.random.split(self._rng)
+        return int(jax.random.categorical(
+            rng, logits_row.astype(jnp.float32) / max(temperature, 1e-6)))
+
+    def _bucket(self, length: int) -> int:
+        bucket = 16
+        while bucket < length:
+            bucket *= 2
+        return min(bucket, self.cfg.max_seq_len)
+
+    def _admit(self, slot: int, req: '_Request') -> None:
+        import time
+        true_len = len(req.ids)
+        bucket = self._bucket(true_len)
+        padded = req.ids + [0] * (bucket - true_len)
+        tokens = jnp.asarray([padded], jnp.int32)
+        logits, cache1 = self._prefill(self.params, tokens,
+                                       jnp.asarray(true_len, jnp.int32))
+        first = self._sample(logits, req.temperature)
+        req.first_token_time = time.time()
+        req.tokens.append(first)
+        req.next_pos = true_len
+        self._cache = self._insert(self._cache, cache1,
+                                   jnp.asarray(slot, jnp.int32))
+        self._slots[slot] = req
+
+    def _finish(self, slot: int) -> None:
+        import time
+        req = self._slots[slot]
+        self._slots[slot] = None
+        stats = {
+            'ttft_s': req.first_token_time - req.submit_time,
+            'total_s': time.time() - req.submit_time,
+            'new_tokens': len(req.tokens),
+            'prompt_tokens': len(req.ids),
+        }
+        req.future.set_result((list(req.tokens), stats))
+
+    def _loop(self) -> None:
+        import contextlib
+        ctx = self.mesh if self.mesh is not None else \
+            contextlib.nullcontext()
+        with ctx:
+            if self._cache is None:
+                self._cache = self._init_slot_cache()
+            while not self._stop.is_set():
+                try:
+                    self._tick()
+                except Exception as e:  # pylint: disable=broad-except
+                    # Fail every in-flight/queued request rather than
+                    # hang their futures, then keep serving.
+                    logger.exception('decode tick failed: %s', e)
+                    for slot in range(self.num_slots):
+                        req = self._slots[slot]
+                        if req is not None:
+                            self._slots[slot] = None
+                            req.future.set_exception(e)
+                    while not self._queue.empty():
+                        try:
+                            self._queue.get_nowait().future.set_exception(
+                                e)
+                        except Exception:  # pylint: disable=broad-except
+                            break
+                    self._cache = self._init_slot_cache()
+
+    def _tick(self) -> None:
+        # Admit new requests into free slots (between ticks — this is
+        # the "continuous" in continuous batching).
+        for slot in range(self.num_slots):
+            if self._slots[slot] is None and not self._queue.empty():
+                try:
+                    req = self._queue.get_nowait()
+                except Exception:  # pylint: disable=broad-except
+                    break
+                self._admit(slot, req)
+        active = [i for i, r in enumerate(self._slots) if r is not None]
+        if not active:
+            self._wake.wait(timeout=0.05)
+            self._wake.clear()
+            return
+        # One all-slots decode tick.
+        tokens = [(self._slots[i].tokens[-1]
+                   if self._slots[i] is not None else 0)
+                  for i in range(self.num_slots)]
+        positions = [(self._slots[i].next_pos
+                      if self._slots[i] is not None else 0)
+                     for i in range(self.num_slots)]
+        temps = [(self._slots[i].temperature
+                  if self._slots[i] is not None else 0.0)
+                 for i in range(self.num_slots)]
+        self._rng, rng = jax.random.split(self._rng)
+        out_tokens, self._cache = self._decode(
+            self.params, self._cache,
+            jnp.asarray(tokens, jnp.int32)[:, None],
+            jnp.asarray(positions, jnp.int32)[:, None],
+            jnp.asarray(temps, jnp.float32), rng)
+        import numpy as np
+        out_tokens = np.asarray(out_tokens)  # the tick's ONE host sync
+        self._decode_steps += 1
+        self.step_log.append((self._decode_steps, frozenset(active)))
+        for slot in active:
+            req = self._slots[slot]
+            req.next_pos += 1
+            token = int(out_tokens[slot])
+            req.tokens.append(token)
+            done = (len(req.tokens) >= req.max_new_tokens or
+                    (req.eos_id is not None and token == req.eos_id) or
+                    req.next_pos + 1 >= self.cfg.max_seq_len)
+            if done:
+                self._finish(slot)
+
+    # ---------------- public api ----------------
+
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               temperature: float = 0.0,
+               eos_id: Optional[int] = None):
+        """Enqueue one request; returns a concurrent.futures.Future that
+        resolves to (token_ids, stats)."""
+        import concurrent.futures
+        ids = [int(t) for t in prompt_ids]
+        if not ids:
+            raise ValueError('empty prompt')
+        if len(ids) + max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f'{len(ids)}+{max_new_tokens} exceeds max_seq_len '
+                f'{self.cfg.max_seq_len}')
+        future: 'concurrent.futures.Future' = concurrent.futures.Future()
+        req = _Request(ids, max_new_tokens, temperature, eos_id, future)
+        self._queue.put(req)
+        self._ensure_thread()
+        self._wake.set()
+        return future
+
+    def generate(self, prompt_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0,
+                 eos_id: Optional[int] = None,
+                 timeout: Optional[float] = 300.0):
+        """Blocking convenience wrapper around submit()."""
+        return self.submit(prompt_ids, max_new_tokens, temperature,
+                           eos_id).result(timeout=timeout)
+
+    def measure_ttft(self, num_requests: int, prompt,
+                     max_new_tokens: int = 16) -> list:
+        """Submit `num_requests` concurrently; returns their TTFTs (s)."""
+        futures = [self.submit(prompt, max_new_tokens=max_new_tokens)
+                   for _ in range(num_requests)]
+        return [f.result(timeout=600.0)[1]['ttft_s'] for f in futures]
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
 
 
 def load_params_from_checkpoint(cfg: ModelConfig,

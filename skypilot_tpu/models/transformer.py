@@ -93,7 +93,7 @@ class Attention(nn.Module):
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
         if cfg.decode:
-            out = self._decode_attention(q, k, v)
+            out = self._decode_attention(q, k, v, positions)
         else:
             out = flash_attention(q, k, v, causal=True,
                                   impl=cfg.attention_impl)
@@ -107,17 +107,28 @@ class Attention(nn.Module):
         return sharding.constrain(out, 'batch', 'seq', 'act_embed')
 
     def _decode_attention(self, q: jax.Array, k: jax.Array,
-                          v: jax.Array) -> jax.Array:
+                          v: jax.Array,
+                          positions: jax.Array) -> jax.Array:
         """KV-cached attention for prefill + autoregressive decode.
 
         The cache (`'cache'` variable collection) holds K/V over a static
-        max_seq_len window (kv heads sharded on tp, batch on dp/fsdp) plus
-        a fill index. One call appends the current chunk — the whole
-        prompt at prefill, one token per decode step — and attends q to
-        everything cached so far. Static shapes keep a single compiled
-        step; masking hides unfilled slots. (The reference delegates this
+        max_seq_len window (kv heads sharded on tp, batch on dp/fsdp).
+        One call appends the current chunk — the whole prompt at prefill,
+        one token per decode step — at the caller-provided `positions`
+        and attends q to everything at-or-before each query's position.
+        Positions are PER ROW: each batch row (slot) may sit at a
+        different depth, which is what makes continuous batching possible
+        (a slot mid-decode coexists with freshly prefilled ones). Static
+        shapes keep a single compiled step; the causal mask hides
+        unfilled/stale cache slots. (The reference delegates this
         machinery to vLLM's paged attention — SURVEY §2.9; here it is the
         in-tree engine behind serve replicas.)
+
+        INVARIANT (caller-enforced — see InferenceEngine.generate's
+        length assert): per-row positions stay < max_seq_len and each
+        chunk is written contiguously from positions[:, 0]. Positions are
+        traced, so this cannot be checked here; past the window,
+        dynamic_update_slice clamps and silently overwrites old entries.
         """
         cfg = self.cfg
         batch, cur_len, _, _ = q.shape
@@ -125,10 +136,6 @@ class Attention(nn.Module):
             raise ValueError(
                 f'prompt chunk {cur_len} exceeds max_seq_len '
                 f'{cfg.max_seq_len}')
-        # INVARIANT (caller-enforced — see InferenceEngine.generate's
-        # length assert): cache_index + cur_len <= max_seq_len. The fill
-        # index is traced, so it cannot be checked here; past the window,
-        # dynamic_update_slice clamps and silently overwrites old slots.
         kv_heads = k.shape[2]
         cache_shape = (batch, cfg.max_seq_len, kv_heads, cfg.head_dim)
         cached_key = self.variable(
@@ -141,25 +148,26 @@ class Attention(nn.Module):
             lambda: nn.with_logical_partitioning(
                 jnp.zeros, ('batch', None, 'kv_heads', None))(
                     cache_shape, v.dtype))
-        cache_index = self.variable(
-            'cache', 'cache_index', lambda: jnp.zeros((), jnp.int32))
 
-        index = cache_index.value
         key_box = cached_key.value
         value_box = cached_value.value
         key_arr = key_box.unbox() if hasattr(key_box, 'unbox') else key_box
         value_arr = (value_box.unbox()
                      if hasattr(value_box, 'unbox') else value_box)
-        key_arr = jax.lax.dynamic_update_slice(key_arr, k, (0, index, 0, 0))
-        value_arr = jax.lax.dynamic_update_slice(value_arr, v,
-                                                 (0, index, 0, 0))
+        # Per-row contiguous write at positions[:, 0] (vmapped DUS lowers
+        # to a scatter; rows at different depths write independently).
+        write = jax.vmap(
+            lambda cache, new, start: jax.lax.dynamic_update_slice(
+                cache, new, (start, 0, 0)))
+        start_pos = positions[:, 0].astype(jnp.int32)
+        key_arr = write(key_arr, k, start_pos)
+        value_arr = write(value_arr, v, start_pos)
         if hasattr(key_box, 'replace_boxed'):
             cached_key.value = key_box.replace_boxed(key_arr)
             cached_value.value = value_box.replace_boxed(value_arr)
         else:
             cached_key.value = key_arr
             cached_value.value = value_arr
-        cache_index.value = index + cur_len
 
         # Grouped-query attention directly against the unrepeated KV
         # cache: repeating kv→num_heads over the whole window would 4x
@@ -171,10 +179,10 @@ class Attention(nn.Module):
         scores = jnp.einsum('bqkrd,bskd->bkrqs', q_grouped, key_arr,
                             preferred_element_type=jnp.float32)
         scores = scores * (cfg.head_dim**-0.5)
-        q_pos = index + jnp.arange(cur_len)[:, None]          # (q, 1)
-        k_pos = jnp.arange(cfg.max_seq_len)[None, :]          # (1, s)
+        q_pos = positions[:, :, None]                          # (b, q, 1)
+        k_pos = jnp.arange(cfg.max_seq_len)[None, None, :]     # (1, 1, s)
         mask = k_pos <= q_pos                                  # causal+fill
-        scores = jnp.where(mask[None, None, None, :, :], scores, -1e30)
+        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(value_arr.dtype)
         out = jnp.einsum('bkrqs,bskd->bqkrd', probs, value_arr)
         return out.reshape(batch, cur_len, cfg.num_heads, cfg.head_dim)

@@ -13,9 +13,12 @@ Design (per the pallas TPU playbook):
   derived from the q-block index, so the kernel does ~half the FLOPs of
   dense attention.
 - fp32 accumulation, bf16 inputs (MXU-native).
-- Backward is a recompute VJP through the reference implementation: the
-  memory win (no S×S tensor saved for bwd) is kept, while XLA fuses the
-  recomputed backward well. A dedicated bwd kernel is a later optimization.
+- Backward is the standard flash-attention backward pair of pallas
+  kernels (dq kernel gridded over q-blocks; dk/dv kernel gridded over
+  k-blocks), recomputing p from the saved logsumexp instead of an S×S
+  residual. Causal block-skipping applies on both sides, so the O(S²)
+  recompute-through-XLA cost of the old VJP is gone — this is what keeps
+  MFU from collapsing at seq ≥ 2048.
 
 GQA is handled by folding: kv heads are repeated to match q heads before
 the kernel (cheap relative to attention FLOPs at the sizes we run).
@@ -29,8 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
 
 
 def _reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -47,8 +50,9 @@ def _reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum('bhqk,bkhd->bqhd', probs, v)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool,
-                block_q: int, block_k: int, seq_len: int, head_dim: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
+                causal: bool, block_q: int, block_k: int, seq_len: int,
+                head_dim: int):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
 
@@ -89,15 +93,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool,
     init = (jnp.zeros((block_q, head_dim), jnp.float32),
             jnp.full((block_q,), -jnp.inf, jnp.float32),
             jnp.zeros((block_q,), jnp.float32))
-    acc, _, l = jax.lax.fori_loop(0, hi, body, init)
-    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    acc, m, l = jax.lax.fori_loop(0, hi, body, init)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = acc / l_safe[:, None]
     o_ref[0] = out.astype(o_ref.dtype)
+    # Per-row logsumexp of the SCALED logits — the backward kernels
+    # rebuild p = exp(s - lse) from this instead of an S×S residual.
+    # Layout note: lse rides as (BH, 1, S) full-row blocks written via a
+    # dynamic slice — a (1, block_q) block on a (BH, S) array violates the
+    # TPU lowering's (8, 128)-divisibility rule for the last two dims.
+    lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = m + jnp.log(l_safe)
 
 
 def _pallas_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
                     sm_scale: float, block_q: int, block_k: int,
-                    interpret: bool) -> jax.Array:
-    """q,k,v: (BH, S, D) — pre-folded batch*heads, kv already repeated."""
+                    interpret: bool):
+    """q,k,v: (BH, S, D) — pre-folded batch*heads, kv already repeated.
+    Returns (out, lse)."""
     bh, seq_len, head_dim = q.shape
     grid = (bh, seq_len // block_q)
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
@@ -111,11 +123,159 @@ def _pallas_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
             pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, seq_len), lambda b, i: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_len, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, seq_len), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, sm_scale: float, causal: bool, block_q: int,
+                   block_k: int, seq_len: int, head_dim: int):
+    """dQ for one q-block: stream k-blocks (skipping fully-masked ones),
+    rebuild p from lse, accumulate ds @ K."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                      # (bq, d)
+    do = do_ref[0].astype(jnp.float32)                    # (bq, d)
+    lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]     # (bq,)
+    delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+
+    num_kb = seq_len // block_k
+    if causal:
+        hi = ((qi + 1) * block_q + block_k - 1) // block_k
+        hi = jnp.minimum(hi, num_kb)
+    else:
+        hi = num_kb
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(
+            jnp.float32)                                  # (bk, d)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, -1e30)
+        p = jnp.exp(s - lse[:, None])                     # (bq, bk)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])                    # dlogits
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, hi, body, jnp.zeros((block_q, head_dim), jnp.float32))
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale: float, causal: bool,
+                    block_q: int, block_k: int, seq_len: int,
+                    head_dim: int):
+    """dK/dV for one k-block: stream q-blocks at-or-after it (causal),
+    rebuild p, accumulate pᵀ @ dO and dsᵀ @ Q."""
+    kb = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)                  # (bk, d)
+    v_blk = v_ref[0].astype(jnp.float32)
+
+    num_qb = seq_len // block_q
+    # First q-block whose LAST row can see this k-block's first key.
+    lo = (kb * block_k) // block_q if causal else 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(
+            jnp.float32)                                  # (bq, d)
+        do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(
+            jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]  # (bq,)
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        s = jax.lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, -1e30)
+        p = jnp.exp(s - lse[:, None])                     # (bq, bk)
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, d)
+        dp = jax.lax.dot_general(do_blk, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, d)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        lo, num_qb, body,
+        (jnp.zeros((block_k, head_dim), jnp.float32),
+         jnp.zeros((block_k, head_dim), jnp.float32)))
+    dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pallas_backward(q, k, v, do, lse, delta, causal, sm_scale, block_q,
+                     block_k, interpret):
+    """All inputs pre-folded (BH, S, D) / (BH, S). Returns dq, dk, dv."""
+    bh, seq_len, head_dim = q.shape
+    full = lambda: pl.BlockSpec((1, seq_len, head_dim),
+                                lambda b, i: (b, 0, 0))
+    full_row = lambda: pl.BlockSpec((1, 1, seq_len), lambda b, i: (b, 0, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          seq_len=seq_len, head_dim=head_dim),
+        grid=(bh, seq_len // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            full(), full(),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            full_row(), full_row(),
+        ],
         out_specs=pl.BlockSpec((1, block_q, head_dim),
                                lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_len, head_dim), q.dtype),
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          seq_len=seq_len, head_dim=head_dim),
+        grid=(bh, seq_len // block_k),
+        in_specs=[
+            full(),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0)),
+            full(), full_row(), full_row(),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_len, head_dim), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_len, head_dim), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
@@ -124,37 +284,65 @@ def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     return jnp.repeat(x, n_rep, axis=2)
 
 
+def _fold(x: jax.Array) -> jax.Array:
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold(x: jax.Array, b: int, h: int) -> jax.Array:
+    bh, s, d = x.shape
+    del bh
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     b, s, h, d = q.shape
+    del s, d
     n_rep = h // k.shape[2]
-    kr = _repeat_kv(k, n_rep)
-    vr = _repeat_kv(v, n_rep)
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kf = kr.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    vf = vr.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    out = _pallas_forward(qf, kf, vf, causal, sm_scale, block_q, block_k,
-                          interpret)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    out, _ = _pallas_forward(_fold(q), _fold(_repeat_kv(k, n_rep)),
+                             _fold(_repeat_kv(v, n_rep)), causal, sm_scale,
+                             block_q, block_k, interpret)
+    return _unfold(out, b, h)
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    b, s, h, d = q.shape
+    del s, d
+    n_rep = h // k.shape[2]
+    out_f, lse = _pallas_forward(_fold(q), _fold(_repeat_kv(k, n_rep)),
+                                 _fold(_repeat_kv(v, n_rep)), causal,
+                                 sm_scale, block_q, block_k, interpret)
+    return _unfold(out_f, b, h), (q, k, v, out_f, lse)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
-    del block_q, block_k, interpret
-    q, k, v = residuals
-
-    def ref(q_, k_, v_):
-        n_rep = q_.shape[2] // k_.shape[2]
-        return _reference_attention(q_, _repeat_kv(k_, n_rep),
-                                    _repeat_kv(v_, n_rep), causal, sm_scale)
-
-    # Recompute-based backward: no S×S residual was saved by the kernel.
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    q, k, v, out_f, lse = residuals
+    b, s, h, d = q.shape
+    del s, d
+    num_kv = k.shape[2]
+    n_rep = h // num_kv
+    qf = _fold(q)
+    kf = _fold(_repeat_kv(k, n_rep))
+    vf = _fold(_repeat_kv(v, n_rep))
+    gf = _fold(g)
+    # delta_i = rowsum(dO_i * O_i) — the softmax-normalization term of
+    # dlogits (XLA fuses this elementwise+reduce pair on its own).
+    # (BH, 1, S): the lse/delta row layout the kernels expect.
+    delta = jnp.sum(gf.astype(jnp.float32) * out_f.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+    dqf, dkf, dvf = _pallas_backward(qf, kf, vf, gf, lse, delta, causal,
+                                     sm_scale, block_q, block_k, interpret)
+    dq = _unfold(dqf, b, h).astype(q.dtype)
+    dk_full = _unfold(dkf, b, h)                     # (b, s, h, d)
+    dv_full = _unfold(dvf, b, h)
+    if n_rep > 1:
+        # GQA: repeated kv heads j*n_rep..j*n_rep+n_rep-1 all came from
+        # kv head j — sum their gradients back.
+        bsz, seq, _, hd = dk_full.shape
+        dk_full = dk_full.reshape(bsz, seq, num_kv, n_rep, hd).sum(axis=3)
+        dv_full = dv_full.reshape(bsz, seq, num_kv, n_rep, hd).sum(axis=3)
+    return (dq, dk_full.astype(k.dtype), dv_full.astype(v.dtype))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -184,6 +372,10 @@ def flash_attention(q: jax.Array,
     if h % k.shape[2]:
         raise ValueError(f'num_heads {h} not divisible by kv heads '
                          f'{k.shape[2]}')
+    # Blocks never exceed the sequence (the 256-default would otherwise
+    # reject short sequences that tile fine at their own length).
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
     if impl == 'auto':
         on_tpu = any(dev.platform == 'tpu' for dev in jax.devices())
         tiles = (s % block_q == 0 and s % block_k == 0 and

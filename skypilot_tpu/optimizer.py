@@ -60,6 +60,16 @@ class Optimizer:
             task.set_best_resources(res)
             task._estimated_cost = cost  # pylint: disable=protected-access
             task._estimated_runtime = runtime  # pylint: disable=protected-access
+            # Full failover order for the provisioner: best pick first,
+            # then every other candidate by ascending objective
+            # (reference: RetryingVmProvisioner.provision_with_retries
+            # walks the optimizer's candidate list on
+            # ResourcesUnavailableError, cloud_vm_ray_backend.py:1911).
+            ordered = sorted(
+                candidates[task],
+                key=lambda r: _node_cost(task, r, minimize)[0])
+            task._ordered_candidates = [res] + [  # pylint: disable=protected-access
+                r for r in ordered if r is not res]
         if not quiet:
             print(format_plan_table(dag, plan, minimize))
         return dag

@@ -1,0 +1,139 @@
+"""Minimal REST client for compute.googleapis.com (v1) — firewall rules.
+
+Reference parity: sky/provision/gcp/config.py:392-500 creates/validates
+VPC firewall rules so `ports:` in task YAML actually opens traffic. Same
+injectable-transport pattern as tpu_api.py: production uses google-auth'd
+urllib; tests inject a fake — no SDK, no discovery cache.
+
+TPU-native specifics: TPU VM nodes carry network `tags`, so each cluster
+gets one tag (`skytpu-<cluster>`) at create time and one tag-scoped allow
+rule per cluster — deleting the rule closes every port of that cluster
+and nothing else.
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu.provision import errors
+
+API_ROOT = 'https://compute.googleapis.com/compute/v1'
+
+Transport = Callable[[str, str, Optional[Dict[str, Any]]],
+                     'tuple[int, Dict[str, Any]]']
+
+_transport_override: Optional[Transport] = None
+
+
+def set_transport_override(transport: Optional[Transport]) -> None:
+    """Test hook: route all compute API calls through a fake."""
+    global _transport_override
+    _transport_override = transport
+
+
+def cluster_network_tag(cluster_name: str) -> str:
+    """The network tag applied to every node of a cluster and targeted by
+    its firewall rule. GCP tags: lowercase RFC1035, max 63 chars."""
+    tag = 'skytpu-' + re.sub(r'[^a-z0-9-]', '-', cluster_name.lower())
+    return tag[:63].rstrip('-')
+
+
+def firewall_rule_name(cluster_name: str) -> str:
+    return cluster_network_tag(cluster_name) + '-ports'
+
+
+class ComputeClient:
+    """Thin typed wrapper over the firewalls + globalOperations endpoints."""
+
+    def __init__(self, project: str,
+                 transport: Optional[Transport] = None) -> None:
+        self.project = project
+        from skypilot_tpu.provision.gcp import tpu_api
+        self._transport = (transport or _transport_override or
+                           tpu_api._default_transport)  # pylint: disable=protected-access
+
+    def _call(self, method: str, path: str,
+              body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        url = f'{API_ROOT}/projects/{self.project}/{path}'
+        status, payload = self._transport(method, url, body)
+        if status >= 400:
+            message = payload.get('error', {}).get('message', str(payload))
+            exc = errors.classify(Exception(message), http_status=status)
+            exc.http_status = status  # type: ignore[attr-defined]
+            raise exc
+        return payload
+
+    def _wait_global_op(self, op: Dict[str, Any],
+                        timeout: float = 120.0) -> None:
+        name = op.get('name')
+        if name is None or op.get('status') == 'DONE':
+            self._raise_op_error(op)
+            return
+        deadline = time.time() + timeout
+        while op.get('status') != 'DONE':
+            if time.time() > deadline:
+                raise errors.TransientApiError(
+                    f'Compute operation {name} timed out after {timeout}s.')
+            time.sleep(1.0)
+            op = self._call('GET', f'global/operations/{name}')
+        self._raise_op_error(op)
+
+    @staticmethod
+    def _raise_op_error(op: Dict[str, Any]) -> None:
+        if op.get('error'):
+            first = (op['error'].get('errors') or [{}])[0]
+            raise errors.classify(
+                Exception(first.get('message', str(op['error']))))
+
+    # ---------------- firewalls ----------------
+
+    def get_firewall(self, name: str) -> Optional[Dict[str, Any]]:
+        try:
+            return self._call('GET', f'global/firewalls/{name}')
+        except errors.ProvisionerError as e:
+            if getattr(e, 'http_status', None) == 404:
+                return None
+            raise
+
+    def insert_firewall(self, body: Dict[str, Any]) -> None:
+        op = self._call('POST', 'global/firewalls', body)
+        self._wait_global_op(op)
+
+    def patch_firewall(self, name: str, body: Dict[str, Any]) -> None:
+        op = self._call('PATCH', f'global/firewalls/{name}', body)
+        self._wait_global_op(op)
+
+    def delete_firewall(self, name: str) -> None:
+        try:
+            op = self._call('DELETE', f'global/firewalls/{name}')
+        except errors.ProvisionerError as e:
+            if getattr(e, 'http_status', None) == 404:
+                return
+            raise
+        self._wait_global_op(op)
+
+
+def normalize_ports(ports: List) -> List[str]:
+    """['8080', '9000-9010', 8124] → sorted unique compute-API port specs."""
+    out = set()
+    for p in ports:
+        p = str(p).strip()
+        if not re.fullmatch(r'\d+(-\d+)?', p):
+            raise ValueError(f'Invalid port spec {p!r}')
+        out.add(p)
+    return sorted(out)
+
+
+def firewall_body(cluster_name: str, ports: List[str],
+                  network: str = 'global/networks/default'
+                  ) -> Dict[str, Any]:
+    return {
+        'name': firewall_rule_name(cluster_name),
+        'description': f'skytpu: task ports for cluster {cluster_name}',
+        'network': network,
+        'direction': 'INGRESS',
+        'allowed': [{'IPProtocol': 'tcp', 'ports': normalize_ports(ports)}],
+        'sourceRanges': ['0.0.0.0/0'],
+        'targetTags': [cluster_network_tag(cluster_name)],
+    }

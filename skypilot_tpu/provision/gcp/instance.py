@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.provision import common
 from skypilot_tpu.provision import errors
+from skypilot_tpu.provision.gcp import compute_api
 from skypilot_tpu.provision.gcp import tpu_api
 
 PROVIDER_NAME = 'gcp'
@@ -75,6 +76,10 @@ def _node_body(config: common.ProvisionConfig, slice_index: int
             'enableExternalIps': True,
         },
         'metadata': {},
+        # Per-cluster network tag: open_ports' firewall rule targets it
+        # (reference: tag-scoped firewall rules,
+        # sky/provision/gcp/config.py:392-500).
+        'tags': [compute_api.cluster_network_tag(config.cluster_name)],
     }
     explicit_topology = config.provider_config.get('explicit_topology')
     if explicit_topology:
@@ -263,15 +268,36 @@ def get_cluster_info(
 
 def open_ports(cluster_name: str, ports: List[str],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
-    """Firewall rules via the compute API. TPU VMs sit on the default VPC;
-    a tag-scoped allow rule per cluster mirrors the reference
-    (sky/provision/gcp/config.py firewall bootstrap)."""
-    del cluster_name, ports, provider_config
-    # Implemented via compute.googleapis.com in a follow-up; serve's LB runs
-    # on the controller, which fronts replicas over internal IPs, so this is
-    # not on the serving critical path.
+    """One tag-scoped INGRESS allow rule per cluster via the compute API
+    (reference: sky/provision/gcp/config.py:392-500). Every node of the
+    cluster carries the tag (set in _node_body), so the rule covers all
+    hosts of all slices; idempotent — an existing rule with the same port
+    set is left alone, a different set is patched."""
+    if not ports:
+        return
+    project = (provider_config or {}).get('project')
+    if not project:
+        raise errors.PrecheckError(
+            'provider_config.project is required to open ports.')
+    client = compute_api.ComputeClient(project)
+    network = (provider_config or {}).get('network',
+                                          'global/networks/default')
+    body = compute_api.firewall_body(cluster_name, ports, network)
+    name = compute_api.firewall_rule_name(cluster_name)
+    existing = client.get_firewall(name)
+    if existing is None:
+        client.insert_firewall(body)
+        return
+    have = sorted((existing.get('allowed') or [{}])[0].get('ports', []))
+    if have != body['allowed'][0]['ports']:
+        client.patch_firewall(name, {'allowed': body['allowed']})
 
 
 def cleanup_ports(cluster_name: str,
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    del cluster_name, provider_config
+    """Delete the cluster's firewall rule (missing rule is a no-op)."""
+    project = (provider_config or {}).get('project')
+    if not project:
+        return  # nothing was ever opened without a project
+    client = compute_api.ComputeClient(project)
+    client.delete_firewall(compute_api.firewall_rule_name(cluster_name))

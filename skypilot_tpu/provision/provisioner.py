@@ -38,8 +38,15 @@ class ProvisionResult:
 class FailoverEngine:
     """Stateless walk over the candidate space with error-driven blocklists."""
 
-    def __init__(self, sleep_between_attempts: float = 0.0) -> None:
-        self._blocked: List['resources_lib.Resources'] = []
+    def __init__(self, sleep_between_attempts: float = 0.0,
+                 blocked_resources: Optional[
+                     List['resources_lib.Resources']] = None) -> None:
+        # Seeded blocks: zones/regions the caller already knows are bad —
+        # e.g. managed-job recovery passes the zone that just preempted
+        # the task (reference: EAGER_NEXT_REGION blocks the launched
+        # region before failover, sky/jobs/recovery_strategy.py:458-543).
+        self._blocked: List['resources_lib.Resources'] = list(
+            blocked_resources or [])
         self._sleep = sleep_between_attempts
 
     def _is_blocked(self, candidate: 'resources_lib.Resources') -> bool:
@@ -71,6 +78,24 @@ class FailoverEngine:
             for zone in zones:
                 pairs.append((region, zone))
         return pairs
+
+    @staticmethod
+    def _open_ports_with_retry(provider: str, cluster_name: str,
+                               config: provision_common.ProvisionConfig,
+                               zone: str) -> None:
+        """Transient firewall-API errors retry in place — the cluster is
+        healthy and billing; tearing it down for a flaky API call would
+        be self-inflicted churn."""
+        pc = dict(config.provider_config, zone=zone)
+        for attempt in range(_IN_PLACE_RETRIES + 1):
+            try:
+                provision.open_ports(provider, cluster_name, config.ports,
+                                     provider_config=pc)
+                return
+            except errors.ProvisionerError as e:
+                if not e.retryable_in_place or attempt == _IN_PLACE_RETRIES:
+                    raise
+                time.sleep(_IN_PLACE_BACKOFF_S * (attempt + 1))
 
     def _provision_one_zone(
         self, provider: str, region: str, zone: str, cluster_name: str,
@@ -132,6 +157,24 @@ class FailoverEngine:
                 try:
                     record, info = self._provision_one_zone(
                         provider, region, zone, cluster_name, config)
+                    if config.ports:
+                        # Task `ports:` become cloud firewall openings
+                        # (reference: provisioner open_ports stage,
+                        # sky/provision/provisioner.py:557 →
+                        # sky/provision/gcp/config.py:392-500). The slice
+                        # is already live and billing, so: retry transient
+                        # API errors in place (do NOT tear down a healthy
+                        # cluster for a flaky firewall call), and on
+                        # persistent failure clean up before raising —
+                        # anything else leaks an orphaned slice.
+                        try:
+                            self._open_ports_with_retry(
+                                provider, cluster_name, config, zone)
+                        except Exception as port_err:
+                            # Re-raise classified: the ProvisionerError
+                            # handler below owns teardown + blocklisting,
+                            # so no slice is leaked even for a ValueError.
+                            raise errors.classify(port_err) from port_err
                     return ProvisionResult(attempt_res, record, info)
                 except errors.ProvisionerError as e:
                     history.append(e)

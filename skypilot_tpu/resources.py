@@ -22,6 +22,7 @@ TPU-native differences:
 from __future__ import annotations
 
 import dataclasses
+import re
 import textwrap
 import typing
 from typing import Any, Dict, List, Optional, Union
@@ -88,7 +89,15 @@ class Resources:
         if ports is not None:
             if not isinstance(ports, list):
                 ports = [ports]
-            self._ports = [str(p) for p in ports]
+            self._ports = [str(p).strip() for p in ports]
+            # Validate at spec time: a malformed port discovered only at
+            # the post-provision firewall step would strand a freshly
+            # provisioned (billing) slice.
+            for p in self._ports:
+                if not re.fullmatch(r'\d+(-\d+)?', p):
+                    raise ValueError(
+                        f'Invalid port spec {p!r}: expected N or N-M '
+                        f"(e.g. ports: [8080, '9000-9010']).")
 
         # Resolve accelerator → TpuSlice.
         self._tpu: Optional[topology.TpuSlice] = None

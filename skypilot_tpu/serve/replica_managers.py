@@ -19,7 +19,6 @@ what makes multi-replica serving hermetically testable.
 """
 from __future__ import annotations
 
-import copy
 import logging
 import os
 import threading
@@ -174,7 +173,11 @@ class SkyPilotReplicaManager:
     def _replica_task(self, replica_id: int,
                       resources_override: Dict[str, Any]
                       ) -> 'task_lib.Task':
-        task = copy.copy(self.task)
+        # Task.copy() rebinds _envs: concurrent _launch_replica threads
+        # each customize their own env dict instead of racing on the base
+        # task's (the copy.copy() + in-place update_envs combination let
+        # replica N's SKYTPU_REPLICA_ID leak into replica M's task).
+        task = self.task.copy()
         port = _port_for_replica(self._base_port, replica_id)
         task.update_envs({
             'SKYTPU_REPLICA_ID': str(replica_id),
@@ -190,9 +193,10 @@ class SkyPilotReplicaManager:
     def _launch_replica(self, replica_id: int,
                         resources_override: Dict[str, Any]) -> None:
         from skypilot_tpu import execution
-        info = self.replicas[replica_id]
-        info.status = ReplicaStatus.PROVISIONING
-        self._persist(info)
+        with self.lock:
+            info = self.replicas[replica_id]
+            info.status = ReplicaStatus.PROVISIONING
+            self._persist(info)
         task = self._replica_task(replica_id, resources_override)
         try:
             job_id, handle = execution.launch(

@@ -16,8 +16,10 @@ the built-in byte-level tokenizer (ids 0-255 = bytes — honest and
 dependency-free; swap in a real tokenizer via --tokenizer hf:<path> when
 the model has one).
 
-Prompt lengths pad to power-of-two buckets so the jitted prefill compiles
-once per bucket, not once per length.
+Concurrency: the engine continuous-batches — each request's prompt drops
+into a free decode slot between ticks (prompt lengths bucket to powers of
+two inside the engine), so concurrent requests interleave on-chip instead
+of queueing behind one another.
 """
 from __future__ import annotations
 
@@ -28,12 +30,9 @@ import sys
 import time
 from typing import List, Optional
 
-import jax.numpy as jnp
 from aiohttp import web
 
 logger = logging.getLogger(__name__)
-
-_PAD_ID = 0
 
 
 def byte_encode(text: str) -> List[int]:
@@ -45,35 +44,31 @@ def byte_decode(ids: List[int]) -> str:
         'utf-8', errors='replace')
 
 
-def _bucket(length: int, max_len: int) -> int:
-    bucket = 16
-    while bucket < length:
-        bucket *= 2
-    return min(bucket, max_len)
-
-
 class InferenceServer:
 
     def __init__(self, model: str, max_seq_len: Optional[int] = None,
                  tokenizer: str = 'byte',
-                 checkpoint_dir: Optional[str] = None) -> None:
-        from skypilot_tpu.models.inference import (InferenceEngine,
-                                                   load_params_from_checkpoint)
+                 checkpoint_dir: Optional[str] = None,
+                 num_slots: int = 4) -> None:
+        from skypilot_tpu.models.inference import (
+            ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
         params = None
         if checkpoint_dir:
             params = load_params_from_checkpoint(get_config(model),
                                                  checkpoint_dir)
-        self.engine = InferenceEngine(model, params=params, batch_size=1,
-                                      max_seq_len=max_seq_len)
+        # Continuous batching: requests stream into free decode slots, so
+        # concurrent requests interleave instead of queueing behind each
+        # other (the old engine serialized behind an asyncio lock).
+        self.engine = ContinuousBatchingEngine(model, params=params,
+                                               num_slots=num_slots,
+                                               max_seq_len=max_seq_len)
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
         if tokenizer.startswith('hf:'):
             from transformers import AutoTokenizer
             self._hf_tokenizer = AutoTokenizer.from_pretrained(
                 tokenizer[3:])
-        # Single-sequence engine v1: serialize generations.
-        self._lock = asyncio.Lock()
         self.ready = False
 
     # -- tokenizer --
@@ -110,33 +105,34 @@ class InferenceServer:
         max_new = int(data.get('max_new_tokens', 32))
         temperature = float(data.get('temperature', 0.0))
 
-        results, stats = [], []
-        async with self._lock:
-            for ids in prompts:
-                out, st = await asyncio.get_event_loop().run_in_executor(
-                    None, self._generate_one, ids, max_new, temperature)
-                results.append(out)
-                stats.append(st)
+        # All prompts go straight into the engine queue; awaiting the
+        # futures concurrently lets this request's prompts AND other
+        # in-flight HTTP requests share decode ticks.
+        futures = [self._submit_one(ids, max_new, temperature)
+                   for ids in prompts]
+        gathered = await asyncio.gather(
+            *[asyncio.wrap_future(f) for f in futures])
+        results = [out for out, _ in gathered]
+        stats = [st for _, st in gathered]
         return web.json_response({
             'token_ids': results,
             'text': [self.decode(r) for r in results],
             'stats': stats,
         })
 
-    def _generate_one(self, ids: List[int], max_new: int,
-                      temperature: float):
+    def _submit_one(self, ids: List[int], max_new: int,
+                    temperature: float):
         max_seq = self.engine.cfg.max_seq_len
         if len(ids) + max_new > max_seq:
             ids = ids[-(max_seq - max_new):]
-        bucket = _bucket(len(ids), max_seq - max_new)
-        padded = ids + [_PAD_ID] * (bucket - len(ids))
-        # Right-padding changes the cache fill index; simplest correct
-        # form for v1: treat the padded prompt as the prompt. TODO:
-        # left-pad + position offsets for exactness at bucket edges.
-        prompt = jnp.asarray([padded[:bucket]], jnp.int32)
-        out, st = self.engine.generate(prompt, max_new_tokens=max_new,
-                                       temperature=temperature)
-        return [int(t) for t in out[0]], st
+        return self.engine.submit(ids, max_new_tokens=max_new,
+                                  temperature=temperature)
+
+    def _generate_one(self, ids: List[int], max_new: int,
+                      temperature: float):
+        out, st = self._submit_one(ids, max_new, temperature).result(
+            timeout=600.0)
+        return out, st
 
     def warmup(self) -> None:
         t0 = time.time()

@@ -124,6 +124,25 @@ class Task:
                 raise ValueError(f'workdir {self.workdir!r} is not a '
                                  'directory.')
 
+    def copy(self) -> 'Task':
+        """Shallow copy with FRESH mutable containers.
+
+        `copy.copy(task)` shares `_envs` (and the other dicts/sets) with
+        the original, so a subsequent `update_envs` on the copy mutates
+        the original — a real concurrency bug when per-replica tasks are
+        built from one base task in parallel launch threads. Callers that
+        intend to customize a copy must use this instead.
+        """
+        import copy as copy_module
+        new = copy_module.copy(self)
+        new._envs = dict(self._envs)
+        new._resources = set(self._resources)
+        new.file_mounts = (dict(self.file_mounts)
+                           if self.file_mounts is not None else None)
+        new.storage_mounts = dict(self.storage_mounts)
+        new.storage_plans = dict(self.storage_plans)
+        return new
+
     # ---------------- envs ----------------
     @property
     def envs(self) -> Dict[str, str]:
@@ -163,6 +182,12 @@ class Task:
 
     def set_best_resources(self, r: resources_lib.Resources) -> None:
         self._best_resources = r
+
+    def ordered_candidates(self) -> Optional[List[
+            resources_lib.Resources]]:
+        """The optimizer's full failover order (best first); None if the
+        optimizer has not run."""
+        return getattr(self, '_ordered_candidates', None)
 
     # ---------------- storage / files ----------------
     def set_file_mounts(self, file_mounts: Optional[Dict[str, str]]) -> 'Task':
