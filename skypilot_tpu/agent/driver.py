@@ -52,6 +52,13 @@ def make_runner(host: Dict[str, Any]) -> command_runner.CommandRunner:
         host_env['HOME'] = host['home']
     if host.get('runner', 'local') == 'local':
         return command_runner.LocalCommandRunner(host_env)
+    if host.get('runner') == 'kubectl':
+        return command_runner.KubernetesCommandRunner(
+            host['pod'], host.get('namespace', 'default'),
+            host_env=host_env)
+    if host.get('runner') == 'docker':
+        return command_runner.DockerCommandRunner(host['container'],
+                                                  host_env=host_env)
     return command_runner.SSHCommandRunner(host['ip'], host['ssh_user'],
                                            host['ssh_key'],
                                            host.get('ssh_port', 22),
@@ -109,9 +116,56 @@ class GangRun:
         self._rcs: List[Optional[int]] = [None] * len(spec['hosts'])
         self._lock = threading.Lock()
         self._failed = threading.Event()
+        self._done = threading.Event()
         self._mux = None
         self._combined = open(os.path.join(log_dir, 'run.log'), 'a',
                               buffering=1, encoding='utf-8')
+
+    # ---------------- host liveness ----------------
+
+    def _probe_loop(self) -> None:
+        """Bounded-time detection of hung/dead worker hosts.
+
+        A wedged non-head host otherwise surfaces only as a run command
+        that never returns (SURVEY §7 hard-part (a)): its process pipe
+        stays open and the gang waits forever. Probe every host with a
+        cheap command; `threshold` consecutive failures/timeouts fail the
+        gang, which triggers the normal first-failure cancellation. The
+        probe command is env-overridable, which is also what makes this
+        hermetically testable on fake (local) hosts.
+        """
+        import subprocess as sp
+        interval = float(os.environ.get('SKYTPU_HOST_PROBE_INTERVAL',
+                                        '60'))
+        if interval <= 0:
+            return
+        timeout = float(os.environ.get('SKYTPU_HOST_PROBE_TIMEOUT', '30'))
+        threshold = int(os.environ.get('SKYTPU_HOST_PROBE_FAILURES', '2'))
+        probe_cmd = os.environ.get('SKYTPU_HOST_PROBE_COMMAND', 'true')
+        hosts = self.spec['hosts']
+        fails = [0] * len(hosts)
+        while not self._done.wait(interval):
+            if self._failed.is_set():
+                return
+            for rank, host in enumerate(hosts):
+                proc = self._procs[rank]
+                if proc is None or proc.poll() is not None:
+                    continue  # not started / already finished
+                try:
+                    rc = make_runner(host).run(probe_cmd,
+                                               stream_logs=False,
+                                               timeout=timeout)
+                except (sp.TimeoutExpired, OSError):
+                    rc = 255
+                fails[rank] = 0 if rc == 0 else fails[rank] + 1
+                if fails[rank] >= threshold:
+                    with self._lock:
+                        self._combined.write(
+                            f'(driver) host rank {rank} failed '
+                            f'{fails[rank]} liveness probes; failing the '
+                            f'gang and cancelling stragglers.\n')
+                    self._failed.set()
+                    return
 
     def _pump(self, rank: int, proc, prefix: str) -> None:
         """Pure-Python fallback pump (one thread per rank)."""
@@ -164,11 +218,17 @@ class GangRun:
             python = (sys.executable
                       if host.get('runner', 'local') == 'local' else
                       'python3')
-            runner.run(
-                f'{python} -c "from skypilot_tpu.utils.'
-                f'subprocess_utils import kill_by_marker; '
-                f'kill_by_marker(\'{self.marker}\')" || true',
-                stream_logs=False)
+            try:
+                # Bounded: this may be running BECAUSE the host is dead
+                # (liveness probe) — an untimed kill attempt against a
+                # wedged host would re-wedge the gang.
+                runner.run(
+                    f'{python} -c "from skypilot_tpu.utils.'
+                    f'subprocess_utils import kill_by_marker; '
+                    f'kill_by_marker(\'{self.marker}\')" || true',
+                    stream_logs=False, timeout=30)
+            except Exception:  # pylint: disable=broad-except
+                pass
 
     def run(self, cmd: str, base_env: Dict[str, str]) -> List[int]:
         hosts = self.spec['hosts']
@@ -196,6 +256,10 @@ class GangRun:
         if mux is not None:
             mux.start()
             self._mux = mux
+        self._done.clear()
+        if many:
+            threading.Thread(target=self._probe_loop, daemon=True,
+                             name='host-liveness').start()
         # Wait; on first failure cancel the rest (poll so we can react
         # before slow ranks finish).
         cancelled = False
@@ -239,6 +303,7 @@ class GangRun:
                         proc.stdout.close()
                     except OSError:
                         pass
+        self._done.set()
         self._combined.flush()
         return [rc if rc is not None else 137 for rc in self._rcs]
 

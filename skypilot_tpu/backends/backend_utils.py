@@ -155,6 +155,67 @@ def get_clusters(refresh: bool = False,
     return fresh
 
 
+def _ssh_config_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_SSH_CONFIG_DIR', '~/.skytpu/ssh'))
+
+
+def update_cluster_ssh_config(cluster_name: str, handle) -> None:
+    """Write `ssh <cluster>` / `ssh <cluster>-worker<N>` aliases
+    (reference: SSHConfigHelper, sky/backends/backend_utils.py:398).
+
+    One config file per cluster under ~/.skytpu/ssh/; a single
+    `Include ~/.skytpu/ssh/*` line is added to ~/.ssh/config the first
+    time (idempotent; set SKYTPU_SSH_CONFIG_INCLUDE=0 to manage the
+    include yourself)."""
+    recs = [r for r in handle.host_records() if r.get('runner') == 'ssh']
+    if not recs:
+        return  # fake/kubernetes hosts have no ssh identity
+    cfg_dir = _ssh_config_dir()
+    os.makedirs(cfg_dir, exist_ok=True)
+    lines = ['# Auto-generated by skytpu; do not edit.']
+    for i, rec in enumerate(recs):
+        alias = cluster_name if i == 0 else f'{cluster_name}-worker{i}'
+        lines += [
+            f'Host {alias}',
+            f'  HostName {rec["ip"]}',
+            f'  User {rec["ssh_user"]}',
+            f'  IdentityFile {rec["ssh_key"]}',
+            f'  Port {rec.get("ssh_port", 22)}',
+            '  IdentitiesOnly yes',
+            '  StrictHostKeyChecking no',
+            '  UserKnownHostsFile /dev/null',
+        ]
+    with open(os.path.join(cfg_dir, cluster_name), 'w',
+              encoding='utf-8') as f:
+        f.write('\n'.join(lines) + '\n')
+    if os.environ.get('SKYTPU_SSH_CONFIG_INCLUDE') == '0':
+        return
+    ssh_config = os.path.expanduser('~/.ssh/config')
+    include_line = f'Include {cfg_dir}/*'
+    existing = ''
+    if os.path.exists(ssh_config):
+        with open(ssh_config, encoding='utf-8') as f:
+            existing = f.read()
+    if include_line not in existing:
+        os.makedirs(os.path.dirname(ssh_config), exist_ok=True)
+        # Atomic replace: a crash mid-write must never truncate the
+        # user's hand-written ssh config.
+        tmp = f'{ssh_config}.skytpu-{os.getpid()}.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            # Include must come first: ssh applies first-match-wins.
+            f.write(f'{include_line}\n{existing}')
+        os.replace(tmp, ssh_config)
+
+
+def remove_cluster_ssh_config(cluster_name: str) -> None:
+    path = os.path.join(_ssh_config_dir(), cluster_name)
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+
+
 def check_cluster_available(
     cluster_name: str,
     operation: str,

@@ -113,7 +113,10 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
         return len(self.cluster_info.all_hosts())
 
     def provider_config(self) -> Dict[str, Any]:
-        return {'zone': self.cluster_info.zone,
+        # provider_extras (GCP project, k8s namespace, ...) was added
+        # after v1 handles; getattr keeps old pickles loadable.
+        return {**getattr(self, 'provider_extras', {}),
+                'zone': self.cluster_info.zone,
                 'region': self.cluster_info.region}
 
     def update_cluster_info(self,
@@ -146,6 +149,14 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
                 rec['runner'] = 'local'
                 rec['home'] = self._fake_host_home(ref.slice_index,
                                                    ref.host_id)
+            elif self.cluster_info.provider_name == 'kubernetes':
+                rec['runner'] = 'kubectl'
+                rec['pod'] = ref.host.metadata.get('pod')
+                rec['namespace'] = ref.host.metadata.get('namespace',
+                                                         'default')
+            elif self.cluster_info.provider_name == 'docker':
+                rec['runner'] = 'docker'
+                rec['container'] = ref.host.metadata.get('container')
             else:
                 rec['runner'] = 'ssh'
                 rec['ssh_user'] = self.ssh_user
@@ -168,6 +179,11 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
                 env['PYTHONPATH'] = (_repo_root() + os.pathsep +
                                      pypath if pypath else _repo_root())
             return command_runner.LocalCommandRunner(env)
+        if rec.get('runner') == 'kubectl':
+            return command_runner.KubernetesCommandRunner(
+                rec['pod'], rec.get('namespace', 'default'))
+        if rec.get('runner') == 'docker':
+            return command_runner.DockerCommandRunner(rec['container'])
         return command_runner.SSHCommandRunner(
             rec['ip'], rec['ssh_user'], rec['ssh_key'],
             rec.get('ssh_port', 22))
@@ -315,7 +331,9 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
 
         handle = CloudTpuResourceHandle(cluster_name, result.resources,
                                         result.cluster_info)
+        handle.provider_extras = result.provider_config
         self._post_provision_setup(handle)
+        backend_utils.update_cluster_ssh_config(cluster_name, handle)
         global_user_state.add_or_update_cluster(cluster_name, handle,
                                                set(task.resources),
                                                ready=True)
@@ -625,3 +643,5 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
                                'for %s.', handle.cluster_name)
             global_user_state.remove_cluster(handle.cluster_name,
                                              terminate=terminate)
+            if terminate:
+                backend_utils.remove_cluster_ssh_config(handle.cluster_name)

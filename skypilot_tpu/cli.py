@@ -451,6 +451,34 @@ def jobs_logs(job_id, name, controller, no_follow):
 # ---------------- serve ----------------
 
 
+@jobs.command('dashboard')
+@click.option('--port', type=int, default=46590)
+@click.option('--host', default='127.0.0.1')
+def jobs_dashboard(port, host):
+    """Serve a live web dashboard of jobs, services, and clusters
+    (reference: sky/jobs/dashboard/dashboard.py)."""
+    from skypilot_tpu import dashboard
+    sys.exit(dashboard.main(['--host', host, '--port', str(port)]))
+
+
+@cli.command()
+@click.argument('shell', type=click.Choice(['bash', 'zsh', 'fish']))
+def completion(shell):
+    """Emit the shell-completion script (reference: sky/cli.py:345).
+
+    Install with:  eval "$(skytpu completion bash)"  in ~/.bashrc.
+    """
+    # Drive click's native completion machinery directly (spawning a
+    # subprocess doesn't work: click derives the env-var name from the
+    # invoked prog name, which is not 'skytpu' under `python -m`).
+    from click.shell_completion import get_completion_class
+    comp_cls = get_completion_class(shell)
+    if comp_cls is None:
+        _fail(f'No completion support for {shell!r}.')
+    comp = comp_cls(cli, {}, 'skytpu', '_SKYTPU_COMPLETE')
+    click.echo(comp.source())
+
+
 @cli.group()
 def serve():
     """Serve: autoscaled replica fleets behind a load balancer."""
@@ -491,6 +519,26 @@ def serve_status(service_name):
                 for i in r['replica_info']]
         _print_table(rows,
                      ['REPLICA', 'STATUS', 'URL', 'CAPACITY', 'VERSION'])
+
+
+@serve.command('update')
+@click.argument('service_name')
+@click.argument('entrypoint', nargs=-1)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_update(service_name, entrypoint, yes):
+    """Roll a service to a new task/spec version (blue-green-ish: new
+    replicas use the new spec; reference: sky serve update,
+    sky/cli.py:4076)."""
+    task = _make_task(entrypoint, None, None, None, None, None, None, None,
+                      None, (), ())
+    if task.service is None:
+        _fail('Task YAML needs a `service:` section for serve update.')
+    _confirm(f'Update service {service_name!r} to a new version?', yes)
+    try:
+        version = sky.serve.update(task, service_name)
+    except (ValueError, exceptions.ServeUserTerminatedError) as e:
+        _fail(str(e))
+    click.echo(f'Service {service_name!r} updated to version {version}.')
 
 
 @serve.command('down')

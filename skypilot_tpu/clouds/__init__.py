@@ -3,6 +3,7 @@ from typing import Dict, List
 
 from skypilot_tpu.clouds.cloud import (Cloud, CloudImplementationFeatures,
                                        Region, Zone)
+from skypilot_tpu.clouds.docker import Docker
 from skypilot_tpu.clouds.fake import Fake
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
@@ -31,10 +32,11 @@ registry = _Registry()
 registry.register(GCP)
 registry.register(Kubernetes)
 registry.register(Fake)
+registry.register(Docker)
 
 CLOUD_REGISTRY = registry
 
 __all__ = [
-    'CLOUD_REGISTRY', 'Cloud', 'CloudImplementationFeatures', 'Fake', 'GCP',
-    'Kubernetes', 'Region', 'Zone', 'registry',
+    'CLOUD_REGISTRY', 'Cloud', 'CloudImplementationFeatures', 'Docker',
+    'Fake', 'GCP', 'Kubernetes', 'Region', 'Zone', 'registry',
 ]

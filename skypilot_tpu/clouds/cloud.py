@@ -123,6 +123,17 @@ class Cloud:
         """(candidates sorted by cost, fuzzy-match hints if none)."""
         raise NotImplementedError
 
+    @classmethod
+    def provision_provider_config(
+            cls, resources: 'resources_lib.Resources') -> Dict[str, str]:
+        """Cloud-specific extras for ProvisionConfig.provider_config
+        (GCP: project + queued-resources flag; kubernetes: namespace/
+        image). Called by the failover engine right before run_instances
+        (reference analogue: provider section of the rendered cluster
+        YAML, sky/backends/backend_utils.py:751)."""
+        del resources
+        return {}
+
     # ---------------- credentials / identity ----------------
     @classmethod
     def check_credentials(cls) -> Tuple[bool, Optional[str]]:

@@ -1,0 +1,98 @@
+"""Local-docker debug cloud.
+
+Reference parity: sky/backends/local_docker_backend.py:46-56 — iterate on
+task definitions (setup/run/file_mounts/envs) in local containers without
+paying for TPU slices. Opt-in only (never competes in the optimizer
+unless named), no real accelerators: `accelerators` is kept as metadata
+so the same YAML later launches on a real cloud unchanged.
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+import typing
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class Docker(cloud_lib.Cloud):
+
+    NAME = 'docker'
+    _REGION = 'docker'
+
+    @classmethod
+    def unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        del resources
+        return {
+            cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+                'local containers have no spot market.',
+            cloud_lib.CloudImplementationFeatures.AUTOSTOP:
+                'debug containers: use down.',
+        }
+
+    @classmethod
+    def regions_with_offering(
+            cls, accelerator: str, use_spot: bool, region: Optional[str],
+            zone: Optional[str]) -> List[cloud_lib.Region]:
+        del accelerator, use_spot, zone
+        if region is not None and region != cls._REGION:
+            return []
+        r = cloud_lib.Region(cls._REGION)
+        r.set_zones([cloud_lib.Zone(cls._REGION)])
+        return [r]
+
+    @classmethod
+    def zones_provision_loop(
+            cls, *, region: str, accelerator: str,
+            use_spot: bool) -> Iterator[List[cloud_lib.Zone]]:
+        for r in cls.regions_with_offering(accelerator, use_spot, region,
+                                           None):
+            yield r.zones
+
+    @classmethod
+    def accelerator_cost(cls, accelerator: str, use_spot: bool,
+                         region: Optional[str],
+                         zone: Optional[str]) -> float:
+        del accelerator, use_spot, region, zone
+        return 0.0  # your own machine
+
+    @classmethod
+    def get_egress_cost(cls, num_gigabytes: float) -> float:
+        del num_gigabytes
+        return 0.0
+
+    @classmethod
+    def get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        if resources.cloud_name != cls.NAME:
+            return [], []  # strictly opt-in
+        return [resources.copy(cloud=cls.NAME, region=cls._REGION)], []
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        if shutil.which('docker') is None:
+            return False, 'docker binary not found on PATH.'
+        try:
+            proc = subprocess.run(['docker', 'info'], capture_output=True,
+                                  text=True, timeout=15, check=False)
+        except subprocess.TimeoutExpired:
+            return False, 'docker daemon not responding.'
+        if proc.returncode != 0:
+            return False, f'docker daemon unavailable: ' \
+                          f'{proc.stderr.strip()[:200]}'
+        return True, None
+
+    @classmethod
+    def get_current_user_identity(cls) -> Optional[List[str]]:
+        return ['docker:local']
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        return {}

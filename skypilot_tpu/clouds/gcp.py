@@ -150,6 +150,23 @@ class GCP(cloud_lib.Cloud):
             'Could not determine GCP project id.')
 
     @classmethod
+    def provision_provider_config(cls, resources) -> Dict[str, str]:
+        cfg = {'project': cls.get_project_id()}
+        tpu = resources.tpu
+        if tpu is not None:
+            args = resources.accelerator_args or {}
+            use_qr = args.get('use_queued_resources')
+            if use_qr is None:
+                # Queued resources is the default create path for the
+                # generations that support it (v5e/v5p/v6e).
+                use_qr = tpu.gen.queued_resources
+            cfg['queued_resources'] = bool(use_qr)
+            topo = args.get('topology')
+            if topo:
+                cfg['explicit_topology'] = str(topo)
+        return cfg
+
+    @classmethod
     def get_current_user_identity(cls) -> Optional[List[str]]:
         try:
             proc = subprocess.run(

@@ -93,6 +93,19 @@ class Kubernetes(cloud_lib.Cloud):
         return [resources.copy(cloud=cls.NAME, region=cls._REGION)], []
 
     @classmethod
+    def provision_provider_config(cls, resources) -> Dict[str, str]:
+        del resources
+        from skypilot_tpu import sky_config
+        cfg = {
+            'namespace': sky_config.get_nested(('kubernetes', 'namespace'),
+                                               'default'),
+        }
+        image = sky_config.get_nested(('kubernetes', 'image'), None)
+        if image:
+            cfg['image'] = image
+        return cfg
+
+    @classmethod
     def check_credentials(cls) -> Tuple[bool, Optional[str]]:
         if shutil.which('kubectl') is None:
             return False, 'kubectl not found on PATH.'

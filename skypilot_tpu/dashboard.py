@@ -1,0 +1,224 @@
+"""Live web dashboard: managed jobs, services/replicas, clusters.
+
+Reference parity: sky/jobs/dashboard/dashboard.py (a small Flask app
+tunneled over SSH, sky/cli.py:3803). Here it is aiohttp (the framework's
+HTTP stack), serves all three state tables instead of jobs only, and runs
+locally against the client state db — the controllers in this framework
+are local processes, so no SSH tunnel is needed.
+
+Entry: `skytpu jobs dashboard` (cli.py) or
+`python -m skypilot_tpu.dashboard`.
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import html
+import sys
+from typing import Any, Dict, List
+
+from aiohttp import web
+
+_PAGE = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<meta http-equiv="refresh" content="5">
+<title>skytpu dashboard</title>
+<style>
+  body {{ font-family: -apple-system, 'Segoe UI', Roboto, sans-serif;
+         margin: 2rem; color: #1a1a1a; }}
+  h1 {{ font-size: 1.4rem; }}
+  h2 {{ font-size: 1.1rem; margin-top: 2rem; }}
+  table {{ border-collapse: collapse; width: 100%; font-size: 0.9rem; }}
+  th, td {{ text-align: left; padding: 6px 10px;
+            border-bottom: 1px solid #ddd; }}
+  th {{ background: #f5f5f5; }}
+  .ok {{ color: #0a7d32; font-weight: 600; }}
+  .bad {{ color: #b3261e; font-weight: 600; }}
+  .dim {{ color: #777; }}
+  footer {{ margin-top: 2rem; color: #777; font-size: 0.8rem; }}
+</style>
+</head>
+<body>
+<h1>skytpu dashboard</h1>
+<h2>Managed jobs</h2>
+{jobs}
+<h2>Services</h2>
+{services}
+<h2>Clusters</h2>
+{clusters}
+<footer>refreshed {now} &middot; auto-refresh 5s</footer>
+</body>
+</html>
+"""
+
+_GOOD = {'RUNNING', 'SUCCEEDED', 'READY', 'UP'}
+_BAD_PREFIX = ('FAILED', 'CANCELLED', 'NOT_READY', 'PREEMPTED')
+
+
+def _status_cell(value: str) -> str:
+    value = html.escape(str(value))
+    if value in _GOOD:
+        return f'<td class="ok">{value}</td>'
+    if value.startswith(_BAD_PREFIX):
+        return f'<td class="bad">{value}</td>'
+    return f'<td>{value}</td>'
+
+
+def _table(headers: List[str], rows: List[List[Any]],
+           status_col: int = -1) -> str:
+    if not rows:
+        return '<p class="dim">none</p>'
+    out = ['<table><tr>']
+    out += [f'<th>{html.escape(h)}</th>' for h in headers]
+    out.append('</tr>')
+    for row in rows:
+        out.append('<tr>')
+        for i, cell in enumerate(row):
+            if i == status_col % len(headers):
+                out.append(_status_cell(cell))
+            else:
+                out.append(f'<td>{html.escape(str(cell))}</td>')
+        out.append('</tr>')
+    out.append('</table>')
+    return ''.join(out)
+
+
+def _cluster_resources(record) -> str:
+    handle = record.get('handle')
+    if handle is not None and \
+            getattr(handle, 'launched_resources', None) is not None:
+        return str(handle.launched_resources)
+    return '-'
+
+
+def _fmt_ts(ts) -> str:
+    if not ts:
+        return '-'
+    return datetime.datetime.fromtimestamp(float(ts)).strftime(
+        '%m-%d %H:%M:%S')
+
+
+class Dashboard:
+
+    # -- data (JSON API, also feeds the HTML page) --
+
+    def _jobs(self) -> List[Dict[str, Any]]:
+        from skypilot_tpu.jobs import core as jobs_core
+        try:
+            return jobs_core.queue(refresh=False)
+        except Exception:  # pylint: disable=broad-except
+            return []
+
+    def _services(self) -> List[Dict[str, Any]]:
+        from skypilot_tpu.serve import core as serve_core
+        try:
+            return serve_core.status()
+        except Exception:  # pylint: disable=broad-except
+            return []
+
+    def _clusters(self) -> List[Dict[str, Any]]:
+        from skypilot_tpu import core
+        try:
+            return core.status(refresh=False)
+        except Exception:  # pylint: disable=broad-except
+            return []
+
+    # -- handlers --
+
+    async def index(self, request: web.Request) -> web.Response:
+        del request
+        jobs_rows = [[
+            r.get('job_id'), r.get('job_name'),
+            (r['status'].value if hasattr(r.get('status'), 'value') else
+             r.get('status')),
+            r.get('resources', '-'), r.get('recovery_count', 0),
+            _fmt_ts(r.get('submitted_at')),
+        ] for r in self._jobs()]
+        svc_rows = []
+        for s in self._services():
+            status = s.get('status')
+            status = status.value if hasattr(status, 'value') else status
+            svc_rows.append([s.get('name'), status,
+                             s.get('endpoint') or '-', '-', '-'])
+            for i in s.get('replica_info', []):
+                svc_rows.append([
+                    f"  └ replica {i.get('replica_id')}",
+                    i.get('status'), i.get('url') or '-',
+                    'spot' if i.get('is_spot') else 'on-demand',
+                    i.get('version'),
+                ])
+        cl_rows = [[
+            r.get('name'),
+            (r['status'].value if hasattr(r.get('status'), 'value') else
+             r.get('status')),
+            _cluster_resources(r),
+            _fmt_ts(r.get('launched_at')),
+        ] for r in self._clusters()]
+        page = _PAGE.format(
+            jobs=_table(['ID', 'NAME', 'STATUS', 'RESOURCES', 'RECOVERIES',
+                         'SUBMITTED'], jobs_rows, status_col=2),
+            services=_table(['SERVICE', 'STATUS', 'ENDPOINT', 'CAPACITY',
+                             'VERSION'], svc_rows, status_col=1),
+            clusters=_table(['NAME', 'STATUS', 'RESOURCES', 'LAUNCHED'],
+                            cl_rows, status_col=1),
+            now=datetime.datetime.now().strftime('%H:%M:%S'))
+        return web.Response(text=page, content_type='text/html')
+
+    async def api_jobs(self, request: web.Request) -> web.Response:
+        del request
+        return web.json_response([
+            dict(r, status=(r['status'].value
+                            if hasattr(r.get('status'), 'value')
+                            else r.get('status')))
+            for r in self._jobs()
+        ])
+
+    async def api_services(self, request: web.Request) -> web.Response:
+        del request
+        out = []
+        for s in self._services():
+            status = s.get('status')
+            out.append(dict(
+                s, status=(status.value
+                           if hasattr(status, 'value') else status)))
+        return web.json_response(out)
+
+    async def api_clusters(self, request: web.Request) -> web.Response:
+        del request
+        out = []
+        for r in self._clusters():
+            status = r.get('status')
+            out.append({
+                'name': r.get('name'),
+                'status': (status.value
+                           if hasattr(status, 'value') else status),
+                'resources': _cluster_resources(r),
+                'launched_at': r.get('launched_at'),
+            })
+        return web.json_response(out)
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get('/', self.index)
+        app.router.add_get('/api/jobs', self.api_jobs)
+        app.router.add_get('/api/services', self.api_services)
+        app.router.add_get('/api/clusters', self.api_clusters)
+        return app
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=46590)
+    args = parser.parse_args(argv)
+    app = Dashboard().make_app()
+    print(f'skytpu dashboard: http://{args.host}:{args.port}')
+    web.run_app(app, host=args.host, port=args.port, print=None,
+                handle_signals=False)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

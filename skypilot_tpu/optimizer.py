@@ -105,6 +105,10 @@ def _fill_in_launchable_resources(
         candidates: List[resources_lib.Resources] = []
         hints: List[str] = []
         for res in task.resources:
+            # Task-level `num_nodes` means SLICES (task.py docstring); an
+            # explicit Resources(num_slices=...) wins when both are set.
+            if task.num_nodes > 1 and res.num_slices == 1:
+                res = res.copy(num_slices=task.num_nodes)
             clouds = ([res.cloud] if res.cloud_name is not None else
                       [registry.get(name) for name in enabled])
             for cloud in clouds:

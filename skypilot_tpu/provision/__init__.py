@@ -17,6 +17,7 @@ _PROVIDERS = {
     'gcp': 'skypilot_tpu.provision.gcp',
     'kubernetes': 'skypilot_tpu.provision.kubernetes',
     'fake': 'skypilot_tpu.provision.fake',
+    'docker': 'skypilot_tpu.provision.docker',
 }
 
 

@@ -66,11 +66,14 @@ class ProvisionRecord:
 
 @dataclasses.dataclass
 class HostInfo:
-    """One SSH-able host (TPU worker VM) inside a slice."""
+    """One reachable host (TPU worker VM, or a pod on kubernetes) inside
+    a slice."""
     host_id: int                   # worker index within the slice
     internal_ip: Optional[str]
     external_ip: Optional[str]
     ssh_port: int = 22
+    # Provider-specific addressing (kubernetes: {'pod', 'namespace'}).
+    metadata: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,17 @@
+"""Local-docker debug provisioner (reference parity:
+sky/backends/local_docker_backend.py + sky/provision/docker_utils.py).
+See instance.py for the container-per-host model."""
+from skypilot_tpu.provision.docker.instance import (cleanup_ports,
+                                                    get_cluster_info,
+                                                    open_ports,
+                                                    query_instances,
+                                                    run_instances,
+                                                    stop_instances,
+                                                    terminate_instances,
+                                                    wait_instances)
+
+__all__ = [
+    'cleanup_ports', 'get_cluster_info', 'open_ports', 'query_instances',
+    'run_instances', 'stop_instances', 'terminate_instances',
+    'wait_instances',
+]

@@ -1,0 +1,184 @@
+"""Local-docker implementation of the functional provision API — the
+debug backend.
+
+Reference parity: sky/backends/local_docker_backend.py:46-56 (cluster →
+docker container, for iterating on task definitions without paying for
+cloud resources). Reshaped to this framework's provision API so the WHOLE
+stack above it (backend, agent bootstrap, runtime shipping, gang driver)
+is exercised unchanged: one cluster = num_slices × hosts_per_slice
+containers, each a long-running `tail -f /dev/null` the DockerCommandRunner
+execs into. No TPUs inside, obviously — `accelerators` is honored as
+topology metadata only.
+
+Driven through the `docker` CLI (the only stable cross-platform surface);
+tests stub the binary on PATH.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import errors
+
+PROVIDER_NAME = 'docker'
+
+_CLUSTER_LABEL = 'skytpu-cluster'
+_SLICE_LABEL = 'skytpu-slice'
+_HOST_LABEL = 'skytpu-host'
+
+_DEFAULT_IMAGE = 'python:3.11-slim'
+
+_STATE_MAP = {
+    'running': common.InstanceStatus.RUNNING,
+    'created': common.InstanceStatus.PENDING,
+    'restarting': common.InstanceStatus.PENDING,
+    'paused': common.InstanceStatus.STOPPED,
+    'exited': common.InstanceStatus.STOPPED,
+    'dead': common.InstanceStatus.TERMINATED,
+}
+
+
+def _docker(*args: str, check: bool = True) -> str:
+    try:
+        proc = subprocess.run(['docker', *args], capture_output=True,
+                              text=True, check=False, timeout=300)
+    except FileNotFoundError as e:
+        raise errors.PrecheckError(
+            'docker binary not found; the docker debug cloud needs a '
+            'local docker daemon.') from e
+    except subprocess.TimeoutExpired as e:
+        raise errors.TransientApiError(f'docker command timed out: '
+                                       f'{e}') from e
+    if check and proc.returncode != 0:
+        raise errors.classify(
+            Exception(f'docker {" ".join(args[:2])} failed: '
+                      f'{proc.stderr.strip()}'))
+    return proc.stdout
+
+
+def _container_name(cluster_name: str, slice_index: int,
+                    host_id: int) -> str:
+    return f'skytpu-{cluster_name}-{slice_index}-{host_id}'
+
+
+def _list_cluster(cluster_name: str) -> List[Dict[str, Any]]:
+    out = _docker('ps', '-a', '--filter',
+                  f'label={_CLUSTER_LABEL}={cluster_name}', '--format',
+                  '{{json .}}')
+    rows = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line:
+            rows.append(json.loads(line))
+    return rows
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    image = config.provider_config.get('image', _DEFAULT_IMAGE)
+    existing = {r['Names']: r for r in _list_cluster(cluster_name)}
+    created, resumed = [], []
+    for i in range(config.num_slices):
+        for h in range(config.hosts_per_slice):
+            name = _container_name(cluster_name, i, h)
+            if name in existing:
+                if existing[name].get('State', '') == 'exited':
+                    _docker('start', name)
+                    resumed.append(name)
+                continue
+            _docker('run', '-d', '--name', name,
+                    '--label', f'{_CLUSTER_LABEL}={cluster_name}',
+                    '--label', f'{_SLICE_LABEL}={i}',
+                    '--label', f'{_HOST_LABEL}={h}',
+                    image, 'tail', '-f', '/dev/null')
+            created.append(name)
+    return common.ProvisionRecord(PROVIDER_NAME, cluster_name, region, zone,
+                                  resumed, created)
+
+
+def wait_instances(region: str, cluster_name: str,
+                   state_filter: Optional[common.InstanceStatus]) -> None:
+    del region, cluster_name, state_filter  # docker run is synchronous
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    del provider_config, worker_only
+    for row in _list_cluster(cluster_name):
+        _docker('stop', row['Names'])
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    del provider_config, worker_only
+    for row in _list_cluster(cluster_name):
+        _docker('rm', '-f', row['Names'], check=False)
+
+
+def query_instances(
+    cluster_name: str,
+    provider_config: Optional[Dict[str, Any]] = None,
+    non_terminated_only: bool = True,
+) -> Dict[str, common.InstanceStatus]:
+    del provider_config
+    out = {}
+    for row in _list_cluster(cluster_name):
+        status = _STATE_MAP.get(row.get('State', ''),
+                                common.InstanceStatus.PENDING)
+        if non_terminated_only and \
+                status == common.InstanceStatus.TERMINATED:
+            continue
+        out[row['Names']] = status
+    return out
+
+
+def get_cluster_info(
+        region: str, cluster_name: str,
+        provider_config: Optional[Dict[str, Any]] = None
+) -> common.ClusterInfo:
+    del provider_config
+    by_slice: Dict[int, List[Dict[str, Any]]] = {}
+    for row in _list_cluster(cluster_name):
+        labels = dict(
+            kv.split('=', 1) for kv in row.get('Labels', '').split(',')
+            if '=' in kv)
+        row['_labels'] = labels
+        by_slice.setdefault(int(labels.get(_SLICE_LABEL, 0)),
+                            []).append(row)
+    slices = []
+    for idx in sorted(by_slice):
+        rows = sorted(by_slice[idx],
+                      key=lambda r: int(r['_labels'].get(_HOST_LABEL, 0)))
+        hosts = []
+        for row in rows:
+            # Exec-based transport: the address is the container name.
+            hosts.append(common.HostInfo(
+                int(row['_labels'].get(_HOST_LABEL, 0)), None, None,
+                metadata={'container': row['Names']}))
+        status = _STATE_MAP.get(rows[0].get('State', ''),
+                                common.InstanceStatus.PENDING)
+        slices.append(common.SliceInfo(f'{cluster_name}-{idx}', idx,
+                                       status, hosts,
+                                       dict(rows[0]['_labels'])))
+    if not slices:
+        raise errors.ProvisionerError(
+            f'No containers found for {cluster_name}.',
+            errors.BlockScope.PRECHECK)
+    return common.ClusterInfo(PROVIDER_NAME, cluster_name, region, zone=None,
+                              slices=slices)
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    # Port publishing must be chosen at `docker run` time; the debug
+    # backend keeps containers off the host network. Documented no-op.
+    del cluster_name, ports, provider_config
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name, provider_config

@@ -1,28 +1,22 @@
 """GKE TPU provisioner (reference parity: sky/provision/kubernetes/, 3,833
-LoC — pods as nodes, ssh-jump/port-forward networking).
+LoC — pods as nodes, label-driven lifecycle, scheduling-error surfacing).
 
 TPU slices on GKE are requested via node selectors
-(cloud.google.com/gke-tpu-accelerator, gke-tpu-topology) on pods. This
-module ships after the GCP path; every function raises a classified
-precheck error so failover cleanly skips kubernetes when unconfigured.
+(cloud.google.com/gke-tpu-accelerator, gke-tpu-topology) on pods; see
+instance.py for the pod-per-host model and k8s_api.py for the
+dependency-light API client with injectable transport.
 """
-from typing import Any, Dict, List, Optional
+from skypilot_tpu.provision.kubernetes.instance import (cleanup_ports,
+                                                        get_cluster_info,
+                                                        open_ports,
+                                                        query_instances,
+                                                        run_instances,
+                                                        stop_instances,
+                                                        terminate_instances,
+                                                        wait_instances)
 
-from skypilot_tpu.provision import common
-from skypilot_tpu.provision import errors
-
-
-def _unavailable(*_args, **_kwargs):
-    raise errors.PrecheckError(
-        'Kubernetes (GKE TPU) provisioning requires a configured '
-        'kubeconfig with TPU node pools; not yet wired in this build.')
-
-
-run_instances = _unavailable
-wait_instances = _unavailable
-stop_instances = _unavailable
-terminate_instances = _unavailable
-query_instances = _unavailable
-get_cluster_info = _unavailable
-open_ports = _unavailable
-cleanup_ports = _unavailable
+__all__ = [
+    'cleanup_ports', 'get_cluster_info', 'open_ports', 'query_instances',
+    'run_instances', 'stop_instances', 'terminate_instances',
+    'wait_instances',
+]

@@ -33,6 +33,10 @@ class ProvisionResult:
     resources: 'resources_lib.Resources'   # pinned to the landed region/zone
     record: provision_common.ProvisionRecord
     cluster_info: provision_common.ClusterInfo
+    # The provider_config the slices were created with (GCP project, k8s
+    # namespace, ...) — later lifecycle ops (query/stop/terminate) need
+    # the same addressing, so the backend persists it in the handle.
+    provider_config: dict = dataclasses.field(default_factory=dict)
 
 
 class FailoverEngine:
@@ -67,6 +71,11 @@ class FailoverEngine:
         """(region, zone) pairs in failover order: cheapest region first,
         honoring any pinned region/zone (reference: _yield_zones,
         sky/backends/cloud_vm_ray_backend.py:1165)."""
+        if to_provision.cloud_name in ('kubernetes', 'docker'):
+            # Availability is cluster-local (a configured k8s context /
+            # the local docker daemon); there is no zone walk.
+            name = to_provision.cloud_name
+            return [(name, name)]
         if to_provision.zone is not None:
             return [(to_provision.region, to_provision.zone)]
         pairs = []
@@ -131,6 +140,22 @@ class FailoverEngine:
         history: List[Exception] = []
         for to_provision in candidates:
             provider = to_provision.cloud_name or 'gcp'
+            # Cloud-specific provider config (GCP project/QR flag, k8s
+            # namespace). Identity failures are prechecks: block this
+            # cloud and continue the candidate walk.
+            try:
+                from skypilot_tpu.clouds import registry
+                cloud_provider_config = registry.get(
+                    provider).provision_provider_config(to_provision)
+            except Exception as e:  # pylint: disable=broad-except
+                err = errors.classify(e)
+                history.append(err)
+                logger.info('Provider config for %s failed: %s', provider,
+                            e)
+                self._block(to_provision.copy(zone=None, region=None),
+                            errors.BlockScope.CLOUD)
+                continue
+            cloud_provider_config.update(provider_config_extra or {})
             for region, zone in self._zone_candidates(to_provision):
                 attempt_res = to_provision.copy(region=region, zone=zone)
                 if self._is_blocked(attempt_res):
@@ -150,7 +175,7 @@ class FailoverEngine:
                     labels=deploy['labels'],
                     ports=deploy['ports'],
                     authorized_key=authorized_key,
-                    provider_config=dict(provider_config_extra or {}),
+                    provider_config=dict(cloud_provider_config),
                 )
                 logger.info('Provisioning %s as %s in %s/%s', cluster_name,
                             to_provision.accelerators, region, zone)
@@ -175,7 +200,8 @@ class FailoverEngine:
                             # handler below owns teardown + blocklisting,
                             # so no slice is leaked even for a ValueError.
                             raise errors.classify(port_err) from port_err
-                    return ProvisionResult(attempt_res, record, info)
+                    return ProvisionResult(attempt_res, record, info,
+                                           dict(config.provider_config))
                 except errors.ProvisionerError as e:
                     history.append(e)
                     if e.scope == errors.BlockScope.PRECHECK:

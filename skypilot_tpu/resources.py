@@ -123,10 +123,10 @@ class Resources:
             self._accelerators = self._tpu.name
         self._region = region
         self._zone = zone
-        # Catalog regions are GCP's; kubernetes uses cluster-local
+        # Catalog regions are GCP's; kubernetes/docker use cluster-local
         # pseudo-regions that the catalog does not know.
         if (region is not None or zone is not None) and \
-                self._cloud_name != 'kubernetes':
+                self._cloud_name not in ('kubernetes', 'docker'):
             self._region, self._zone = catalog.validate_region_zone(
                 region, zone)
 

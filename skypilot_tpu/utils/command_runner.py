@@ -182,6 +182,135 @@ class LocalCommandRunner(CommandRunner):
                 1, f'local sync {src} -> {dst}', str(e)) from e
 
 
+class ExecCommandRunner(CommandRunner):
+    """Base for exec-style transports (kubectl exec, docker exec): run
+    commands through a subprocess exec bridge; file sync is a tar pipe
+    (preserves permissions, needs only tar in the target)."""
+
+    def _exec_base(self, interactive: bool = False) -> List[str]:
+        raise NotImplementedError
+
+    def _argv(self, cmd, env):
+        return self._exec_base() + ['bash', '-c', self._wrap(cmd, env)]
+
+    def run(self, cmd, *, require_outputs=False, stream_logs=False,
+            log_path='/dev/null', env=None, timeout=None):
+        return self._execute(self._argv(cmd, env),
+                             require_outputs=require_outputs,
+                             stream_logs=stream_logs, log_path=log_path,
+                             timeout=timeout)
+
+    def rsync(self, source: str, target: str, *, up: bool, excludes=None):
+        import io
+        import tarfile
+        if not up:
+            self._sync_down(source, target)
+            return
+        src = os.path.expanduser(source)
+        # Build the tar in memory (sources here are small: runtime
+        # tarball, workdirs) and untar inside the pod.
+        buf = io.BytesIO()
+        patterns = list(excludes or [])
+        import fnmatch
+
+        def _filter(info: tarfile.TarInfo):
+            name = os.path.basename(info.name)
+            if any(fnmatch.fnmatch(name, p) for p in patterns):
+                return None
+            return info
+
+        src_is_dir = os.path.isdir(src)
+        with tarfile.open(fileobj=buf, mode='w') as tar:
+            if src_is_dir:
+                for entry in sorted(os.listdir(src)):
+                    tar.add(os.path.join(src, entry), arcname=entry,
+                            filter=_filter)
+            else:
+                tar.add(src, arcname=os.path.basename(target.rstrip('/')),
+                        filter=_filter)
+        dest_dir = target if src_is_dir else \
+            (os.path.dirname(target.rstrip('/')) or '.')
+        # `~` must expand in the TARGET's shell, not be quoted literally.
+        if dest_dir.startswith('~'):
+            dest_expr = '"$HOME"' + shlex.quote(dest_dir[1:])
+        else:
+            dest_expr = shlex.quote(dest_dir)
+        argv = self._exec_base(interactive=True) + [
+            'bash', '-c',
+            f'mkdir -p {dest_expr} && tar -xf - -C {dest_expr}'
+        ]
+        proc = subprocess.run(argv, input=buf.getvalue(),
+                              capture_output=True, check=False)
+        if proc.returncode != 0:
+            from skypilot_tpu import exceptions
+            raise exceptions.CommandError(
+                proc.returncode, ' '.join(argv),
+                proc.stderr.decode(errors='replace'))
+
+    def _sync_down(self, remote_dir: str, local_dir: str) -> None:
+        """Download a remote directory: tar out of the target, extract
+        locally (sync_down_logs / benchmark summaries need this)."""
+        import io
+        import tarfile
+        if remote_dir.startswith('~'):
+            src_expr = '"$HOME"' + shlex.quote(remote_dir[1:])
+        else:
+            src_expr = shlex.quote(remote_dir)
+        argv = self._exec_base(interactive=True) + [
+            'bash', '-c', f'tar -cf - -C {src_expr} .'
+        ]
+        proc = subprocess.run(argv, capture_output=True, check=False)
+        if proc.returncode != 0:
+            from skypilot_tpu import exceptions
+            raise exceptions.CommandError(
+                proc.returncode, ' '.join(argv),
+                proc.stderr.decode(errors='replace'))
+        dst = os.path.expanduser(local_dir)
+        os.makedirs(dst, exist_ok=True)
+        with tarfile.open(fileobj=io.BytesIO(proc.stdout)) as tar:
+            tar.extractall(dst, filter='data')
+
+
+class KubernetesCommandRunner(ExecCommandRunner):
+    """Run commands in one pod via `kubectl exec` (reference:
+    KubernetesCommandRunner, sky/utils/command_runner.py:647)."""
+
+    def __init__(self, pod: str, namespace: str = 'default',
+                 container: Optional[str] = None,
+                 host_env: Optional[Dict[str, str]] = None) -> None:
+        super().__init__(host_env)
+        self.pod = pod
+        self.namespace = namespace
+        self.container = container
+
+    def _exec_base(self, interactive: bool = False) -> List[str]:
+        base = ['kubectl', 'exec']
+        if interactive:
+            base.append('-i')
+        base += [self.pod, '-n', self.namespace]
+        if self.container:
+            base += ['-c', self.container]
+        return base + ['--']
+
+
+class DockerCommandRunner(ExecCommandRunner):
+    """Run commands in one local container via `docker exec` (reference:
+    the docker-exec mode of SSHCommandRunner + LocalDockerBackend,
+    sky/utils/command_runner.py:392, sky/backends/
+    local_docker_backend.py)."""
+
+    def __init__(self, container: str,
+                 host_env: Optional[Dict[str, str]] = None) -> None:
+        super().__init__(host_env)
+        self.container = container
+
+    def _exec_base(self, interactive: bool = False) -> List[str]:
+        base = ['docker', 'exec']
+        if interactive:
+            base.append('-i')
+        return base + [self.container]
+
+
 class SSHCommandRunner(CommandRunner):
     """SSH/rsync to one TPU host (reference: sky/utils/command_runner.py:392;
     the gcloud `tpus tpu-vm ssh --worker=all` fan-out is layered above this
