@@ -35,6 +35,28 @@ class JobSchedulerEvent:
         job_lib.schedule_step()
 
 
+class ManagedJobUpdateEvent:
+    """Dead managed-job-controller watchdog (reference:
+    ManagedJobUpdateEvent, sky/skylet/events.py:70): a controller
+    process that died (OOM, kill -9) leaves its job RUNNING forever
+    unless someone reconciles."""
+    interval = float(os.environ.get('SKYTPU_WATCHDOG_INTERVAL', '300'))
+
+    def step(self) -> None:
+        from skypilot_tpu.jobs import utils as jobs_utils
+        jobs_utils.update_managed_job_status()
+
+
+class ServiceUpdateEvent:
+    """Dead serve-controller watchdog (reference: ServiceUpdateEvent,
+    sky/skylet/events.py:78)."""
+    interval = float(os.environ.get('SKYTPU_WATCHDOG_INTERVAL', '300'))
+
+    def step(self) -> None:
+        from skypilot_tpu.serve import core as serve_core
+        serve_core.update_service_status()
+
+
 class AutostopEvent:
     """Stop/down the cluster from the inside when idle (reference:
     AutostopEvent, events.py:90-291)."""
@@ -92,6 +114,10 @@ def main() -> int:
         JobSchedulerEvent(),
         AutostopEvent(args.cluster_name, args.provider,
                       json.loads(args.provider_config)),
+        # Controller watchdogs: no-ops where the controller dbs are empty
+        # (ordinary cluster heads), reconcilers where controllers live.
+        ManagedJobUpdateEvent(),
+        ServiceUpdateEvent(),
     ]
     last_run = {id(e): 0.0 for e in events}
     logger.info('Agent up for cluster %s (home=%s).', args.cluster_name,

@@ -269,13 +269,8 @@ def schedule_step_safe() -> None:
 
 # ---------------- reconciliation ----------------
 def _pid_alive(pid: Optional[int]) -> bool:
-    if not pid:
-        return False
-    try:
-        os.kill(pid, 0)
-        return True
-    except (ProcessLookupError, PermissionError):
-        return False
+    from skypilot_tpu.utils import subprocess_utils
+    return subprocess_utils.pid_alive(pid)
 
 
 def update_job_statuses() -> None:

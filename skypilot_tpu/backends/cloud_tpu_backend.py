@@ -436,7 +436,16 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
         mounts = dict(all_file_mounts or {})
         recs = handle.host_records()
         for dst, src in mounts.items():
-            if src.startswith(('gs://', 's3://')):
+            if src.startswith(('s3://', 'r2://', 'cos://')):
+                # GCS-first scope (SURVEY §2.10): fail loudly instead of
+                # handing an s3 URI to gcloud and producing a confusing
+                # on-host error mid-provision.
+                raise exceptions.NotSupportedError(
+                    f'File mount source {src!r}: only gs:// (and local '
+                    f'paths) are supported in this build. Mirror the '
+                    f'bucket to GCS, e.g. `gcloud storage cp -r {src} '
+                    f'gs://<bucket>`.')
+            if src.startswith('gs://'):
                 # Download on each host via gcloud storage/gsutil.
                 def _fetch(rec, dst=dst, src=src):
                     runner = handle._make_runner(rec)  # pylint: disable=protected-access

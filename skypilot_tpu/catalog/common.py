@@ -30,23 +30,61 @@ _CATALOG_PATH_OVERRIDE: Optional[str] = None
 def set_catalog_path_override(path: Optional[str]) -> None:
     global _CATALOG_PATH_OVERRIDE
     _CATALOG_PATH_OVERRIDE = path
-    read_catalog.cache_clear()
+    _read_catalog_cached.cache_clear()
+
+
+# A user catalog (written by `fetch_gcp --online`) overrides the packaged
+# one while fresh; past the TTL it is demoted back to the packaged CSV so
+# stale billing data does not silently steer the optimizer forever
+# (reference: read_catalog's TTL refresh, service_catalog/common.py:159).
+CATALOG_TTL_SECONDS = float(os.environ.get('SKYTPU_CATALOG_TTL_SECONDS',
+                                           str(7 * 24 * 3600)))
+
+# One warning per stale file per process: catalog_path() is called once
+# per candidate resource per optimize pass.
+_warned_stale: set = set()
+
+
+def user_catalog_path(filename: str = 'gcp_tpus.csv') -> str:
+    """Where `fetch_gcp --online` writes and catalog_path() reads — ONE
+    definition so the writer and reader cannot drift apart."""
+    home = os.path.expanduser(os.environ.get('SKYTPU_HOME', '~/.skytpu'))
+    return os.path.join(home, 'catalogs', filename)
 
 
 def catalog_path(filename: str = 'gcp_tpus.csv') -> str:
     if _CATALOG_PATH_OVERRIDE is not None:
         return _CATALOG_PATH_OVERRIDE
+    user = user_catalog_path(filename)
+    if os.path.exists(user):
+        import time
+        age = time.time() - os.path.getmtime(user)
+        if age <= CATALOG_TTL_SECONDS:
+            return user
+        if user not in _warned_stale:
+            _warned_stale.add(user)
+            import logging
+            logging.getLogger(__name__).warning(
+                'User catalog %s is %.1f days old (TTL %.0fd); using the '
+                'packaged catalog. Refresh with `python -m '
+                'skypilot_tpu.catalog.data_fetchers.fetch_gcp --online`.',
+                user, age / 86400, CATALOG_TTL_SECONDS / 86400)
     return os.path.join(_CATALOG_DIR, filename)
 
 
 @functools.lru_cache(maxsize=8)
+def _read_catalog_cached(path: str, mtime: float) -> pd.DataFrame:
+    del mtime  # cache key only: picks up in-place rewrites
+    return pd.read_csv(path)
+
+
 def read_catalog(path: Optional[str] = None) -> pd.DataFrame:
     path = path or catalog_path()
     if not os.path.exists(path):
         raise exceptions.SkyTpuError(
             f'Catalog not found at {path}. Regenerate with '
             f'`python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp`.')
-    return pd.read_csv(path)
+    return _read_catalog_cached(path, os.path.getmtime(path))
 
 
 class AcceleratorOffering(NamedTuple):

@@ -111,16 +111,144 @@ def write_csv(rows: List[Dict[str, object]], path: str) -> None:
         w.writerows(rows)
 
 
+# ---------------- online refresh (Cloud Billing Catalog API) ----------------
+
+# Cloud TPU's service id in the billing catalog (reference:
+# sky/clouds/service_catalog/data_fetchers/fetch_gcp.py queries the same
+# service).
+_BILLING_SERVICE = 'services/E000-3F24-B8AA'
+_BILLING_ROOT = 'https://cloudbilling.googleapis.com/v1'
+
+# Billing-SKU description fingerprints → generation.
+_GEN_PATTERNS = [
+    ('v6e', ('v6e', 'trillium')),
+    ('v5p', ('v5p',)),
+    ('v5e', ('v5e', 'v5 lite', 'v5litepod')),
+    ('v4', ('v4',)),
+    ('v3', ('v3',)),
+    ('v2', ('v2',)),
+]
+
+
+def _gen_from_description(desc: str):
+    d = desc.lower()
+    for gen, pats in _GEN_PATTERNS:
+        if any(p in d for p in pats):
+            return gen
+    return None
+
+
+def _sku_unit_price(sku: Dict) -> float:
+    """USD/hour from the SKU's first tiered rate."""
+    expr = (sku.get('pricingInfo') or [{}])[0].get('pricingExpression', {})
+    rates = expr.get('tieredRates') or []
+    if not rates:
+        return 0.0
+    unit = rates[0].get('unitPrice', {})
+    return float(unit.get('units', 0) or 0) + \
+        float(unit.get('nanos', 0) or 0) / 1e9
+
+
+def fetch_billing_prices(transport=None) -> Dict[Tuple[str, str, bool],
+                                                 float]:
+    """{(generation, region, is_spot): $/chip-hour} from the billing API.
+
+    `transport(url) -> dict` is injectable for tests; the default uses
+    ADC credentials (same lazy-auth pattern as provision/gcp/tpu_api).
+    """
+    if transport is None:
+        def transport(url):
+            import json as json_lib
+            import urllib.request
+            from skypilot_tpu.provision.gcp import tpu_api
+            token = tpu_api._get_token()  # pylint: disable=protected-access
+            req = urllib.request.Request(
+                url, headers={'Authorization': f'Bearer {token}'})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return json_lib.loads(resp.read().decode())
+
+    prices: Dict[Tuple[str, str, bool], float] = {}
+    page_token = ''
+    while True:
+        url = f'{_BILLING_ROOT}/{_BILLING_SERVICE}/skus?pageSize=500'
+        if page_token:
+            url += f'&pageToken={page_token}'
+        payload = transport(url)
+        for sku in payload.get('skus', []):
+            category = sku.get('category', {})
+            if category.get('resourceGroup') != 'TPU':
+                continue
+            desc = sku.get('description', '')
+            gen = _gen_from_description(desc)
+            if gen is None:
+                continue
+            is_spot = ('preemptible' in desc.lower() or
+                       'spot' in desc.lower())
+            price = _sku_unit_price(sku)
+            if price <= 0:
+                continue
+            for region in sku.get('serviceRegions', []):
+                key = (gen, region, is_spot)
+                # Multiple SKUs can map to one key (pod vs device);
+                # keep the cheapest per-chip figure.
+                if key not in prices or price < prices[key]:
+                    prices[key] = price
+        page_token = payload.get('nextPageToken', '')
+        if not page_token:
+            return prices
+
+
+def build_online_rows(transport=None) -> List[Dict[str, object]]:
+    """Offline skeleton re-priced from live billing data where available
+    (zones/shapes stay curated: the TPU API has no cross-project
+    availability listing)."""
+    billed = fetch_billing_prices(transport)
+    rows = build_offline_rows()
+    for row in rows:
+        gen = str(row['generation'])
+        region = str(row['region'])
+        chips = int(row['chips'])  # type: ignore[arg-type]
+        od = billed.get((gen, region, False))
+        if od is not None:
+            row['price'] = round(od * chips, 4)
+        spot = billed.get((gen, region, True))
+        if spot is not None:
+            row['spot_price'] = round(spot * chips, 4)
+        elif od is not None:
+            _, spot_frac = _BASE_CHIP_HOUR[gen]
+            row['spot_price'] = round(od * chips * spot_frac, 4)
+    return rows
+
+
+def user_catalog_path() -> str:
+    from skypilot_tpu.catalog import common as catalog_common
+    return catalog_common.user_catalog_path()
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument('--offline', action='store_true', default=True)
-    parser.add_argument('--output', default=os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        'data', 'gcp_tpus.csv'))
+    parser.add_argument('--online', action='store_true', default=False,
+                        help='refresh prices via the Cloud Billing API '
+                             'into the user catalog (~/.skytpu/catalogs).')
+    parser.add_argument('--output', default=None)
     args = parser.parse_args()
-    rows = build_offline_rows()
-    write_csv(rows, args.output)
-    print(f'wrote {len(rows)} rows to {args.output}')
+    if args.online:
+        try:
+            rows = build_online_rows()
+        except Exception as e:  # pylint: disable=broad-except
+            print(f'Online refresh failed ({type(e).__name__}: {e}).\n'
+                  f'Billing-API access needs Application Default '
+                  f'Credentials: run `gcloud auth application-default '
+                  f'login` and retry.', file=__import__('sys').stderr)
+            raise SystemExit(1)
+        output = args.output or user_catalog_path()
+    else:
+        rows = build_offline_rows()
+        output = args.output or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            'data', 'gcp_tpus.csv')
+    write_csv(rows, output)
+    print(f'wrote {len(rows)} rows to {output}')
 
 
 if __name__ == '__main__':

@@ -147,12 +147,21 @@ def launch(entrypoint, name, workdir, cloud, region, zone, accelerators,
     cluster = cluster or task.name
     if not dryrun:
         _confirm(f'Launching on cluster {cluster!r}. Proceed?', yes)
+    from skypilot_tpu.utils import rich_utils
+    import contextlib
+    # Spinner only for detached launches: an attached launch streams the
+    # job's logs to stdout, and a live spinner redrawing the line would
+    # garble them.
+    status_ctx = (rich_utils.safe_status(
+        f'Launching on cluster {cluster or "<new>"}...')
+        if detach_run and not dryrun else contextlib.nullcontext())
     try:
-        job_id, handle = sky.launch(
-            task, cluster_name=cluster, dryrun=dryrun,
-            detach_run=detach_run, down=down,
-            idle_minutes_to_autostop=idle_minutes_to_autostop,
-            retry_until_up=retry_until_up)
+        with status_ctx:
+            job_id, handle = sky.launch(
+                task, cluster_name=cluster, dryrun=dryrun,
+                detach_run=detach_run, down=down,
+                idle_minutes_to_autostop=idle_minutes_to_autostop,
+                retry_until_up=retry_until_up)
     except (exceptions.ResourcesUnavailableError, ValueError) as e:
         _fail(str(e))
     if dryrun:
@@ -182,7 +191,12 @@ def exec_cmd(cluster, entrypoint, env, detach_run):
               help='Reconcile with cloud state first.')
 def status(refresh):
     """Cluster table (reference: sky status, cli.py:1507)."""
-    records = sky.status(refresh=refresh)
+    from skypilot_tpu.utils import rich_utils
+    if refresh:
+        with rich_utils.safe_status('Refreshing cluster statuses...'):
+            records = sky.status(refresh=True)
+    else:
+        records = sky.status(refresh=False)
     if not records:
         click.echo('No clusters.')
         return
@@ -191,11 +205,18 @@ def status(refresh):
         handle = r['handle']
         resources = (str(handle.launched_resources)
                      if handle is not None else '-')
+        endpoints = '-'
+        if handle is not None and \
+                handle.launched_resources.ports and handle.head_ip:
+            endpoints = ' '.join(
+                f'{handle.head_ip}:{p}'
+                for p in handle.launched_resources.ports)
         rows.append([
-            r['name'], r['status'].value, resources,
+            r['name'], r['status'].value, resources, endpoints,
             r.get('autostop', -1) if r.get('autostop', -1) >= 0 else '-'
         ])
-    _print_table(rows, ['NAME', 'STATUS', 'RESOURCES', 'AUTOSTOP(min)'])
+    _print_table(rows, ['NAME', 'STATUS', 'RESOURCES', 'ENDPOINTS',
+                        'AUTOSTOP(min)'])
 
 
 @cli.command()

@@ -49,21 +49,8 @@ def check_cancel_signal(job_id: int) -> bool:
 
 
 def _pid_alive(pid: Optional[int]) -> bool:
-    if pid is None:
-        return False
-    try:
-        os.kill(pid, 0)
-    except (OSError, ProcessLookupError):
-        return False
-    # kill(pid, 0) succeeds for zombies (a dead controller stays a zombie
-    # until its parent reaps it) — check the process state too.
-    try:
-        with open(f'/proc/{pid}/stat', 'r', encoding='utf-8') as f:
-            # Field 3 (after the parenthesised comm) is the state.
-            state = f.read().rsplit(')', 1)[1].split()[0]
-        return state != 'Z'
-    except (OSError, IndexError):
-        return True
+    from skypilot_tpu.utils import subprocess_utils
+    return subprocess_utils.pid_alive(pid)
 
 
 def update_managed_job_status(job_ids: Optional[List[int]] = None) -> None:

@@ -27,6 +27,17 @@ class ModelConfig:
     # MoE (0 ⇒ dense SwiGLU MLP).
     num_experts: int = 0
     experts_per_token: int = 2
+    # 'dispatch' = capacity-based token dispatch (GShard-style: only the
+    # chosen k experts compute each token; the dispatch einsum reshapes
+    # tokens expert-major, which under `ep` sharding lowers to an
+    # all-to-all over ICI). 'dense' = every expert computes every token
+    # with a one-hot combine (exact, simple, E/k× more FLOPs — kept as
+    # the reference implementation and for tiny configs).
+    moe_impl: str = 'dispatch'
+    # Per-expert buffer = ceil(tokens·k/E) · capacity_factor; tokens over
+    # capacity are dropped (their combine weight contributes nothing —
+    # standard GShard/Switch semantics).
+    moe_capacity_factor: float = 1.25
     # Execution knobs.
     scan_layers: bool = True          # lax.scan over stacked layers
     remat: bool = True                # checkpoint each layer

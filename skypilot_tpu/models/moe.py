@@ -1,18 +1,23 @@
 """Mixture-of-Experts block (Mixtral-style) with expert parallelism.
 
 Experts are a stacked weight dim carrying logical axis 'expert' → mesh axis
-`ep`. This round uses the dense-dispatch formulation: every expert computes
-every token and a top-k one-hot combine zeroes the rest. That keeps the op
-a pure einsum (MXU-friendly, no gather/scatter, compiles under scan/remat)
-and makes EP sharding exact: with experts sharded over `ep`, XLA partitions
-the expert dim so each device computes only its local experts, then
-all-reduces the combine over `ep`.
+`ep`. Two formulations, selected by ``cfg.moe_impl``:
 
-A ragged/sorted token-dispatch kernel (megablox-equivalent) is the planned
-optimization for large-scale MoE; the module interface will not change.
-
-Reference parity note: the reference has no in-tree MoE — its Mixtral/dbrx
-recipes delegate EP to vLLM/megablocks (SURVEY §2.9). Here it is in-tree.
+- **dispatch** (default): GShard/Switch-style capacity-based token
+  dispatch. Each token's top-k experts get it via a one-hot dispatch
+  einsum into per-expert capacity buffers (E, C, D); only the chosen
+  experts compute — k/E of the dense formulation's expert FLOPs. Under
+  `ep` sharding GSPMD turns the token-sharded → expert-sharded buffer
+  movement into the EP collective (an all-to-all when tokens and
+  experts ride the same mesh axis; otherwise an all-reduce of the
+  capacity buffers with identical volume) — the TPU-native EP data
+  path, MaxText's dense-dispatch formulation. (jucor/skypilot has no
+  in-tree MoE; its Mixtral/dbrx recipes delegate EP to vLLM/megablocks,
+  SURVEY §2.9.) Tokens over an expert's capacity are dropped (standard
+  GShard semantics; capacity_factor 1.25 gives headroom).
+- **dense**: every expert computes every token and a top-k one-hot
+  combine zeroes the rest. Exact (no drops), E/k× more expert FLOPs;
+  kept as the correctness reference and for tiny test configs.
 """
 from __future__ import annotations
 
@@ -60,6 +65,25 @@ class MoEBlock(nn.Module):
                             router_w.astype(jnp.float32))
         topk_vals, topk_idx = jax.lax.top_k(logits, cfg.experts_per_token)
         topk_probs = jax.nn.softmax(topk_vals, axis=-1)       # (B,S,k)
+
+        if cfg.moe_impl == 'dense':
+            return self._dense(x, topk_idx, topk_probs,
+                               (w_gate, w_up, w_down), dtype)
+        if cfg.moe_impl != 'dispatch':
+            # A typo must not silently switch semantics (dispatch drops
+            # over-capacity tokens; dense is exact).
+            raise ValueError(
+                f'Unknown moe_impl {cfg.moe_impl!r}; expected '
+                f"'dispatch' or 'dense'.")
+        return self._dispatch(x, topk_idx, topk_probs,
+                              (w_gate, w_up, w_down), dtype)
+
+    # ---------------- dense reference ----------------
+
+    def _dense(self, x, topk_idx, topk_probs, weights, dtype):
+        cfg = self.cfg
+        e = cfg.num_experts
+        w_gate, w_up, w_down = weights
         # Combine weights as a dense (B,S,E) map (one-hot sum over k).
         combine = jnp.sum(
             jax.nn.one_hot(topk_idx, e, dtype=jnp.float32) *
@@ -75,4 +99,66 @@ class MoEBlock(nn.Module):
         out = jnp.einsum('ebsd,bse->bsd', out.astype(jnp.float32),
                          combine)
         out = out.astype(dtype)
+        return sharding.constrain(out, 'batch', 'seq', 'act_embed')
+
+    # ---------------- capacity-based dispatch ----------------
+
+    def _dispatch(self, x, topk_idx, topk_probs, weights, dtype):
+        cfg = self.cfg
+        e, k = cfg.num_experts, cfg.experts_per_token
+        w_gate, w_up, w_down = weights
+        b, s, d = x.shape
+        g = b * s  # tokens
+        # Per-expert capacity (static: shapes must not depend on routing).
+        capacity = int(-(-g * k // e) * cfg.moe_capacity_factor)
+        capacity = max(1, min(capacity, g))
+
+        flat_idx = topk_idx.reshape(g, k)                     # (G,k)
+        flat_probs = topk_probs.reshape(g, k).astype(jnp.float32)
+        xf = x.reshape(g, d).astype(dtype)
+
+        # Position of each (token, choice) within its expert's buffer:
+        # running count of prior assignments to the same expert, priority
+        # by (choice rank, token order) — GShard's ordering.
+        choice_onehot = jax.nn.one_hot(flat_idx, e,
+                                       dtype=jnp.int32)       # (G,k,E)
+        # Flatten choices k-major so 1st choices beat 2nd choices.
+        seq_onehot = choice_onehot.transpose(1, 0, 2).reshape(k * g, e)
+        positions = jnp.cumsum(seq_onehot, axis=0) - seq_onehot
+        positions = jnp.sum(positions * seq_onehot, axis=-1)  # (k*G,)
+        positions = positions.reshape(k, g).transpose(1, 0)   # (G,k)
+        keep = positions < capacity                            # (G,k)
+
+        # dispatch[g, e, c] = 1 iff token g occupies slot c of expert e.
+        pos_onehot = jax.nn.one_hot(positions, capacity,
+                                    dtype=jnp.float32)        # (G,k,C)
+        dispatch = jnp.einsum(
+            'gke,gkc->gec',
+            choice_onehot.astype(jnp.float32) *
+            keep[..., None].astype(jnp.float32),
+            pos_onehot)                                        # (G,E,C)
+        combine = jnp.einsum(
+            'gke,gkc,gk->gec',
+            choice_onehot.astype(jnp.float32),
+            pos_onehot,
+            flat_probs * keep.astype(jnp.float32))             # (G,E,C)
+
+        # Token-sharded → expert-sharded: this reshape IS the all-to-all
+        # under `ep` (GSPMD inserts it from the sharding constraints).
+        expert_in = jnp.einsum('gd,gec->ecd', xf,
+                               dispatch.astype(dtype))         # (E,C,D)
+        expert_in = sharding.constrain(expert_in, 'expert', None, None)
+        gate = jnp.einsum('ecd,edm->ecm', expert_in,
+                          w_gate.astype(dtype))
+        up = jnp.einsum('ecd,edm->ecm', expert_in, w_up.astype(dtype))
+        h = nn.silu(gate) * up                                 # (E,C,M)
+        h = sharding.constrain(h, 'expert', None, 'mlp')
+        expert_out = jnp.einsum('ecm,emd->ecd', h,
+                                w_down.astype(dtype))          # (E,C,D)
+        expert_out = sharding.constrain(expert_out, 'expert', None, None)
+        # Expert-sharded → token-sharded (the return all-to-all), with
+        # the router probabilities applied in fp32.
+        out = jnp.einsum('ecd,gec->gd',
+                         expert_out.astype(jnp.float32), combine)
+        out = out.reshape(b, s, d).astype(dtype)
         return sharding.constrain(out, 'batch', 'seq', 'act_embed')

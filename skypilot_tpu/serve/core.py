@@ -126,7 +126,8 @@ def down(service_name: str, purge: bool = False) -> None:
         raise exceptions.ServeUserTerminatedError(
             f'Service {service_name!r} does not exist.')
     pid = record['controller_pid']
-    if pid is not None:
+    from skypilot_tpu.utils import subprocess_utils
+    if pid is not None and subprocess_utils.pid_alive(pid):
         try:
             os.kill(pid, signal_lib.SIGTERM)
         except (OSError, ProcessLookupError):
@@ -137,6 +138,8 @@ def down(service_name: str, purge: bool = False) -> None:
             if serve_state.get_service(service_name) is None:
                 return
             time.sleep(0.2)
+    # Controller already dead (or never started): no runner will ever
+    # remove the row — fall through to direct cleanup instead of waiting.
     if purge:
         # Runner gone/stuck: remove any leftover replica clusters directly.
         from skypilot_tpu import core as sky_core
@@ -156,9 +159,35 @@ def down(service_name: str, purge: bool = False) -> None:
 
 
 @timeline.event
-def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
+def update_service_status() -> None:
+    """Dead-controller watchdog (reference: ServiceUpdateEvent,
+    sky/skylet/events.py:78 + serve_utils.update_service_status): a
+    service whose controller process is gone can never probe or scale
+    again — mark it CONTROLLER_FAILED instead of showing a live status
+    forever."""
+    from skypilot_tpu.serve.serve_state import ServiceStatus
+    for record in serve_state.get_services():
+        status_val = record['status']
+        if isinstance(status_val, ServiceStatus) and status_val in (
+                ServiceStatus.CONTROLLER_FAILED, ServiceStatus.FAILED,
+                ServiceStatus.FAILED_CLEANUP, ServiceStatus.SHUTTING_DOWN):
+            continue
+        pid = record['controller_pid']
+        if pid is None:
+            continue
+        from skypilot_tpu.utils import subprocess_utils
+        if not subprocess_utils.pid_alive(pid):
+            serve_state.set_service_status(
+                record['name'], ServiceStatus.CONTROLLER_FAILED)
+
+
+def status(service_name: Optional[str] = None,
+           refresh: bool = True) -> List[Dict[str, Any]]:
     """Service + replica records (reference: serve.status,
-    serve/core.py:499)."""
+    serve/core.py:499). `refresh` runs dead-controller detection
+    first."""
+    if refresh:
+        update_service_status()
     records = serve_state.get_services()
     if service_name is not None:
         records = [r for r in records if r['name'] == service_name]

@@ -190,11 +190,28 @@ class Task:
         return getattr(self, '_ordered_candidates', None)
 
     # ---------------- storage / files ----------------
+    @staticmethod
+    def _validate_file_mounts(file_mounts: Dict[str, str]) -> None:
+        """Unsupported cloud schemes fail at SPEC time — discovering it
+        after a slice is provisioned (and billing) would be too late
+        (GCS-first scope, SURVEY §2.10)."""
+        for dst, src in file_mounts.items():
+            if isinstance(src, str) and src.startswith(
+                    ('s3://', 'r2://', 'cos://', 'azblob://')):
+                raise ValueError(
+                    f'file_mounts[{dst!r}]: source {src!r} — only gs:// '
+                    f'and local paths are supported in this build. '
+                    f'Mirror the bucket to GCS first, e.g. '
+                    f'`gcloud storage cp -r {src} gs://<bucket>`.')
+
     def set_file_mounts(self, file_mounts: Optional[Dict[str, str]]) -> 'Task':
+        if file_mounts:
+            self._validate_file_mounts(file_mounts)
         self.file_mounts = dict(file_mounts) if file_mounts else None
         return self
 
     def update_file_mounts(self, file_mounts: Dict[str, str]) -> 'Task':
+        self._validate_file_mounts(file_mounts)
         if self.file_mounts is None:
             self.file_mounts = {}
         self.file_mounts.update(file_mounts)

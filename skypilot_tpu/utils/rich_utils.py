@@ -1,0 +1,88 @@
+"""Terminal status spinner (reference parity: sky/utils/rich_utils.py —
+`safe_status` wraps long client operations in a live spinner).
+
+Dependency-free ANSI spinner on a background thread; degrades to a plain
+one-line print when stdout is not a TTY (CI, pipes) and to nothing when
+SKYTPU_NO_SPINNER=1. Nesting is safe: inner statuses update the line.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import sys
+import threading
+import time
+from typing import Iterator, Optional
+
+_FRAMES = ('⠋', '⠙', '⠹', '⠸', '⠼', '⠴', '⠦', '⠧', '⠇', '⠏')
+_INTERVAL = 0.08
+
+_active: Optional['_Spinner'] = None
+_lock = threading.Lock()
+
+
+class _Spinner:
+
+    def __init__(self, message: str) -> None:
+        self.message = message
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._spin, daemon=True)
+
+    def _spin(self) -> None:
+        for frame in itertools.cycle(_FRAMES):
+            if self._stop.is_set():
+                break
+            sys.stdout.write(f'\r\033[K{frame} {self.message}')
+            sys.stdout.flush()
+            time.sleep(_INTERVAL)
+        sys.stdout.write('\r\033[K')
+        sys.stdout.flush()
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def update(self, message: str) -> None:
+        self.message = message
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=1.0)
+
+
+def _enabled() -> bool:
+    return (sys.stdout.isatty() and
+            os.environ.get('SKYTPU_NO_SPINNER') != '1' and
+            os.environ.get('TERM', '') != 'dumb')
+
+
+@contextlib.contextmanager
+def safe_status(message: str) -> Iterator:
+    """`with safe_status('Provisioning...')`: live spinner on a TTY, a
+    plain line otherwise (reference: rich_utils.safe_status)."""
+    global _active
+    with _lock:
+        outer = _active
+    if outer is not None:
+        # Nested: retitle the outer spinner, restore on exit.
+        prev = outer.message
+        outer.update(message)
+        try:
+            yield outer
+        finally:
+            outer.update(prev)
+        return
+    if not _enabled():
+        print(message, flush=True)
+        yield None
+        return
+    spinner = _Spinner(message)
+    with _lock:
+        _active = spinner
+    spinner.start()
+    try:
+        yield spinner
+    finally:
+        spinner.stop()
+        with _lock:
+            _active = None

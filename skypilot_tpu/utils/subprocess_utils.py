@@ -23,6 +23,26 @@ def run_in_parallel(fn: Callable, args: List[Any],
         return list(pool.map(fn, args))
 
 
+def pid_alive(pid: Optional[int]) -> bool:
+    """Zombie-aware process liveness: kill(pid, 0) succeeds for zombies
+    (a dead detached controller stays a zombie until its parent reaps
+    it), so the /proc state is checked too. The one shared liveness
+    predicate for job drivers and jobs/serve controller watchdogs."""
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+    except (OSError, ProcessLookupError):
+        return False
+    try:
+        with open(f'/proc/{pid}/stat', 'r', encoding='utf-8') as f:
+            # Field 3 (after the parenthesised comm) is the state.
+            state = f.read().rsplit(')', 1)[1].split()[0]
+        return state != 'Z'
+    except (OSError, IndexError):
+        return True  # no /proc (non-Linux): trust kill(pid, 0)
+
+
 def kill_process_tree(pid: int, sig: int = signal.SIGTERM,
                       include_parent: bool = True) -> None:
     """Signal a process and all descendants (no psutil dependency: walk

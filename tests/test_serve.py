@@ -253,6 +253,30 @@ class TestServeEndToEnd:
         assert serve_core.status('svc') == []
         assert global_user_state.get_clusters() == []
 
+    def test_dead_controller_detection(self):
+        """A serve controller killed out-of-band must surface as
+        CONTROLLER_FAILED via the watchdog (reference: ServiceUpdateEvent,
+        sky/skylet/events.py:78), not stay READY forever."""
+        import os
+        import signal
+        from skypilot_tpu.serve import core as serve_core
+        result = serve_core.up(self._service_task(), 'svcdead')
+        try:
+            serve_core.wait_until_ready('svcdead', timeout=90)
+            os.kill(result['pid'], signal.SIGKILL)
+            deadline = time.time() + 10
+            status = None
+            while time.time() < deadline:
+                serve_core.update_service_status()
+                status = serve_core.status('svcdead',
+                                           refresh=False)[0]['status']
+                if status == ServiceStatus.CONTROLLER_FAILED:
+                    break
+                time.sleep(0.2)
+            assert status == ServiceStatus.CONTROLLER_FAILED
+        finally:
+            serve_core.down('svcdead', purge=True)
+
     def test_two_replicas_round_robin(self):
         from skypilot_tpu.serve import core as serve_core
         serve_core.up(self._service_task(replicas=2), 'svc2')
