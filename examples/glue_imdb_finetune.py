@@ -1,0 +1,142 @@
+"""Sentiment-classification fine-tune on one TPU host.
+
+TPU-native rewrite of the reference's BERT-fine-tune recipe
+(examples/huggingface_glue_imdb_app.py: HF transformers + torch on a GPU).
+Here the encoder is the in-tree transformer with a mean-pool
+classification head, trained with the same jit/shard machinery as the big
+models. Data: the IMDB reviews set via `datasets` when installed (real
+clusters pip-install it in `setup:`); otherwise a built-in synthetic
+sentiment corpus so the example runs hermetically anywhere.
+
+Run directly (CPU or one chip):
+    python3 examples/glue_imdb_finetune.py --steps 30
+Launch on a slice:
+    skytpu launch examples/huggingface_glue_imdb_app.yaml
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from skypilot_tpu.models import Transformer, get_config
+
+SEQ_LEN = 128
+_POS = ('great', 'wonderful', 'loved', 'brilliant', 'excellent',
+        'delightful', 'superb', 'masterpiece')
+_NEG = ('terrible', 'awful', 'hated', 'boring', 'dreadful', 'wooden',
+        'mess', 'disaster')
+
+
+def synthetic_reviews(n: int, rng: np.random.Generator):
+    """Tiny generated sentiment corpus (hermetic fallback for `datasets`)."""
+    texts, labels = [], []
+    fillers = ('the movie was', 'i thought it was', 'honestly just',
+               'the acting felt', 'overall a', 'what a')
+    for _ in range(n):
+        label = int(rng.integers(2))
+        words = [rng.choice(fillers)]
+        vocab = _POS if label else _NEG
+        words += list(rng.choice(vocab, size=3))
+        texts.append(' '.join(words))
+        labels.append(label)
+    return texts, labels
+
+
+def load_data(n: int):
+    try:
+        import datasets  # type: ignore
+        ds = datasets.load_dataset('imdb', split=f'train[:{n}]')
+        return list(ds['text']), list(ds['label'])
+    except Exception:  # pylint: disable=broad-except
+        print('datasets/imdb unavailable; using the synthetic corpus.')
+        return synthetic_reviews(n, np.random.default_rng(0))
+
+
+def encode_batch(texts, labels):
+    """Byte-level tokenization, right-padded/truncated to SEQ_LEN."""
+    ids = np.zeros((len(texts), SEQ_LEN), np.int32)
+    for i, t in enumerate(texts):
+        b = list(t.encode('utf-8'))[:SEQ_LEN]
+        ids[i, :len(b)] = b
+    return jnp.asarray(ids), jnp.asarray(labels, jnp.int32)
+
+
+class Classifier(nn.Module):
+    """In-tree transformer trunk + mean-pool + linear head."""
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = dataclasses.replace(get_config('test-tiny'),
+                                  vocab_size=256, max_seq_len=SEQ_LEN,
+                                  dtype='float32', param_dtype='float32',
+                                  remat=False)
+        # Hidden states: reuse the trunk minus its LM head by reading the
+        # logits' pre-projection via a small trick — run the trunk and
+        # project its LM logits down. Simpler and still a real fine-tune:
+        # treat the LM logits as features.
+        feats = Transformer(cfg, name='trunk')(tokens)     # (B,S,V)
+        pooled = feats.mean(axis=1)                        # (B,V)
+        return nn.Dense(self.num_classes, name='head')(pooled)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--steps', type=int, default=50)
+    parser.add_argument('--batch', type=int, default=32)
+    parser.add_argument('--examples', type=int, default=512)
+    parser.add_argument('--lr', type=float, default=3e-4)
+    args = parser.parse_args(argv)
+
+    texts, labels = load_data(args.examples)
+    ids, y = encode_batch(texts, labels)
+    n_train = int(len(texts) * 0.9)
+
+    model = Classifier()
+    params = model.init(jax.random.PRNGKey(0), ids[:2])
+    tx = optax.adamw(args.lr)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            logits = model.apply(p, xb)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+            acc = (logits.argmax(-1) == yb).mean()
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn,
+                                                has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, acc
+
+    rng = np.random.default_rng(1)
+    t0 = time.time()
+    for i in range(args.steps):
+        sel = rng.integers(0, n_train, size=args.batch)
+        params, opt_state, loss, acc = step(params, opt_state, ids[sel],
+                                            y[sel])
+        if i % 10 == 0 or i == args.steps - 1:
+            print(f'step {i}: loss={float(loss):.4f} '
+                  f'acc={float(acc):.2f}')
+
+    @jax.jit
+    def eval_acc(params, xb, yb):
+        return (model.apply(params, xb).argmax(-1) == yb).mean()
+
+    test_acc = float(eval_acc(params, ids[n_train:], y[n_train:]))
+    print(f'done in {time.time() - t0:.1f}s; held-out accuracy: '
+          f'{test_acc:.2f}')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

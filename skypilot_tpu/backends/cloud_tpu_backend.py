@@ -435,8 +435,9 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
         Storage (bucket) mounts are mounted via the data layer."""
         mounts = dict(all_file_mounts or {})
         recs = handle.host_records()
+        from skypilot_tpu.data import data_utils
         for dst, src in mounts.items():
-            if src.startswith(('s3://', 'r2://', 'cos://')):
+            if src.startswith(data_utils.UNSUPPORTED_CLOUD_SCHEMES):
                 # GCS-first scope (SURVEY §2.10): fail loudly instead of
                 # handing an s3 URI to gcloud and producing a confusing
                 # on-host error mid-provision.

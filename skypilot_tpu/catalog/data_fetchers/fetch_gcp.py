@@ -5,13 +5,13 @@ LoC) queries the GCP SKU + TPU APIs to build pricing CSVs that are then hosted
 and cached client-side. Here the same two-phase design is kept (fetcher →
 CSV → query API), but the fetcher also has a fully offline mode that emits the
 checked-in catalog from embedded list prices, so the framework works with zero
-network access and tests are hermetic. Run with ``--offline`` to regenerate
-``skypilot_tpu/catalog/data/gcp_tpus.csv``.
+network access and tests are hermetic. Run with no flags to regenerate
+``skypilot_tpu/catalog/data/gcp_tpus.csv`` offline.
 
 With network + credentials, ``--online`` refreshes prices via the Cloud
-Billing Catalog API (services/E000-3F24-B8AA is Cloud TPU) and availability
-via ``tpu.googleapis.com`` acceleratorTypes.list per zone; both paths emit the
-same schema.
+Billing Catalog API (services/E000-3F24-B8AA is Cloud TPU) into the user
+catalog (~/.skytpu/catalogs/, TTL-preferred by catalog/common.py); both
+paths emit the same schema.
 """
 from __future__ import annotations
 

@@ -151,17 +151,29 @@ def launch(entrypoint, name, workdir, cloud, region, zone, accelerators,
     import contextlib
     # Spinner only for detached launches: an attached launch streams the
     # job's logs to stdout, and a live spinner redrawing the line would
-    # garble them.
+    # garble them. The plan table prints BEFORE the spinner starts (the
+    # optimizer result is cached on the task, so launch won't re-print).
+    use_spinner = detach_run and not dryrun
+    quiet_opt = False
+    if use_spinner:
+        try:
+            dag = sky.Dag()
+            dag.add(task)
+            sky.optimize(dag)
+            quiet_opt = True
+        except (exceptions.ResourcesUnavailableError, ValueError) as e:
+            _fail(str(e))
     status_ctx = (rich_utils.safe_status(
         f'Launching on cluster {cluster or "<new>"}...')
-        if detach_run and not dryrun else contextlib.nullcontext())
+        if use_spinner else contextlib.nullcontext())
     try:
         with status_ctx:
             job_id, handle = sky.launch(
                 task, cluster_name=cluster, dryrun=dryrun,
                 detach_run=detach_run, down=down,
                 idle_minutes_to_autostop=idle_minutes_to_autostop,
-                retry_until_up=retry_until_up)
+                retry_until_up=retry_until_up,
+                quiet_optimizer=quiet_opt)
     except (exceptions.ResourcesUnavailableError, ValueError) as e:
         _fail(str(e))
     if dryrun:
@@ -228,8 +240,16 @@ def queue(cluster, skip_finished):
         jobs = sky.queue(cluster, skip_finished=skip_finished)
     except exceptions.ClusterNotUpError as e:
         _fail(str(e))
+    import datetime
+
+    def fmt_ts(ts):
+        if not ts:
+            return '-'
+        return datetime.datetime.fromtimestamp(float(ts)).strftime(
+            '%Y-%m-%d %H:%M:%S')
+
     rows = [[j['job_id'], j.get('job_name') or '-', j['status'],
-             j.get('submitted_at') or '-'] for j in jobs]
+             fmt_ts(j.get('submitted_at'))] for j in jobs]
     _print_table(rows, ['ID', 'NAME', 'STATUS', 'SUBMITTED'])
 
 

@@ -199,12 +199,49 @@ class Dashboard:
             })
         return web.json_response(out)
 
+    async def metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition of the three state tables (the
+        reference has no Prometheus surface at all — SURVEY §5)."""
+        del request
+
+        def counts(records, key='status'):
+            out: Dict[str, int] = {}
+            for r in records:
+                v = r.get(key)
+                v = v.value if hasattr(v, 'value') else str(v)
+                out[v] = out.get(v, 0) + 1
+            return out
+
+        lines = []
+
+        def gauge(name, help_text, by_status):
+            lines.append(f'# HELP {name} {help_text}')
+            lines.append(f'# TYPE {name} gauge')
+            for status, n in sorted(by_status.items()):
+                lines.append(f'{name}{{status="{status}"}} {n}')
+
+        gauge('skytpu_managed_jobs', 'Managed jobs by status',
+              counts(self._jobs()))
+        gauge('skytpu_clusters', 'Clusters by status',
+              counts(self._clusters()))
+        services = self._services()
+        gauge('skytpu_services', 'Services by status', counts(services))
+        replicas: Dict[str, int] = {}
+        for s in services:
+            for i in s.get('replica_info', []):
+                v = str(i.get('status'))
+                replicas[v] = replicas.get(v, 0) + 1
+        gauge('skytpu_replicas', 'Serve replicas by status', replicas)
+        return web.Response(text='\n'.join(lines) + '\n',
+                            content_type='text/plain')
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get('/', self.index)
         app.router.add_get('/api/jobs', self.api_jobs)
         app.router.add_get('/api/services', self.api_services)
         app.router.add_get('/api/clusters', self.api_clusters)
+        app.router.add_get('/metrics', self.metrics)
         return app
 
 

@@ -15,6 +15,11 @@ from skypilot_tpu import exceptions
 GCS_PREFIX = 'gs://'
 LOCAL_PREFIX = 'local://'   # fake bucket scheme for hermetic tests
 
+# Cloud schemes this GCS-first build deliberately does NOT support
+# (SURVEY §2.10). ONE list: task-spec validation and the backend's
+# defense-in-depth check both import it, so they cannot drift.
+UNSUPPORTED_CLOUD_SCHEMES = ('s3://', 'r2://', 'cos://', 'azblob://')
+
 _BUCKET_NAME_RE = re.compile(r'^[a-z0-9][a-z0-9._-]{1,61}[a-z0-9]$')
 
 

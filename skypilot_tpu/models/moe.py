@@ -103,62 +103,83 @@ class MoEBlock(nn.Module):
 
     # ---------------- capacity-based dispatch ----------------
 
+    @staticmethod
+    def _group_size(g: int) -> int:
+        """Largest divisor of g that is ≤1024 and a power of two when
+        possible. Grouping bounds the one-hot dispatch/combine tensors to
+        num_groups × gs × E × C = G·gs·k·cf elements — LINEAR in total
+        tokens (ungrouped, C ≈ G·k/E makes them quadratic in G and OOMs
+        at exactly the batch·seq scales MoE targets; GShard/MaxText group
+        the same way)."""
+        gs = 1
+        while gs * 2 <= min(g, 1024) and g % (gs * 2) == 0:
+            gs *= 2
+        if gs == 1 and g <= 4096:
+            return g  # odd small token counts: one group
+        return gs
+
     def _dispatch(self, x, topk_idx, topk_probs, weights, dtype):
         cfg = self.cfg
         e, k = cfg.num_experts, cfg.experts_per_token
         w_gate, w_up, w_down = weights
         b, s, d = x.shape
         g = b * s  # tokens
-        # Per-expert capacity (static: shapes must not depend on routing).
-        capacity = int(-(-g * k // e) * cfg.moe_capacity_factor)
-        capacity = max(1, min(capacity, g))
+        gs = self._group_size(g)
+        n = g // gs  # groups
+        # Per-expert capacity PER GROUP (static: shapes must not depend
+        # on routing).
+        capacity = int(-(-gs * k // e) * cfg.moe_capacity_factor)
+        capacity = max(1, min(capacity, gs))
 
-        flat_idx = topk_idx.reshape(g, k)                     # (G,k)
-        flat_probs = topk_probs.reshape(g, k).astype(jnp.float32)
-        xf = x.reshape(g, d).astype(dtype)
+        flat_idx = topk_idx.reshape(n, gs, k)                  # (N,g,k)
+        flat_probs = topk_probs.reshape(n, gs, k).astype(jnp.float32)
+        xf = x.reshape(n, gs, d).astype(dtype)
 
-        # Position of each (token, choice) within its expert's buffer:
-        # running count of prior assignments to the same expert, priority
-        # by (choice rank, token order) — GShard's ordering.
+        # Position of each (token, choice) within its expert's per-group
+        # buffer: running count of prior assignments to the same expert,
+        # priority by (choice rank, token order) — GShard's ordering.
         choice_onehot = jax.nn.one_hot(flat_idx, e,
-                                       dtype=jnp.int32)       # (G,k,E)
+                                       dtype=jnp.int32)       # (N,g,k,E)
         # Flatten choices k-major so 1st choices beat 2nd choices.
-        seq_onehot = choice_onehot.transpose(1, 0, 2).reshape(k * g, e)
-        positions = jnp.cumsum(seq_onehot, axis=0) - seq_onehot
-        positions = jnp.sum(positions * seq_onehot, axis=-1)  # (k*G,)
-        positions = positions.reshape(k, g).transpose(1, 0)   # (G,k)
-        keep = positions < capacity                            # (G,k)
+        seq_onehot = choice_onehot.transpose(0, 2, 1, 3).reshape(
+            n, k * gs, e)
+        positions = jnp.cumsum(seq_onehot, axis=1) - seq_onehot
+        positions = jnp.sum(positions * seq_onehot, axis=-1)   # (N,k*g)
+        positions = positions.reshape(n, k, gs).transpose(0, 2, 1)
+        keep = positions < capacity                             # (N,g,k)
 
-        # dispatch[g, e, c] = 1 iff token g occupies slot c of expert e.
+        # dispatch[n,g,e,c] = 1 iff token (n,g) fills slot c of expert e.
         pos_onehot = jax.nn.one_hot(positions, capacity,
-                                    dtype=jnp.float32)        # (G,k,C)
+                                    dtype=jnp.float32)         # (N,g,k,C)
         dispatch = jnp.einsum(
-            'gke,gkc->gec',
+            'ngke,ngkc->ngec',
             choice_onehot.astype(jnp.float32) *
             keep[..., None].astype(jnp.float32),
-            pos_onehot)                                        # (G,E,C)
+            pos_onehot)                                         # (N,g,E,C)
         combine = jnp.einsum(
-            'gke,gkc,gk->gec',
+            'ngke,ngkc,ngk->ngec',
             choice_onehot.astype(jnp.float32),
             pos_onehot,
-            flat_probs * keep.astype(jnp.float32))             # (G,E,C)
+            flat_probs * keep.astype(jnp.float32))              # (N,g,E,C)
 
-        # Token-sharded → expert-sharded: this reshape IS the all-to-all
-        # under `ep` (GSPMD inserts it from the sharding constraints).
-        expert_in = jnp.einsum('gd,gec->ecd', xf,
-                               dispatch.astype(dtype))         # (E,C,D)
-        expert_in = sharding.constrain(expert_in, 'expert', None, None)
-        gate = jnp.einsum('ecd,edm->ecm', expert_in,
+        # Token-sharded → expert-sharded: this reshape IS the EP
+        # collective under `ep` (GSPMD inserts it from the constraints).
+        expert_in = jnp.einsum('ngd,ngec->encd', xf,
+                               dispatch.astype(dtype))          # (E,N,C,D)
+        expert_in = sharding.constrain(expert_in, 'expert', None, None,
+                                       None)
+        gate = jnp.einsum('encd,edm->encm', expert_in,
                           w_gate.astype(dtype))
-        up = jnp.einsum('ecd,edm->ecm', expert_in, w_up.astype(dtype))
-        h = nn.silu(gate) * up                                 # (E,C,M)
-        h = sharding.constrain(h, 'expert', None, 'mlp')
-        expert_out = jnp.einsum('ecm,emd->ecd', h,
-                                w_down.astype(dtype))          # (E,C,D)
-        expert_out = sharding.constrain(expert_out, 'expert', None, None)
-        # Expert-sharded → token-sharded (the return all-to-all), with
+        up = jnp.einsum('encd,edm->encm', expert_in, w_up.astype(dtype))
+        h = nn.silu(gate) * up                                  # (E,N,C,M)
+        h = sharding.constrain(h, 'expert', None, None, 'mlp')
+        expert_out = jnp.einsum('encm,emd->encd', h,
+                                w_down.astype(dtype))           # (E,N,C,D)
+        expert_out = sharding.constrain(expert_out, 'expert', None, None,
+                                        None)
+        # Expert-sharded → token-sharded (the return collective), with
         # the router probabilities applied in fp32.
-        out = jnp.einsum('ecd,gec->gd',
+        out = jnp.einsum('encd,ngec->ngd',
                          expert_out.astype(jnp.float32), combine)
         out = out.reshape(b, s, d).astype(dtype)
         return sharding.constrain(out, 'batch', 'seq', 'act_embed')

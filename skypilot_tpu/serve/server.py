@@ -155,6 +155,9 @@ def main(argv=None) -> int:
     parser.add_argument('--tokenizer', default='byte')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='Orbax checkpoint dir (train/run.py output).')
+    parser.add_argument('--num-slots', type=int, default=4,
+                        help='concurrent decode slots (continuous '
+                             'batching width)')
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -162,7 +165,8 @@ def main(argv=None) -> int:
     distributed.initialize()
     server = InferenceServer(args.model, max_seq_len=args.max_seq_len,
                              tokenizer=args.tokenizer,
-                             checkpoint_dir=args.checkpoint_dir)
+                             checkpoint_dir=args.checkpoint_dir,
+                             num_slots=args.num_slots)
     server.warmup()
     web.run_app(server.make_app(), host='0.0.0.0', port=args.port,
                 handle_signals=False)

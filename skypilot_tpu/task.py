@@ -195,9 +195,10 @@ class Task:
         """Unsupported cloud schemes fail at SPEC time — discovering it
         after a slice is provisioned (and billing) would be too late
         (GCS-first scope, SURVEY §2.10)."""
+        from skypilot_tpu.data import data_utils
         for dst, src in file_mounts.items():
             if isinstance(src, str) and src.startswith(
-                    ('s3://', 'r2://', 'cos://', 'azblob://')):
+                    data_utils.UNSUPPORTED_CLOUD_SCHEMES):
                 raise ValueError(
                     f'file_mounts[{dst!r}]: source {src!r} — only gs:// '
                     f'and local paths are supported in this build. '

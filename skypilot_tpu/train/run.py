@@ -30,6 +30,8 @@ def main(argv=None) -> int:
     parser.add_argument('--tp', type=int, default=None)
     parser.add_argument('--sp', type=int, default=None)
     parser.add_argument('--dp', type=int, default=None)
+    parser.add_argument('--ep', type=int, default=None,
+                        help='expert-parallel axis size (MoE models)')
     parser.add_argument('--log-every', type=int, default=10)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO,
@@ -51,7 +53,7 @@ def main(argv=None) -> int:
 
     # 2. Mesh over every chip in the job.
     mesh_cfg = infer_mesh_config(jax.device_count(), tp=args.tp,
-                                 sp=args.sp, dp=args.dp)
+                                 sp=args.sp, dp=args.dp, ep=args.ep)
     mesh = build_mesh(mesh_cfg)
     logger.info('mesh: %s', mesh_cfg)
 

@@ -73,7 +73,9 @@ def safe_status(message: str) -> Iterator:
             outer.update(prev)
         return
     if not _enabled():
-        print(message, flush=True)
+        # Progress chatter must not contaminate machine-parsed stdout
+        # (pipes/CI): stderr only.
+        print(message, file=sys.stderr, flush=True)
         yield None
         return
     spinner = _Spinner(message)

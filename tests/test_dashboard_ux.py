@@ -81,6 +81,10 @@ class TestDashboard:
         assert requests.get(base + '/api/services', timeout=5).json() == []
         assert requests.get(base + '/api/clusters', timeout=5).json() == []
 
+        metrics = requests.get(base + '/metrics', timeout=5).text
+        assert 'skytpu_managed_jobs{status="RUNNING"} 1' in metrics
+        assert '# TYPE skytpu_clusters gauge' in metrics
+
 
 class TestServeUpdateCli:
 

@@ -17,6 +17,8 @@ _EXAMPLES = sorted(
               recursive=True))
 _PIPELINES = [p for p in _EXAMPLES if 'pipeline' in p]
 _SINGLE = [p for p in _EXAMPLES if p not in _PIPELINES]
+_LLM = sorted(
+    glob.glob(os.path.join(_REPO, 'llm', '**', '*.yaml'), recursive=True))
 
 
 @pytest.fixture(autouse=True)
@@ -45,6 +47,64 @@ def test_pipeline_example_parses(path):
     dag = dag_utils.load_chain_dag_from_yaml(path)
     assert len(dag.tasks) == 2
     assert dag.is_chain()
+
+
+def test_llm_recipes_exist():
+    """The BASELINE.json acceptance recipes (llm/ tree)."""
+    names = {os.path.relpath(p, _REPO) for p in _LLM}
+    assert 'llm/llama-3_1-finetuning/sft.yaml' in names
+    assert 'llm/jetstream/serve.yaml' in names
+    assert 'llm/mixtral/train.yaml' in names
+    assert 'llm/gpt-2/pretrain.yaml' in names
+
+
+@pytest.mark.parametrize('path', _LLM, ids=lambda p: os.path.relpath(
+    p, _REPO))
+def test_llm_recipe_parses_and_optimizes(path):
+    task = sky.Task.from_yaml(path, env_overrides={'BUCKET': 'test-bkt'})
+    assert task.run is not None
+    dag = sky.Dag()
+    dag.add(task)
+    sky.optimize(dag, quiet=True)
+    assert task.best_resources() is not None
+
+
+def test_glue_imdb_app_learns(tmp_path):
+    """The sentiment fine-tune example actually trains (CPU, synthetic
+    fallback corpus)."""
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               PYTHONPATH=_REPO + os.pathsep +
+               os.environ.get('PYTHONPATH', ''))
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, 'examples', 'glue_imdb_finetune.py'),
+         '--steps', '25', '--examples', '128', '--batch', '16'],
+        capture_output=True, text=True, timeout=420, env=env, check=False)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert 'held-out accuracy' in proc.stdout
+
+
+def test_resnet_dp_example_runs(tmp_path):
+    """Flax ResNet-50 DP example runs sharded over the 8-device CPU
+    mesh (tiny images to keep CI fast)."""
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               XLA_FLAGS='--xla_force_host_platform_device_count=8',
+               PYTHONPATH=_REPO + os.pathsep +
+               os.environ.get('PYTHONPATH', ''))
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, 'examples', 'resnet', 'resnet_flax.py'),
+         '--steps', '2', '--per-chip-batch', '2', '--image-size', '64'],
+        capture_output=True, text=True, timeout=420, env=env, check=False)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert '8 chips' in proc.stdout
+    assert 'images/sec' in proc.stdout
 
 
 def test_mnist_example_trains(tmp_path):
