@@ -400,11 +400,14 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
             return
         head = handle.host_records()[0]
         runner = handle._make_runner(head)  # pylint: disable=protected-access
+        import shlex
+        provider_config = shlex.quote(json.dumps(handle.provider_config()))
         runner.run(
             wheel_utils.RUNTIME_PY_RESOLVER +
             'nohup "$_SKYPY" -m skypilot_tpu.agent.agent '
-            f'--cluster {handle.cluster_name} '
+            f'--cluster-name {handle.cluster_name} '
             f'--provider {handle.cluster_info.provider_name} '
+            f'--provider-config {provider_config} '
             '>> "${SKYTPU_HOME:-$HOME/.skytpu}/agent.log" 2>&1 '
             '< /dev/null & disown || true',
             stream_logs=False)

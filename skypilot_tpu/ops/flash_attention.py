@@ -378,8 +378,12 @@ def flash_attention(q: jax.Array,
     block_k = min(block_k, s)
     if impl == 'auto':
         on_tpu = any(dev.platform == 'tpu' for dev in jax.devices())
+        # Mosaic requires 128-aligned slices in the lane dimension: short
+        # sequences (clamped blocks < 128) fall back to XLA — they are
+        # tiny anyway (e.g. the 8-token shape used to init engines).
         tiles = (s % block_q == 0 and s % block_k == 0 and
-                 d in (64, 128, 256))
+                 d in (64, 128, 256) and
+                 block_q % 128 == 0 and block_k % 128 == 0)
         impl = 'pallas' if (on_tpu and tiles) else 'xla'
     if impl == 'xla':
         n_rep = h // k.shape[2]

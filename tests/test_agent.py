@@ -237,3 +237,41 @@ class TestCodegen:
         queue = codegen.run_on_head(
             runner, codegen.JobCodeGen.get_job_queue(None, True))
         assert queue[0]['job_name'] == 'j'
+
+
+class TestAgentDaemonStart:
+
+    def test_backend_starts_agent_on_head(self, _isolate_state,
+                                          monkeypatch):
+        """SKYTPU_START_AGENT=1: provisioning launches the agent daemon on
+        the head host with the full provider config (autostop from the
+        inside needs it), and it heartbeats."""
+        import signal
+        from skypilot_tpu import execution, global_user_state
+        import skypilot_tpu as sky
+        global_user_state.set_enabled_clouds(['fake'])
+        monkeypatch.setenv('SKYTPU_START_AGENT', '1')
+        task = sky.Task(name='ag', run='echo hi')
+        task.set_resources(
+            {sky.Resources(cloud='fake', accelerators='tpu-v5e-1')})
+        _, handle = execution.launch(task, cluster_name='agc',
+                                     detach_run=True, stream_logs=False,
+                                     quiet_optimizer=True)
+        head_home = handle.host_records()[0]['home']
+        pid_file = os.path.join(head_home, 'agent.pid')
+        hb_file = os.path.join(head_home, 'agent.heartbeat')
+        deadline = time.time() + 30
+        while time.time() < deadline and not os.path.exists(hb_file):
+            time.sleep(0.3)
+        try:
+            assert os.path.exists(pid_file), 'agent.pid missing'
+            assert os.path.exists(hb_file), 'agent heartbeat missing'
+        finally:
+            if os.path.exists(pid_file):
+                with open(pid_file, encoding='utf-8') as f:
+                    try:
+                        os.kill(int(f.read().strip()), signal.SIGKILL)
+                    except (OSError, ValueError):
+                        pass
+            from skypilot_tpu import core
+            core.down('agc', purge=True)
