@@ -1,0 +1,51 @@
+"""Hyperparameter grid search over one cluster's job queue.
+
+TPU-native rewrite of the reference's grid-search app
+(examples/huggingface_glue_imdb_grid_search_app.py: N `sky exec` jobs with
+different learning rates sharing one cluster). Same idiom here: launch the
+cluster once, then `exec` a detached job per grid point — the agent's FIFO
+queue runs them back to back while the slice stays provisioned, so the
+grid pays provisioning once.
+
+    python3 examples/grid_search.py                    # real launch
+    python3 examples/grid_search.py --dryrun           # plan only
+"""
+from __future__ import annotations
+
+import argparse
+
+import skypilot_tpu as sky
+
+LRS = (1e-4, 3e-4, 1e-3)
+STEPS = 100
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--cluster', default='grid')
+    parser.add_argument('--dryrun', action='store_true')
+    args = parser.parse_args()
+
+    base = sky.Task(
+        name='grid-setup',
+        run='echo cluster ready',
+    )
+    base.set_resources(sky.Resources(accelerators='tpu-v5e-1'))
+    sky.launch(base, cluster_name=args.cluster, dryrun=args.dryrun)
+
+    for lr in LRS:
+        job = sky.Task(
+            name=f'lr-{lr:g}',
+            run=(f'python3 -m skypilot_tpu.train.run --model test-tiny '
+                 f'--learning-rate {lr:g} --steps {STEPS} --batch 8 '
+                 f'--seq 128'),
+        )
+        job.set_resources(sky.Resources(accelerators='tpu-v5e-1'))
+        if not args.dryrun:
+            sky.exec(job, cluster_name=args.cluster, detach_run=True)
+            print(f'queued lr={lr:g}')
+    print(f'grid queued; watch with: skytpu queue {args.cluster}')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,50 @@
+"""Tokenize text into SKYTOK shards for `train.run --data-dir`.
+
+The llm.c-style data prep step (reference: llm/gpt-2 uses fineweb tokens).
+Uses the GPT-2 BPE via `transformers` when installed; otherwise falls back
+to byte-level tokens (ids 0-255) so the pipeline works hermetically.
+
+    python3 llm/gpt-2/prepare_data.py --input corpus.txt --out data/
+    python3 -m skypilot_tpu.train.run --model gpt2-124m --data-dir data/
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from skypilot_tpu.train.data import write_token_shard
+
+
+def _tokenize(text: str) -> np.ndarray:
+    try:
+        from transformers import GPT2TokenizerFast  # type: ignore
+        tok = GPT2TokenizerFast.from_pretrained('gpt2')
+        return np.asarray(tok(text)['input_ids'], dtype=np.uint32)
+    except Exception:  # pylint: disable=broad-except
+        return np.frombuffer(text.encode('utf-8'),
+                             dtype=np.uint8).astype(np.uint16)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--input', required=True, help='UTF-8 text file')
+    parser.add_argument('--out', required=True, help='shard directory')
+    parser.add_argument('--shard-tokens', type=int, default=10_000_000)
+    args = parser.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    with open(args.input, encoding='utf-8') as f:
+        tokens = _tokenize(f.read())
+    n = 0
+    for i in range(0, len(tokens), args.shard_tokens):
+        path = os.path.join(args.out, f'shard_{n:05d}.bin')
+        write_token_shard(path, tokens[i:i + args.shard_tokens])
+        print(f'{path}: {min(args.shard_tokens, len(tokens) - i)} tokens')
+        n += 1
+    print(f'{len(tokens)} tokens in {n} shard(s) -> {args.out}')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -2,8 +2,13 @@
 
 The recipe tree (llm/) references these by name, the way the reference's
 recipes name HF checkpoints (reference: llm/llama-3_1-finetuning,
-llm/mixtral per BASELINE.json). Architecture is Llama-3-style decoder-only
-(RMSNorm, RoPE, GQA, SwiGLU), with optional MoE (Mixtral-style) switched by
+llm/mixtral, llm/gemma, llm/qwen, llm/gpt-2 per Appendix A of SURVEY.md).
+The base architecture is Llama-3-style decoder-only (RMSNorm, RoPE, GQA,
+SwiGLU); the family knobs below compose to express the other families the
+reference's recipe tree serves — Gemma ((1+w)-RMSNorm, GeGLU, embedding
+scaling, tied unembed, 256-wide heads), Gemma-2 (attention/final logit
+softcaps), Qwen2 (QKV bias), GPT-2 (LayerNorm, learned positions, plain
+GELU MLP, biases everywhere) — and MoE (Mixtral-style) is switched by
 ``num_experts``.
 """
 from __future__ import annotations
@@ -24,6 +29,28 @@ class ModelConfig:
     max_seq_len: int = 2048
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
+    # --- Architecture-family knobs (compose; Llama-3 is all-defaults) ---
+    # Gemma fixes head_dim=256 independent of d_model/num_heads.
+    head_dim_override: Optional[int] = None
+    # GLU gate activation ('silu' = SwiGLU/Llama, 'gelu' = GeGLU/Gemma).
+    mlp_activation: str = 'silu'
+    # 'glu' = gate/up/down (3 matmuls); 'plain' = up/down (GPT-2).
+    mlp_style: str = 'glu'
+    # 'rms' (Llama), 'rms_plus1' (Gemma: out = normed·(1+w)),
+    # 'layernorm' (GPT-2: mean-centred, scale+bias).
+    norm_style: str = 'rms'
+    # 'rope' | 'learned' (GPT-2 absolute position table).
+    pos_embedding: str = 'rope'
+    qkv_bias: bool = False            # Qwen2 (and GPT-2)
+    o_bias: bool = False              # GPT-2
+    mlp_bias: bool = False            # GPT-2
+    tie_embeddings: bool = False      # Gemma, GPT-2: unembed = embedᵀ
+    scale_embed_by_dim: bool = False  # Gemma: x ·= sqrt(d_model)
+    # Gemma-2 logit softcaps (0 ⇒ off). Softcapped attention runs on the
+    # XLA path (tanh fuses into the fwd matmul); the pallas kernel rejects
+    # it explicitly rather than silently dropping the cap.
+    attn_logit_softcap: float = 0.0
+    final_logit_softcap: float = 0.0
     # MoE (0 ⇒ dense SwiGLU MLP).
     num_experts: int = 0
     experts_per_token: int = 2
@@ -55,27 +82,40 @@ class ModelConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.num_heads
+        return self.head_dim_override or self.d_model // self.num_heads
 
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
 
     def num_params(self) -> int:
-        """Parameter count (embedding counted once; unembed untied)."""
-        embed = self.vocab_size * self.d_model * 2
+        """Parameter count (tied unembed counted once; biases included)."""
+        embed = self.vocab_size * self.d_model * \
+            (1 if self.tie_embeddings else 2)
+        if self.pos_embedding == 'learned':
+            embed += self.max_seq_len * self.d_model
         attn = (self.d_model * self.num_heads * self.head_dim +        # q
                 2 * self.d_model * self.num_kv_heads * self.head_dim +  # k,v
                 self.num_heads * self.head_dim * self.d_model)          # o
+        if self.qkv_bias:
+            attn += (self.num_heads + 2 * self.num_kv_heads) * \
+                self.head_dim
+        if self.o_bias:
+            attn += self.d_model
+        mlp_mats = 3 if self.mlp_style == 'glu' else 2
         if self.is_moe:
-            mlp = self.num_experts * 3 * self.d_model * self.d_mlp
+            mlp = self.num_experts * mlp_mats * self.d_model * self.d_mlp
             router = self.d_model * self.num_experts
         else:
-            mlp = 3 * self.d_model * self.d_mlp
+            mlp = mlp_mats * self.d_model * self.d_mlp
             router = 0
-        norms = 2 * self.d_model
+        if self.mlp_bias:
+            mlp += (mlp_mats - 1) * self.d_mlp + self.d_model
+        norm_params = (2 if self.norm_style == 'layernorm' else 1) * \
+            self.d_model
+        norms = 2 * norm_params
         per_layer = attn + mlp + router + norms
-        return embed + self.num_layers * per_layer + self.d_model
+        return embed + self.num_layers * per_layer + norm_params
 
     def flops_per_token(self, seq_len: Optional[int] = None) -> float:
         """Training FLOPs/token (fwd+bwd ≈ 6 × params-matmul + attention
@@ -93,6 +133,10 @@ class ModelConfig:
                              self.num_layers * active_per_layer)
         else:
             matmul_params = self.num_params()
+            if self.tie_embeddings:
+                # The unembed matmul still burns FLOPs even though its
+                # weights are counted once in num_params.
+                matmul_params += self.vocab_size * self.d_model
         # causal attention: 12 * L * d * s * 0.5
         attn_flops = 6 * self.num_layers * self.d_model * seq_len
         return 6.0 * matmul_params + attn_flops
@@ -136,6 +180,62 @@ MIXTRAL_8X7B = _register(ModelConfig(
     name='mixtral-8x7b', vocab_size=32000, d_model=4096, num_layers=32,
     num_heads=32, num_kv_heads=8, d_mlp=14336, max_seq_len=8192,
     rope_theta=1e6, num_experts=8, experts_per_token=2))
+
+# --- Gemma family (reference recipe: llm/gemma). (1+w)-RMSNorm, GeGLU,
+# sqrt(d)-scaled embeddings, tied unembed, 256-wide heads, rope 10k.
+GEMMA_2B = _register(ModelConfig(
+    name='gemma-2b', vocab_size=256128, d_model=2048, num_layers=18,
+    num_heads=8, num_kv_heads=1, d_mlp=16384, max_seq_len=8192,
+    rope_theta=10000.0, norm_eps=1e-6, head_dim_override=256,
+    mlp_activation='gelu', norm_style='rms_plus1', tie_embeddings=True,
+    scale_embed_by_dim=True))
+
+GEMMA_7B = _register(ModelConfig(
+    name='gemma-7b', vocab_size=256128, d_model=3072, num_layers=28,
+    num_heads=16, num_kv_heads=16, d_mlp=24576, max_seq_len=8192,
+    rope_theta=10000.0, norm_eps=1e-6, head_dim_override=256,
+    mlp_activation='gelu', norm_style='rms_plus1', tie_embeddings=True,
+    scale_embed_by_dim=True))
+
+# Gemma-2 adds attention/final logit softcaps (tanh-capped on the XLA
+# attention path; Gemma-2's interleaved sliding-window layers are not
+# modeled — full causal attention everywhere, a strict superset window).
+GEMMA2_9B = _register(ModelConfig(
+    name='gemma2-9b', vocab_size=256128, d_model=3584, num_layers=42,
+    num_heads=16, num_kv_heads=8, d_mlp=14336, max_seq_len=8192,
+    rope_theta=10000.0, norm_eps=1e-6, head_dim_override=256,
+    mlp_activation='gelu', norm_style='rms_plus1', tie_embeddings=True,
+    scale_embed_by_dim=True, attn_logit_softcap=50.0,
+    final_logit_softcap=30.0, attention_impl='xla'))
+
+# --- Qwen2 family (reference recipe: llm/qwen): Llama shape + QKV bias.
+QWEN2_7B = _register(ModelConfig(
+    name='qwen2-7b', vocab_size=152064, d_model=3584, num_layers=28,
+    num_heads=28, num_kv_heads=4, d_mlp=18944, max_seq_len=8192,
+    rope_theta=1e6, norm_eps=1e-6, qkv_bias=True))
+
+QWEN2_72B = _register(ModelConfig(
+    name='qwen2-72b', vocab_size=152064, d_model=8192, num_layers=80,
+    num_heads=64, num_kv_heads=8, d_mlp=29568, max_seq_len=8192,
+    rope_theta=1e6, norm_eps=1e-6, qkv_bias=True))
+
+# --- GPT-2 (reference recipe: llm/gpt-2, llm.c pretrain): LayerNorm,
+# learned positions, plain GELU MLP, biases, tied unembed. Vocab padded
+# 50257 → 50304 (×128) so the unembed matmul tiles the MXU cleanly, the
+# same padding llm.c applies.
+GPT2_124M = _register(ModelConfig(
+    name='gpt2-124m', vocab_size=50304, d_model=768, num_layers=12,
+    num_heads=12, num_kv_heads=12, d_mlp=3072, max_seq_len=1024,
+    mlp_activation='gelu', mlp_style='plain', norm_style='layernorm',
+    pos_embedding='learned', qkv_bias=True, o_bias=True, mlp_bias=True,
+    tie_embeddings=True))
+
+GPT2_1_5B = _register(ModelConfig(
+    name='gpt2-1.5b', vocab_size=50304, d_model=1600, num_layers=48,
+    num_heads=25, num_kv_heads=25, d_mlp=6400, max_seq_len=1024,
+    mlp_activation='gelu', mlp_style='plain', norm_style='layernorm',
+    pos_embedding='learned', qkv_bias=True, o_bias=True, mlp_bias=True,
+    tie_embeddings=True))
 
 
 def get_config(name: str, **overrides) -> ModelConfig:

@@ -40,18 +40,38 @@ def _param_dtype(cfg: ModelConfig) -> Dtype:
 
 
 class RMSNorm(nn.Module):
+    """Pre-norm in the family's dialect: 'rms' (Llama), 'rms_plus1'
+    (Gemma — the stored weight is a delta from 1), 'layernorm' (GPT-2 —
+    mean-centred with a bias). Statistics in fp32 regardless of compute
+    dtype."""
     cfg: ModelConfig
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        init = (nn.initializers.zeros if cfg.norm_style == 'rms_plus1'
+                else nn.initializers.ones)
         scale = self.param(
             'scale',
-            nn.with_logical_partitioning(nn.initializers.ones, ('embed',)),
-            (x.shape[-1],), _param_dtype(self.cfg))
+            nn.with_logical_partitioning(init, ('embed',)),
+            (x.shape[-1],), _param_dtype(cfg))
         x32 = x.astype(jnp.float32)
+        if cfg.norm_style == 'layernorm':
+            x32 = x32 - jnp.mean(x32, axis=-1, keepdims=True)
         var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
-        normed = x32 * jax.lax.rsqrt(var + self.cfg.norm_eps)
-        return (normed * scale.astype(jnp.float32)).astype(_dtype(self.cfg))
+        normed = x32 * jax.lax.rsqrt(var + cfg.norm_eps)
+        w = scale.astype(jnp.float32)
+        if cfg.norm_style == 'rms_plus1':
+            w = 1.0 + w
+        out = normed * w
+        if cfg.norm_style == 'layernorm':
+            bias = self.param(
+                'bias',
+                nn.with_logical_partitioning(nn.initializers.zeros,
+                                             ('embed',)),
+                (x.shape[-1],), _param_dtype(cfg))
+            out = out + bias.astype(jnp.float32)
+        return out.astype(_dtype(cfg))
 
 
 def apply_rope(x: jax.Array, positions: jax.Array,
@@ -76,10 +96,12 @@ class Attention(nn.Module):
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         cfg = self.cfg
         dense = lambda feats, axes, name: nn.DenseGeneral(
-            features=feats, axis=-1, use_bias=False, dtype=_dtype(cfg),
-            param_dtype=_param_dtype(cfg),
+            features=feats, axis=-1, use_bias=cfg.qkv_bias,
+            dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), axes),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, axes[1:]),
             name=name)
         q = dense((cfg.num_heads, cfg.head_dim),
                   ('embed', 'heads', 'qkv_dim'), 'q_proj')(x)
@@ -90,19 +112,23 @@ class Attention(nn.Module):
         q = sharding.constrain(q, 'batch', 'seq', 'act_heads', None)
         k = sharding.constrain(k, 'batch', 'seq', 'act_heads', None)
         v = sharding.constrain(v, 'batch', 'seq', 'act_heads', None)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        if cfg.pos_embedding == 'rope':
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k = apply_rope(k, positions, cfg.rope_theta)
         if cfg.decode:
             out = self._decode_attention(q, k, v, positions)
         else:
             out = flash_attention(q, k, v, causal=True,
-                                  impl=cfg.attention_impl)
+                                  impl=cfg.attention_impl,
+                                  logit_softcap=cfg.attn_logit_softcap)
         out = nn.DenseGeneral(
-            features=cfg.d_model, axis=(-2, -1), use_bias=False,
+            features=cfg.d_model, axis=(-2, -1), use_bias=cfg.o_bias,
             dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(),
                 ('heads', 'qkv_dim', 'embed')),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ('embed',)),
             name='o_proj')(out)
         return sharding.constrain(out, 'batch', 'seq', 'act_embed')
 
@@ -179,6 +205,9 @@ class Attention(nn.Module):
         scores = jnp.einsum('bqkrd,bskd->bkrqs', q_grouped, key_arr,
                             preferred_element_type=jnp.float32)
         scores = scores * (cfg.head_dim**-0.5)
+        if cfg.attn_logit_softcap:
+            cap = cfg.attn_logit_softcap
+            scores = cap * jnp.tanh(scores / cap)
         q_pos = positions[:, :, None]                          # (b, q, 1)
         k_pos = jnp.arange(cfg.max_seq_len)[None, None, :]     # (1, 1, s)
         mask = k_pos <= q_pos                                  # causal+fill
@@ -189,20 +218,30 @@ class Attention(nn.Module):
 
 
 class SwiGLU(nn.Module):
+    """Feed-forward in the family's dialect: GLU (gate·act × up → down;
+    silu = Llama SwiGLU, gelu = Gemma GeGLU) or 'plain' (up → act → down;
+    GPT-2), with optional biases (GPT-2)."""
     cfg: ModelConfig
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
+        act = nn.silu if cfg.mlp_activation == 'silu' else (
+            lambda y: nn.gelu(y, approximate=True))
         dense = lambda feats, axes, name: nn.DenseGeneral(
-            features=feats, axis=-1, use_bias=False, dtype=_dtype(cfg),
-            param_dtype=_param_dtype(cfg),
+            features=feats, axis=-1, use_bias=cfg.mlp_bias,
+            dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), axes),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, axes[1:]),
             name=name)
-        gate = dense(cfg.d_mlp, ('embed', 'mlp'), 'gate_proj')(x)
         up = dense(cfg.d_mlp, ('embed', 'mlp'), 'up_proj')(x)
-        h = nn.silu(gate) * up
+        if cfg.mlp_style == 'glu':
+            gate = dense(cfg.d_mlp, ('embed', 'mlp'), 'gate_proj')(x)
+            h = act(gate) * up
+        else:
+            h = act(up)
         h = sharding.constrain(h, 'batch', 'seq', 'mlp')
         out = dense(cfg.d_model, ('mlp', 'embed'), 'down_proj')(h)
         return sharding.constrain(out, 'batch', 'seq', 'act_embed')
@@ -249,12 +288,29 @@ class Transformer(nn.Module):
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :],
                 tokens.shape)
-        x = nn.Embed(
+        # Tied models reuse this table as the unembed projection: init at
+        # d^-1/2 so step-0 logits land at O(1) (and the Gemma sqrt(d)
+        # input scaling restores O(1) activations). Untied keeps the
+        # historical stddev=1 (checkpoint/loss-curve compatibility).
+        embed_std = cfg.d_model**-0.5 if cfg.tie_embeddings else 1.0
+        embed = nn.Embed(
             num_embeddings=cfg.vocab_size, features=cfg.d_model,
             dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
             embedding_init=nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=1.0), ('vocab', 'embed')),
-            name='embed')(tokens)
+                nn.initializers.normal(stddev=embed_std),
+                ('vocab', 'embed')),
+            name='embed')
+        x = embed(tokens)
+        if cfg.scale_embed_by_dim:
+            x = x * jnp.asarray(cfg.d_model**0.5, dtype=x.dtype)
+        if cfg.pos_embedding == 'learned':
+            x = x + nn.Embed(
+                num_embeddings=cfg.max_seq_len, features=cfg.d_model,
+                dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
+                embedding_init=nn.with_logical_partitioning(
+                    nn.initializers.normal(stddev=0.02),
+                    (None, 'embed')),
+                name='pos_embed')(positions)
         x = sharding.constrain(x, 'batch', 'seq', 'act_embed')
 
         if cfg.scan_layers:
@@ -283,10 +339,17 @@ class Transformer(nn.Module):
                 x = layer_ctor(cfg, name=f'layer_{i}')(x, positions)
 
         x = RMSNorm(cfg, name='final_norm')(x)
-        logits = nn.DenseGeneral(
-            features=cfg.vocab_size, axis=-1, use_bias=False,
-            dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), ('embed', 'vocab')),
-            name='lm_head')(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x)
+        else:
+            logits = nn.DenseGeneral(
+                features=cfg.vocab_size, axis=-1, use_bias=False,
+                dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ('embed', 'vocab')),
+                name='lm_head')(x)
+        if cfg.final_logit_softcap:
+            cap = cfg.final_logit_softcap
+            logits = (cap * jnp.tanh(
+                logits.astype(jnp.float32) / cap)).astype(logits.dtype)
         return sharding.constrain(logits, 'batch', 'seq', 'vocab')

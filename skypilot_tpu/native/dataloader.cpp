@@ -1,0 +1,289 @@
+// Native token-shard data loader.
+//
+// The TPU input pipeline's job is to keep the chips from ever waiting on
+// the host: batches must be ready the moment the previous step's donation
+// frees the buffer. The reference delegates input pipelines to the
+// frameworks it launches (torch DataLoader workers / tf.data inside
+// MaxText); here the loader is in-tree and native — a C++ prefetch thread
+// mmaps the token shards and assembles batches into a ring buffer with no
+// GIL on the hot path, so Python only ever does a memcpy-and-go
+// (train/data.py wraps this via ctypes, with a numpy fallback when no
+// compiler is available — same pattern as native/logmux.cpp).
+//
+// Shard format ("SKYTOK1\0", written by train/data.py:write_token_shard):
+//   char[8]  magic "SKYTOK1\0"
+//   u32      version (1)
+//   u32      dtype code: 2 = uint16 tokens, 4 = uint32 tokens
+//   u64      token count
+//   payload  count tokens, little-endian
+//
+// Sampling model: the shard list is one logical token stream; each
+// training window is (seq+1) consecutive tokens (windows never straddle
+// shards). Host-sharding takes windows where index % stride ==
+// stride_offset, so N hosts see disjoint data with no coordination.
+// "Shuffle" walks the window space by an affine map idx -> (a*i + b) mod
+// n_windows with gcd(a, n_windows) = 1: full coverage per epoch,
+// deterministic for resume, no permutation table in memory.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'S', 'K', 'Y', 'T', 'O', 'K', '1', '\0'};
+constexpr int kRingCapacity = 8;
+
+struct Shard {
+  const uint8_t* data = nullptr;   // mmap base
+  size_t map_len = 0;
+  const uint8_t* tokens = nullptr; // payload start
+  uint64_t count = 0;
+  uint32_t dtype = 0;              // 2 or 4 (bytes per token)
+  uint64_t first_window = 0;       // global index of this shard's window 0
+};
+
+struct Loader {
+  std::vector<Shard> shards;
+  int batch = 0;
+  int window = 0;                  // seq + 1 tokens per sample
+  uint64_t num_windows = 0;        // across all shards
+  // Host sharding.
+  uint64_t stride = 1;
+  uint64_t stride_offset = 0;
+  uint64_t my_windows = 0;         // windows this host owns
+  // Affine shuffle over [0, my_windows).
+  uint64_t mul = 1;
+  uint64_t add = 0;
+  // Cursor (batch counter; each batch consumes `batch` windows).
+  uint64_t cursor = 0;
+  // Prefetch ring.
+  std::vector<std::vector<uint32_t>> ring;
+  std::vector<int> ring_flag;      // 1 = full
+  std::vector<uint64_t> ring_epoch_wrap;
+  size_t head = 0, tail = 0;
+  std::mutex mu;
+  std::condition_variable cv_full, cv_empty;
+  std::thread producer;
+  std::atomic<bool> stop{false};
+  std::string error;
+};
+
+uint64_t gcd64(uint64_t a, uint64_t b) {
+  while (b) { uint64_t t = a % b; a = b; b = t; }
+  return a;
+}
+
+bool map_shard(const char* path, int window, Shard* out,
+               std::string* err) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) { *err = std::string("open failed: ") + path; return false; }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 24) {
+    ::close(fd);
+    *err = std::string("bad shard (too small): ") + path;
+    return false;
+  }
+  void* base = ::mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);
+  if (base == MAP_FAILED) {
+    *err = std::string("mmap failed: ") + path;
+    return false;
+  }
+  const uint8_t* p = static_cast<const uint8_t*>(base);
+  if (memcmp(p, kMagic, 8) != 0) {
+    ::munmap(base, st.st_size);
+    *err = std::string("bad magic: ") + path;
+    return false;
+  }
+  uint32_t version, dtype;
+  uint64_t count;
+  memcpy(&version, p + 8, 4);
+  memcpy(&dtype, p + 12, 4);
+  memcpy(&count, p + 16, 8);
+  if (version != 1 || (dtype != 2 && dtype != 4)) {
+    ::munmap(base, st.st_size);
+    *err = std::string("bad header: ") + path;
+    return false;
+  }
+  if (static_cast<uint64_t>(st.st_size) < 24 + count * dtype) {
+    ::munmap(base, st.st_size);
+    *err = std::string("truncated shard: ") + path;
+    return false;
+  }
+  out->data = p;
+  out->map_len = st.st_size;
+  out->tokens = p + 24;
+  out->count = count;
+  out->dtype = dtype;
+  (void)window;
+  return true;
+}
+
+// Copy window w (global index) into dst as uint32.
+void read_window(const Loader& L, uint64_t w, uint32_t* dst) {
+  // Find the owning shard (shard lists are short; linear scan).
+  size_t lo = 0;
+  for (size_t i = 0; i < L.shards.size(); ++i) {
+    uint64_t next_first = (i + 1 < L.shards.size())
+                              ? L.shards[i + 1].first_window
+                              : L.num_windows;
+    if (w >= L.shards[i].first_window && w < next_first) { lo = i; break; }
+  }
+  const Shard* s = &L.shards[lo];
+  uint64_t local = w - s->first_window;
+  uint64_t start = local * (L.window - 1);  // stride seq, overlap 1
+  if (s->dtype == 4) {
+    memcpy(dst, s->tokens + start * 4, static_cast<size_t>(L.window) * 4);
+  } else {
+    const uint16_t* src =
+        reinterpret_cast<const uint16_t*>(s->tokens) + start;
+    for (int i = 0; i < L.window; ++i) dst[i] = src[i];
+  }
+}
+
+void producer_loop(Loader* L) {
+  const uint64_t batch_count = L->my_windows / L->batch;  // per epoch
+  while (!L->stop.load(std::memory_order_relaxed)) {
+    // Assemble the next batch outside the lock.
+    std::vector<uint32_t> buf(static_cast<size_t>(L->batch) * L->window);
+    uint64_t b = L->cursor++;
+    uint64_t epoch = batch_count ? b / batch_count : 0;
+    uint64_t wrapped = batch_count ? (b % batch_count == 0 && b > 0) : 0;
+    for (int i = 0; i < L->batch; ++i) {
+      uint64_t k = batch_count
+                       ? (b % batch_count) * L->batch + i
+                       : i;
+      // Affine walk varies per epoch so repeats reorder.
+      uint64_t j = (L->mul * k + L->add + epoch * 7919) % L->my_windows;
+      uint64_t global = L->stride_offset + j * L->stride;
+      read_window(*L, global, buf.data() +
+                                   static_cast<size_t>(i) * L->window);
+    }
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_empty.wait(lk, [L] {
+      return L->stop.load(std::memory_order_relaxed) ||
+             !L->ring_flag[L->head];
+    });
+    if (L->stop.load(std::memory_order_relaxed)) return;
+    L->ring[L->head] = std::move(buf);
+    L->ring_flag[L->head] = 1;
+    L->ring_epoch_wrap[L->head] = wrapped;
+    L->head = (L->head + 1) % kRingCapacity;
+    L->cv_full.notify_one();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle, or null (check dl_last_error via errno-less
+// contract: callers pass an error buffer).
+void* dl_open(const char** paths, int n_paths, int batch, int seq,
+              long long stride_offset, long long stride,
+              unsigned long long seed, long long start_batch,
+              char* err_buf, int err_len) {
+  auto fail = [&](const std::string& msg) -> void* {
+    if (err_buf && err_len > 0) {
+      strncpy(err_buf, msg.c_str(), err_len - 1);
+      err_buf[err_len - 1] = '\0';
+    }
+    return nullptr;
+  };
+  if (n_paths <= 0 || batch <= 0 || seq <= 0 || stride <= 0 ||
+      stride_offset < 0 || stride_offset >= stride || start_batch < 0)
+    return fail("invalid arguments");
+  auto* L = new Loader();
+  L->cursor = start_batch;  // resume: skip already-consumed batches
+  L->batch = batch;
+  L->window = seq + 1;
+  L->stride = stride;
+  L->stride_offset = stride_offset;
+  uint64_t acc = 0;
+  for (int i = 0; i < n_paths; ++i) {
+    Shard s;
+    std::string err;
+    if (!map_shard(paths[i], L->window, &s, &err)) {
+      for (auto& sh : L->shards)
+        ::munmap(const_cast<uint8_t*>(sh.data), sh.map_len);
+      delete L;
+      return fail(err);
+    }
+    s.first_window = acc;
+    uint64_t w = s.count >= static_cast<uint64_t>(L->window)
+                     ? (s.count - 1) / (L->window - 1)
+                     : 0;
+    acc += w;
+    L->shards.push_back(s);
+  }
+  L->num_windows = acc;
+  uint64_t mine =
+      acc > L->stride_offset
+          ? (acc - 1 - L->stride_offset) / L->stride + 1
+          : 0;
+  L->my_windows = mine;
+  if (mine < static_cast<uint64_t>(batch)) {
+    for (auto& sh : L->shards)
+      ::munmap(const_cast<uint8_t*>(sh.data), sh.map_len);
+    delete L;
+    return fail("not enough data: fewer windows than batch size");
+  }
+  // Pick a multiplier coprime with my_windows from the seed.
+  uint64_t a = (seed % mine) | 1;
+  while (gcd64(a, mine) != 1) a = (a + 2) % mine ? (a + 2) : 1;
+  L->mul = a == 0 ? 1 : a;
+  L->add = (seed / 3) % mine;
+  L->ring.resize(kRingCapacity);
+  L->ring_flag.assign(kRingCapacity, 0);
+  L->ring_epoch_wrap.assign(kRingCapacity, 0);
+  L->producer = std::thread(producer_loop, L);
+  return L;
+}
+
+long long dl_num_windows(void* h) {
+  return static_cast<Loader*>(h)->my_windows;
+}
+
+// Blocks until a batch is ready; copies batch*(seq+1) uint32 into out.
+// Returns 1 if this batch wrapped an epoch, 0 otherwise, -1 on error.
+int dl_next(void* h, uint32_t* out) {
+  auto* L = static_cast<Loader*>(h);
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->cv_full.wait(lk, [L] {
+    return L->stop.load(std::memory_order_relaxed) || L->ring_flag[L->tail];
+  });
+  if (L->stop.load(std::memory_order_relaxed)) return -1;
+  std::vector<uint32_t> buf = std::move(L->ring[L->tail]);
+  int wrapped = static_cast<int>(L->ring_epoch_wrap[L->tail]);
+  L->ring_flag[L->tail] = 0;
+  L->tail = (L->tail + 1) % kRingCapacity;
+  lk.unlock();
+  L->cv_empty.notify_one();
+  memcpy(out, buf.data(), buf.size() * 4);
+  return wrapped;
+}
+
+void dl_close(void* h) {
+  auto* L = static_cast<Loader*>(h);
+  L->stop.store(true);
+  L->cv_empty.notify_all();
+  L->cv_full.notify_all();
+  if (L->producer.joinable()) L->producer.join();
+  for (auto& sh : L->shards)
+    ::munmap(const_cast<uint8_t*>(
+                 const_cast<uint8_t*>(sh.data)), sh.map_len);
+  delete L;
+}
+
+}  // extern "C"

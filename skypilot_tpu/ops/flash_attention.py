@@ -37,11 +37,15 @@ DEFAULT_BLOCK_K = 256
 
 
 def _reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                         causal: bool, sm_scale: float) -> jax.Array:
+                         causal: bool, sm_scale: float,
+                         logit_softcap: float = 0.0) -> jax.Array:
     """Plain XLA attention; fp32 softmax. Shapes: (B, S, H, D)."""
     logits = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                         preferred_element_type=jnp.float32)
     logits = logits * sm_scale
+    if logit_softcap:
+        # Gemma-2 style tanh cap; XLA fuses this into the matmul epilogue.
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
     if causal:
         s_q, s_k = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), s_k - s_q)
@@ -356,7 +360,8 @@ def flash_attention(q: jax.Array,
                     sm_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    impl: str = 'auto') -> jax.Array:
+                    impl: str = 'auto',
+                    logit_softcap: float = 0.0) -> jax.Array:
     """Multi-head attention with GQA support.
 
     Args:
@@ -365,6 +370,9 @@ def flash_attention(q: jax.Array,
         multiple of num_kv_heads.
       impl: 'pallas' | 'xla' | 'auto' (pallas on TPU when shapes tile,
         xla otherwise).
+      logit_softcap: Gemma-2-style tanh cap on attention logits (0 = off).
+        Supported on the XLA path only; 'auto' routes capped attention to
+        XLA, explicit 'pallas'/'ring' reject it.
     """
     b, s, h, d = q.shape
     if sm_scale is None:
@@ -384,11 +392,17 @@ def flash_attention(q: jax.Array,
         tiles = (s % block_q == 0 and s % block_k == 0 and
                  d in (64, 128, 256) and
                  block_q % 128 == 0 and block_k % 128 == 0)
-        impl = 'pallas' if (on_tpu and tiles) else 'xla'
+        impl = 'pallas' if (on_tpu and tiles and
+                            not logit_softcap) else 'xla'
     if impl == 'xla':
         n_rep = h // k.shape[2]
         return _reference_attention(q, _repeat_kv(k, n_rep),
-                                    _repeat_kv(v, n_rep), causal, sm_scale)
+                                    _repeat_kv(v, n_rep), causal, sm_scale,
+                                    logit_softcap)
+    if logit_softcap:
+        raise ValueError(
+            f'logit_softcap is only supported on the XLA attention path '
+            f'(got impl={impl!r}); use attention_impl="xla" or "auto".')
     if impl == 'ring':
         # Context parallelism: sequence sharded on the `sp` mesh axis,
         # K/V rotating around the ring (ops/ring_attention.py). Requires

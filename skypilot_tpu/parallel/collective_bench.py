@@ -1,0 +1,144 @@
+"""ICI/DCN collective micro-benchmark.
+
+TPU-native equivalent of the reference's NCCL bandwidth test
+(reference: examples/nccl_test.yaml — `all_reduce_perf` via MPI on GPUs).
+Here the collectives are XLA's, issued over the device mesh with
+shard_map, so the same program measures ICI within a slice and DCN across
+slices (whatever the mesh axis spans):
+
+    psum            — all-reduce, the gradient-sync primitive (dp/fsdp)
+    all_gather      — fsdp param gather
+    reduce_scatter  — fsdp gradient scatter (psum_scatter)
+    ppermute        — ring neighbour exchange (pp microbatch handoff,
+                      ring attention's kv rotation)
+
+Reported "bus bandwidth" follows the nccl-tests convention so numbers are
+comparable across collectives and to the reference's GPU results: the
+per-rank buffer size (full gathered buffer for all-gather) × the
+collective's factor ÷ time (all-reduce 2(n-1)/n, gather/scatter (n-1)/n,
+ppermute 1).
+
+Usage (the examples/ici_collective_test.yaml recipe):
+    python3 -m skypilot_tpu.parallel.collective_bench --size-mb 64
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import statistics
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+COLLECTIVES = ('psum', 'all_gather', 'reduce_scatter', 'ppermute')
+
+
+def _bus_factor(name: str, n: int) -> float:
+    if name == 'psum':
+        return 2.0 * (n - 1) / n
+    if name in ('all_gather', 'reduce_scatter'):
+        return float(n - 1) / n
+    return 1.0  # ppermute: each link carries the full shard once
+
+
+def _build_op(name: str, mesh: Mesh):
+    axis = mesh.axis_names[0]
+    n = mesh.devices.size
+
+    def body(x):
+        if name == 'psum':
+            return jax.lax.psum(x, axis)
+        if name == 'all_gather':
+            return jax.lax.all_gather(x, axis, tiled=True)
+        if name == 'reduce_scatter':
+            return jax.lax.psum_scatter(x, axis, tiled=True)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return jax.lax.ppermute(x, axis, perm)
+
+    # check_vma off: all_gather's output is bytewise-replicated but JAX's
+    # varying-axis inference can't prove it; the check is about sharding
+    # hygiene, irrelevant to a timing kernel.
+    return jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+                      out_specs=P(axis) if name in ('reduce_scatter',
+                                                    'ppermute')
+                      else (P() if name == 'psum' else P(None)),
+                      check_vma=False))
+
+
+def run_bench(size_mb: float = 64.0,
+              iters: int = 10,
+              warmup: int = 2,
+              collectives=COLLECTIVES,
+              mesh: Optional[Mesh] = None) -> List[Dict]:
+    """Measure each collective; returns one dict per collective with
+    median seconds and busbw_gbps. `size_mb` is the TOTAL array size
+    across devices (each device holds size_mb/n)."""
+    if mesh is None:
+        import numpy as np
+        devs = np.array(jax.devices(), dtype=object)
+        mesh = Mesh(devs.reshape(len(devs)), ('x',))
+    n = mesh.devices.size
+    per_dev = max(int(size_mb * 1e6 / 4 / n), 128)
+    per_dev += (-per_dev) % n  # tiled reduce_scatter splits shards by n
+    shard_bytes = per_dev * 4
+    axis = mesh.axis_names[0]
+    x = jax.device_put(
+        jnp.arange(per_dev * n, dtype=jnp.float32),
+        NamedSharding(mesh, P(axis)))
+    results = []
+    for name in collectives:
+        op = _build_op(name, mesh)
+        for _ in range(warmup):
+            jax.block_until_ready(op(x))
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(op(x))
+            times.append(time.perf_counter() - t0)
+        med = statistics.median(times)
+        # nccl-tests size convention: the per-rank buffer for
+        # all-reduce / reduce-scatter / sendrecv, the full gathered
+        # buffer for all-gather.
+        conv_bytes = shard_bytes * n if name == 'all_gather' \
+            else shard_bytes
+        busbw = conv_bytes * _bus_factor(name, n) / med / 1e9
+        results.append({
+            'collective': name,
+            'devices': n,
+            'size_mb': shard_bytes * n / 1e6,
+            'median_s': med,
+            'busbw_gbps': busbw,
+        })
+    return results
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--size-mb', type=float, default=64.0,
+                        help='total array size across devices (MB); '
+                        'each device holds size/n')
+    parser.add_argument('--iters', type=int, default=10)
+    parser.add_argument('--collectives', nargs='*', default=COLLECTIVES)
+    args = parser.parse_args(argv)
+    results = run_bench(size_mb=args.size_mb, iters=args.iters,
+                        collectives=args.collectives)
+    width = max(len(r['collective']) for r in results)
+    print(f'devices={results[0]["devices"]} '
+          f'size={results[0]["size_mb"]:.1f}MB')
+    for r in results:
+        print(f'{r["collective"]:<{width}}  '
+              f'{r["median_s"] * 1e3:8.3f} ms  '
+              f'{r["busbw_gbps"]:8.2f} GB/s busbw')
+    print(json.dumps({'metric': 'ici_allreduce_busbw', 'unit': 'GB/s',
+                      'value': next(r['busbw_gbps'] for r in results
+                                    if r['collective'] == 'psum')}))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -25,6 +25,10 @@ def main(argv=None) -> int:
     parser.add_argument('--seq', type=int, default=1024)
     parser.add_argument('--steps', type=int, default=100)
     parser.add_argument('--learning-rate', type=float, default=3e-4)
+    parser.add_argument('--data-dir', default=None,
+                        help='directory of SKYTOK token shards (*.bin); '
+                        'omit for synthetic batches')
+    parser.add_argument('--data-seed', type=int, default=0)
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=100)
     parser.add_argument('--tp', type=int, default=None)
@@ -76,16 +80,29 @@ def main(argv=None) -> int:
     # 4. The step loop.
     step_fn = make_train_step(cfg, mesh, shardings)
     callbacks.init(total_steps=args.steps)
-    batches = [
-        synthetic_batch(jax.random.PRNGKey(i), args.batch, args.seq,
-                        cfg.vocab_size) for i in range(8)
-    ]
+    dataset = None
+    if args.data_dir:
+        from skypilot_tpu.train.data import TokenDataset
+        dataset = TokenDataset(args.data_dir, args.batch, args.seq,
+                               host_rank=topology.host_rank,
+                               num_hosts=topology.num_hosts,
+                               seed=args.data_seed,
+                               start_batch=start_step)
+        logger.info('data: %d windows/host (%s loader)',
+                    dataset.num_windows,
+                    'native' if dataset.native else 'numpy')
+        batch_for = lambda step: dataset.next_batch()  # noqa: E731
+    else:
+        batches = [
+            synthetic_batch(jax.random.PRNGKey(i), args.batch, args.seq,
+                            cfg.vocab_size) for i in range(8)
+        ]
+        batch_for = lambda step: batches[step % len(batches)]  # noqa: E731
     loss = float('nan')
     with mesh:
         for step in range(start_step, args.steps):
             with callbacks.step():
-                state, metrics = step_fn(state,
-                                         batches[step % len(batches)])
+                state, metrics = step_fn(state, batch_for(step))
             if manager is not None:
                 manager.save(step + 1, state)
             if step % args.log_every == 0 or step == args.steps - 1:
@@ -93,6 +110,8 @@ def main(argv=None) -> int:
                 logger.info('step %d/%d loss=%.4f grad_norm=%.3f', step,
                             args.steps, loss,
                             float(metrics['grad_norm']))
+    if dataset is not None:
+        dataset.close()
     if manager is not None:
         if manager.latest_step() != args.steps:
             manager.save(args.steps, state, force=True)

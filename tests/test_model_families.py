@@ -1,0 +1,238 @@
+"""Architecture-family knobs: Gemma / Gemma-2 / Qwen2 / GPT-2 variants of
+the shared Transformer (reference serves these via separate recipe dirs —
+llm/gemma, llm/qwen, llm/gpt-2; here one mesh-first model expresses them
+all through ModelConfig flags, so every family inherits the sharding,
+remat, flash-attention and KV-cache machinery for free).
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import Transformer, get_config, list_configs
+from skypilot_tpu.models.inference import InferenceEngine
+from skypilot_tpu.ops.flash_attention import flash_attention
+
+
+def _tiny(**kw):
+    cfg = get_config('test-tiny')
+    return dataclasses.replace(cfg, dtype='float32', param_dtype='float32',
+                               max_seq_len=64, remat=False, **kw)
+
+
+def _gemma_tiny(**kw):
+    return _tiny(head_dim_override=32, mlp_activation='gelu',
+                 norm_style='rms_plus1', tie_embeddings=True,
+                 scale_embed_by_dim=True, rope_theta=10000.0, **kw)
+
+
+def _gpt2_tiny(**kw):
+    return _tiny(mlp_activation='gelu', mlp_style='plain',
+                 norm_style='layernorm', pos_embedding='learned',
+                 qkv_bias=True, o_bias=True, mlp_bias=True,
+                 tie_embeddings=True, **kw)
+
+
+def _init_and_forward(cfg, seq=16, batch=2):
+    from flax.core import meta
+    model = Transformer(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0,
+                                cfg.vocab_size, jnp.int32)
+    params = meta.unbox(model.init(jax.random.PRNGKey(1), tokens)['params'])
+    logits = model.apply({'params': params}, tokens)
+    return params, tokens, logits
+
+
+class TestGemma:
+
+    def test_forward_shape_and_tied_unembed(self):
+        cfg = _gemma_tiny()
+        params, tokens, logits = _init_and_forward(cfg)
+        assert logits.shape == (*tokens.shape, cfg.vocab_size)
+        assert 'lm_head' not in params          # unembed = embedᵀ
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_plus1_norm_is_identity_at_init(self):
+        """Gemma stores the norm weight as a delta from 1: a zero param
+        must scale by exactly 1 (freshly initialised model ≡ plain RMS)."""
+        cfg = _gemma_tiny()
+        params, tokens, logits = _init_and_forward(cfg)
+        scale = params['final_norm']['scale']
+        np.testing.assert_array_equal(np.asarray(scale), 0.0)
+        rms_logits = Transformer(dataclasses.replace(
+            cfg, norm_style='rms')).apply({'params': params}, tokens)
+        # rms uses scale directly: zeros kill the output ⇒ must differ.
+        assert not np.allclose(np.asarray(logits), np.asarray(rms_logits))
+
+    def test_grads_finite(self):
+        cfg = _gemma_tiny()
+        params, tokens, _ = _init_and_forward(cfg)
+
+        def loss(p):
+            out = Transformer(cfg).apply({'params': p}, tokens)
+            return jnp.mean(out.astype(jnp.float32) ** 2)
+
+        grads = jax.grad(loss)(params)
+        leaves = jax.tree.leaves(grads)
+        assert leaves and all(
+            np.isfinite(np.asarray(g)).all() for g in leaves)
+
+    def test_head_dim_override(self):
+        cfg = _gemma_tiny()
+        assert cfg.head_dim == 32 != cfg.d_model // cfg.num_heads
+        params, _, _ = _init_and_forward(cfg)
+        assert params['layers']['layer']['attn']['q_proj'][
+            'kernel'].shape[-1] == 32
+
+
+class TestGemma2Softcap:
+
+    def test_final_softcap_bounds_logits(self):
+        cap = 2.0
+        cfg = _gemma_tiny(final_logit_softcap=cap)
+        _, _, logits = _init_and_forward(cfg)
+        assert float(jnp.max(jnp.abs(logits))) <= cap
+
+    def test_attn_softcap_runs_and_changes_output(self):
+        base = _gemma_tiny(attention_impl='xla')
+        capped = dataclasses.replace(base, attn_logit_softcap=0.25)
+        model, tokens = Transformer(base), jax.random.randint(
+            jax.random.PRNGKey(0), (1, 16), 0, base.vocab_size, jnp.int32)
+        params = model.init(jax.random.PRNGKey(1), tokens)['params']
+        out_base = model.apply({'params': params}, tokens)
+        out_cap = Transformer(capped).apply({'params': params}, tokens)
+        assert out_base.shape == out_cap.shape
+        assert not np.allclose(np.asarray(out_base), np.asarray(out_cap))
+
+    def test_pallas_rejects_softcap(self):
+        q = jnp.zeros((1, 128, 4, 64), jnp.float32)
+        with pytest.raises(ValueError, match='softcap'):
+            flash_attention(q, q, q, impl='pallas', logit_softcap=5.0,
+                            block_q=128, block_k=128)
+
+    def test_auto_routes_softcap_to_xla(self):
+        # Well-tiled shape that WOULD take pallas on TPU: softcap must
+        # still produce (finite) output via the XLA path on any backend.
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 128, 4, 64))
+        out = flash_attention(q, q, q, impl='auto', logit_softcap=5.0)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestQwen2:
+
+    def test_qkv_bias_present_and_forward(self):
+        cfg = _tiny(qkv_bias=True, rope_theta=1e6)
+        params, tokens, logits = _init_and_forward(cfg)
+        attn = params['layers']['layer']['attn']
+        assert 'bias' in attn['q_proj'] and 'bias' in attn['k_proj']
+        assert 'bias' not in attn['o_proj']
+        assert logits.shape == (*tokens.shape, cfg.vocab_size)
+
+    def test_bias_participates_in_forward(self):
+        cfg = _tiny(qkv_bias=True)
+        params, tokens, logits = _init_and_forward(cfg)
+        bumped = jax.tree_util.tree_map_with_path(
+            lambda path, x: x + 0.5 if any(
+                getattr(k, 'key', None) == 'bias' for k in path) else x,
+            params)
+        out2 = Transformer(cfg).apply({'params': bumped}, tokens)
+        assert not np.allclose(np.asarray(logits), np.asarray(out2))
+
+
+class TestGPT2:
+
+    def test_forward_learned_positions_and_biases(self):
+        cfg = _gpt2_tiny()
+        params, tokens, logits = _init_and_forward(cfg)
+        assert 'pos_embed' in params
+        layer = params['layers']['layer']
+        assert 'bias' in layer['attn_norm']          # layernorm bias
+        assert 'bias' in layer['mlp']['up_proj']
+        assert 'gate_proj' not in layer['mlp']       # plain 2-matmul MLP
+        assert 'lm_head' not in params               # tied
+        assert logits.shape == (*tokens.shape, cfg.vocab_size)
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_grads_finite(self):
+        cfg = _gpt2_tiny()
+        params, tokens, _ = _init_and_forward(cfg)
+
+        def loss(p):
+            out = Transformer(cfg).apply({'params': p}, tokens)
+            return jnp.mean(out.astype(jnp.float32) ** 2)
+
+        grads = jax.grad(loss)(params)
+        assert all(np.isfinite(np.asarray(g)).all()
+                   for g in jax.tree.leaves(grads))
+
+    def test_position_embedding_matters(self):
+        """Same token at different positions ⇒ different logits (rope is
+        off; the learned table must be doing the work)."""
+        cfg = _gpt2_tiny()
+        model = Transformer(cfg)
+        tokens = jnp.full((1, 8), 7, jnp.int32)
+        params = model.init(jax.random.PRNGKey(1), tokens)['params']
+        out = model.apply({'params': params}, tokens)
+        assert not np.allclose(np.asarray(out[0, 0]),
+                               np.asarray(out[0, -1]), atol=1e-5)
+
+
+class TestFamilyDecodeParity:
+    """The KV-cache decode path must reproduce full-forward logits for
+    every family (it shares the same Attention module, but biases,
+    learned positions and softcaps all touch the decode branch)."""
+
+    @pytest.mark.parametrize('family', ['gemma', 'gemma2', 'gpt2', 'qwen'])
+    def test_prefill_then_decode_matches_full(self, family):
+        cfg = {
+            'gemma': _gemma_tiny(),
+            'gemma2': _gemma_tiny(attn_logit_softcap=0.5,
+                                  final_logit_softcap=4.0,
+                                  attention_impl='xla'),
+            'gpt2': _gpt2_tiny(),
+            'qwen': _tiny(qkv_bias=True),
+        }[family]
+        engine = InferenceEngine(cfg, batch_size=1)
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 10), 0,
+                                    cfg.vocab_size, jnp.int32)
+        full = Transformer(dataclasses.replace(engine.cfg, decode=False)
+                           ).apply({'params': engine.params}, tokens)
+        cache = engine.init_cache()
+        logits, cache = engine._prefill(  # pylint: disable=protected-access
+            engine.params, cache, tokens[:, :6], prompt_len=6)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, 5, :]), atol=2e-4,
+                                   rtol=2e-4)
+        for pos in range(6, 10):
+            logits, cache = engine._decode_step(  # pylint: disable=protected-access
+                engine.params, cache, tokens[:, pos:pos + 1],
+                jnp.asarray(pos, jnp.int32))
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full[:, pos, :]),
+                                       atol=2e-4, rtol=2e-4)
+
+
+class TestRegistry:
+
+    @pytest.mark.parametrize('name,lo,hi', [
+        ('gemma-2b', 2.0e9, 3.0e9),
+        ('gemma-7b', 7.5e9, 9.5e9),
+        ('gemma2-9b', 8.0e9, 10.5e9),
+        ('qwen2-7b', 6.5e9, 8.2e9),
+        ('qwen2-72b', 6.5e10, 8.0e10),
+        ('gpt2-124m', 1.1e8, 1.4e8),
+        ('gpt2-1.5b', 1.4e9, 1.7e9),
+    ])
+    def test_param_counts_in_published_range(self, name, lo, hi):
+        assert lo <= get_config(name).num_params() <= hi
+
+    def test_families_listed(self):
+        names = list_configs()
+        for name in ('gemma-2b', 'qwen2-7b', 'gpt2-124m', 'mixtral-8x7b'):
+            assert name in names
+
+    def test_flops_count_tied_unembed(self):
+        cfg = get_config('gpt2-124m')
+        assert cfg.flops_per_token(1024) > 6 * cfg.num_params()
