@@ -18,11 +18,16 @@ from skypilot_tpu.train.data import write_token_shard
 
 
 def _tokenize(text: str) -> np.ndarray:
+    import sys
     try:
         from transformers import GPT2TokenizerFast  # type: ignore
         tok = GPT2TokenizerFast.from_pretrained('gpt2')
         return np.asarray(tok(text)['input_ids'], dtype=np.uint32)
-    except Exception:  # pylint: disable=broad-except
+    except Exception as e:  # pylint: disable=broad-except
+        print(f'WARNING: GPT-2 BPE unavailable ({type(e).__name__}: {e}); '
+              f'falling back to BYTE-LEVEL tokens (ids 0-255). Fine for '
+              f'smoke tests; not the real GPT-2 vocabulary.',
+              file=sys.stderr)
         return np.frombuffer(text.encode('utf-8'),
                              dtype=np.uint8).astype(np.uint16)
 

@@ -370,6 +370,67 @@ def check():
     click.echo(f'Enabled clouds: {", ".join(enabled)}')
 
 
+@cli.group()
+def local():
+    """Local sandbox for iterating without cloud chips (reference:
+    `sky local up`, cli.py:5076 — there a kind k8s cluster; here the
+    docker debug backend, or the in-process fake cloud with --fake)."""
+
+
+@local.command('up')
+@click.option('--fake', is_flag=True, default=False,
+              help='Use the in-process fake cloud instead of docker '
+              '(no daemon needed; slices are local processes).')
+def local_up(fake):
+    """Enable the local backend so `launch --cloud docker|fake` works."""
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.clouds import registry
+    name = 'fake' if fake else 'docker'
+    if fake:
+        # `local up --fake` IS the explicit opt-in the fake cloud's
+        # test-only guard asks for.
+        os.environ['SKYTPU_ENABLE_FAKE_CLOUD'] = '1'
+    cloud = registry.get(name)
+    ok, reason = cloud.check_credentials()
+    if not ok:
+        _fail(f'{name} backend unavailable: {reason}')
+    cached = global_user_state.get_enabled_clouds()
+    if cached is None:
+        # Never-checked install: probe the real clouds first so enabling
+        # the local backend doesn't mask valid GCP/k8s credentials behind
+        # a cache that now exists but was never populated.
+        from skypilot_tpu import check as check_lib
+        cached = check_lib.check(quiet=True)
+    enabled = set(cached)
+    enabled.add(name)
+    global_user_state.set_enabled_clouds(sorted(enabled))
+    click.echo(f'Local {name} backend enabled.\n'
+               f'Try: skytpu launch --cloud {name} '
+               f'examples/docker/docker_app.yaml')
+
+
+@local.command('down')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def local_down(yes):
+    """Tear down local (docker/fake) clusters and disable the backends."""
+    from skypilot_tpu import global_user_state
+    locals_ = [
+        r['name'] for r in global_user_state.get_clusters()
+        if r['handle'] is not None and getattr(
+            r['handle'].launched_resources, 'cloud_name', None
+        ) in ('docker', 'fake')
+    ]
+    if locals_:
+        _confirm(f'Tear down local clusters: {", ".join(locals_)}?', yes)
+        for name in locals_:
+            sky.down(name)
+            click.echo(f'Terminated {name!r}.')
+    enabled = set(global_user_state.get_enabled_clouds() or [])
+    enabled -= {'docker', 'fake'}
+    global_user_state.set_enabled_clouds(sorted(enabled))
+    click.echo('Local backends disabled.')
+
+
 @cli.command('show-tpus')
 @click.option('--all', '-a', 'show_all', is_flag=True, default=False)
 def show_tpus(show_all):

@@ -51,6 +51,10 @@ class ModelConfig:
     # it explicitly rather than silently dropping the cap.
     attn_logit_softcap: float = 0.0
     final_logit_softcap: float = 0.0
+    # Mistral-style uniform sliding window, in keys (0 ⇒ full causal).
+    # The pallas kernels skip blocks outside the window, so long-sequence
+    # attention compute drops from O(S²) to O(S·window).
+    sliding_window: int = 0
     # MoE (0 ⇒ dense SwiGLU MLP).
     num_experts: int = 0
     experts_per_token: int = 2
@@ -207,6 +211,14 @@ GEMMA2_9B = _register(ModelConfig(
     mlp_activation='gelu', norm_style='rms_plus1', tie_embeddings=True,
     scale_embed_by_dim=True, attn_logit_softcap=50.0,
     final_logit_softcap=30.0, attention_impl='xla'))
+
+# --- Mistral (reference recipes: llm/vicuna-llama-2 era serving stacks):
+# Llama shape + uniform 4096-key sliding window on every layer — the
+# config the sliding-window kernel path exists for.
+MISTRAL_7B = _register(ModelConfig(
+    name='mistral-7b', vocab_size=32000, d_model=4096, num_layers=32,
+    num_heads=32, num_kv_heads=8, d_mlp=14336, max_seq_len=8192,
+    rope_theta=10000.0, sliding_window=4096))
 
 # --- Qwen2 family (reference recipe: llm/qwen): Llama shape + QKV bias.
 QWEN2_7B = _register(ModelConfig(

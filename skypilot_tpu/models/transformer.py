@@ -120,7 +120,8 @@ class Attention(nn.Module):
         else:
             out = flash_attention(q, k, v, causal=True,
                                   impl=cfg.attention_impl,
-                                  logit_softcap=cfg.attn_logit_softcap)
+                                  logit_softcap=cfg.attn_logit_softcap,
+                                  window=cfg.sliding_window)
         out = nn.DenseGeneral(
             features=cfg.d_model, axis=(-2, -1), use_bias=cfg.o_bias,
             dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
@@ -211,6 +212,8 @@ class Attention(nn.Module):
         q_pos = positions[:, :, None]                          # (b, q, 1)
         k_pos = jnp.arange(cfg.max_seq_len)[None, None, :]     # (1, 1, s)
         mask = k_pos <= q_pos                                  # causal+fill
+        if cfg.sliding_window:
+            mask &= q_pos - k_pos < cfg.sliding_window
         scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(value_arr.dtype)
         out = jnp.einsum('bkrqs,bskd->bqkrd', probs, value_arr)

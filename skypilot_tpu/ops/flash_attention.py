@@ -38,7 +38,8 @@ DEFAULT_BLOCK_K = 256
 
 def _reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                          causal: bool, sm_scale: float,
-                         logit_softcap: float = 0.0) -> jax.Array:
+                         logit_softcap: float = 0.0,
+                         window: int = 0) -> jax.Array:
     """Plain XLA attention; fp32 softmax. Shapes: (B, S, H, D)."""
     logits = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                         preferred_element_type=jnp.float32)
@@ -46,17 +47,28 @@ def _reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if logit_softcap:
         # Gemma-2 style tanh cap; XLA fuses this into the matmul epilogue.
         logits = logit_softcap * jnp.tanh(logits / logit_softcap)
-    if causal:
+    if causal or window:
         s_q, s_k = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), s_k - s_q)
+        rows = jnp.arange(s_q)[:, None] + (s_k - s_q)
+        cols = jnp.arange(s_k)[None, :]
+        mask = cols <= rows
+        if window:
+            mask &= rows - cols < window
         logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum('bhqk,bkhd->bqhd', probs, v)
 
 
+def _window_lo(qi, block_q: int, block_k: int, window: int):
+    """First k-block any row of q-block `qi` can see under a sliding
+    window of `window` keys (query row r sees keys (r-window, r])."""
+    first_visible = qi * block_q - (window - 1)
+    return jnp.maximum(0, first_visible // block_k)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
-                causal: bool, block_q: int, block_k: int, seq_len: int,
-                head_dim: int):
+                causal: bool, window: int, block_q: int, block_k: int,
+                seq_len: int, head_dim: int):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
 
@@ -68,6 +80,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
         hi = jnp.minimum(hi, num_kb)
     else:
         hi = num_kb
+    lo = _window_lo(qi, block_q, block_k, window) if window else 0
 
     def body(kb, carry):
         acc, m_prev, l_prev = carry
@@ -78,12 +91,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
         s = jax.lax.dot_general(q, k_blk,
                                 (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
+        if causal or window:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows, s, -1e30)
+            keep = cols <= rows  # window implies causal (API-enforced)
+            if window:
+                keep &= rows - cols < window
+            s = jnp.where(keep, s, -1e30)
         m_cur = jnp.max(s, axis=-1)                       # (bq,)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
@@ -97,7 +113,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
     init = (jnp.zeros((block_q, head_dim), jnp.float32),
             jnp.full((block_q,), -jnp.inf, jnp.float32),
             jnp.zeros((block_q,), jnp.float32))
-    acc, m, l = jax.lax.fori_loop(0, hi, body, init)
+    acc, m, l = jax.lax.fori_loop(lo, hi, body, init)
     l_safe = jnp.maximum(l, 1e-30)
     out = acc / l_safe[:, None]
     o_ref[0] = out.astype(o_ref.dtype)
@@ -110,15 +126,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
 
 
 def _pallas_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
-                    sm_scale: float, block_q: int, block_k: int,
-                    interpret: bool):
+                    window: int, sm_scale: float, block_q: int,
+                    block_k: int, interpret: bool):
     """q,k,v: (BH, S, D) — pre-folded batch*heads, kv already repeated.
     Returns (out, lse)."""
     bh, seq_len, head_dim = q.shape
     grid = (bh, seq_len // block_q)
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                               block_q=block_q, block_k=block_k,
-                               seq_len=seq_len, head_dim=head_dim)
+                               window=window, block_q=block_q,
+                               block_k=block_k, seq_len=seq_len,
+                               head_dim=head_dim)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -140,8 +157,9 @@ def _pallas_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, sm_scale: float, causal: bool, block_q: int,
-                   block_k: int, seq_len: int, head_dim: int):
+                   *, sm_scale: float, causal: bool, window: int,
+                   block_q: int, block_k: int, seq_len: int,
+                   head_dim: int):
     """dQ for one q-block: stream k-blocks (skipping fully-masked ones),
     rebuild p from lse, accumulate ds @ K."""
     qi = pl.program_id(1)
@@ -156,6 +174,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         hi = jnp.minimum(hi, num_kb)
     else:
         hi = num_kb
+    lo = _window_lo(qi, block_q, block_k, window) if window else 0
 
     def body(kb, dq):
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(
@@ -165,12 +184,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        if causal:
+        if causal or window:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows, s, -1e30)
+            keep = cols <= rows
+            if window:
+                keep &= rows - cols < window
+            s = jnp.where(keep, s, -1e30)
         p = jnp.exp(s - lse[:, None])                     # (bq, bk)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -180,16 +202,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(
-        0, hi, body, jnp.zeros((block_q, head_dim), jnp.float32))
+        lo, hi, body, jnp.zeros((block_q, head_dim), jnp.float32))
     dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, sm_scale: float, causal: bool,
-                    block_q: int, block_k: int, seq_len: int,
+                    window: int, block_q: int, block_k: int, seq_len: int,
                     head_dim: int):
     """dK/dV for one k-block: stream q-blocks at-or-after it (causal),
-    rebuild p, accumulate pᵀ @ dO and dsᵀ @ Q."""
+    skipping q-blocks past the sliding window, rebuild p, accumulate
+    pᵀ @ dO and dsᵀ @ Q."""
     kb = pl.program_id(1)
     k_blk = k_ref[0].astype(jnp.float32)                  # (bk, d)
     v_blk = v_ref[0].astype(jnp.float32)
@@ -197,6 +220,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     num_qb = seq_len // block_q
     # First q-block whose LAST row can see this k-block's first key.
     lo = (kb * block_k) // block_q if causal else 0
+    if window:
+        # Last visible query row for ANY key here: (kb+1)*block_k - 1 +
+        # window - 1; blocks beyond it contribute nothing.
+        last_row = (kb + 1) * block_k + window - 2
+        hi = jnp.minimum(num_qb, last_row // block_q + 1)
+    else:
+        hi = num_qb
 
     def body(qi, carry):
         dk, dv = carry
@@ -209,12 +239,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        if causal:
+        if causal or window:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows, s, -1e30)
+            keep = cols <= rows
+            if window:
+                keep &= rows - cols < window
+            s = jnp.where(keep, s, -1e30)
         p = jnp.exp(s - lse[:, None])                     # (bq, bk)
         dv = dv + jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())),
@@ -228,15 +261,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         return dk, dv
 
     dk, dv = jax.lax.fori_loop(
-        lo, num_qb, body,
+        lo, hi, body,
         (jnp.zeros((block_k, head_dim), jnp.float32),
          jnp.zeros((block_k, head_dim), jnp.float32)))
     dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _pallas_backward(q, k, v, do, lse, delta, causal, sm_scale, block_q,
-                     block_k, interpret):
+def _pallas_backward(q, k, v, do, lse, delta, causal, window, sm_scale,
+                     block_q, block_k, interpret):
     """All inputs pre-folded (BH, S, D) / (BH, S). Returns dq, dk, dv."""
     bh, seq_len, head_dim = q.shape
     full = lambda: pl.BlockSpec((1, seq_len, head_dim),
@@ -244,7 +277,7 @@ def _pallas_backward(q, k, v, do, lse, delta, causal, sm_scale, block_q,
     full_row = lambda: pl.BlockSpec((1, 1, seq_len), lambda b, i: (b, 0, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
+                          window=window, block_q=block_q, block_k=block_k,
                           seq_len=seq_len, head_dim=head_dim),
         grid=(bh, seq_len // block_q),
         in_specs=[
@@ -260,8 +293,9 @@ def _pallas_backward(q, k, v, do, lse, delta, causal, sm_scale, block_q,
     )(q, k, v, do, lse, delta)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=block_q, block_k=block_k,
-                          seq_len=seq_len, head_dim=head_dim),
+                          causal=causal, window=window, block_q=block_q,
+                          block_k=block_k, seq_len=seq_len,
+                          head_dim=head_dim),
         grid=(bh, seq_len // block_k),
         in_specs=[
             full(),
@@ -299,28 +333,31 @@ def _unfold(x: jax.Array, b: int, h: int) -> jax.Array:
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, window, sm_scale, block_q, block_k, interpret):
     b, s, h, d = q.shape
     del s, d
     n_rep = h // k.shape[2]
     out, _ = _pallas_forward(_fold(q), _fold(_repeat_kv(k, n_rep)),
-                             _fold(_repeat_kv(v, n_rep)), causal, sm_scale,
-                             block_q, block_k, interpret)
+                             _fold(_repeat_kv(v, n_rep)), causal, window,
+                             sm_scale, block_q, block_k, interpret)
     return _unfold(out, b, h)
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, window, sm_scale, block_q, block_k,
+               interpret):
     b, s, h, d = q.shape
     del s, d
     n_rep = h // k.shape[2]
     out_f, lse = _pallas_forward(_fold(q), _fold(_repeat_kv(k, n_rep)),
                                  _fold(_repeat_kv(v, n_rep)), causal,
-                                 sm_scale, block_q, block_k, interpret)
+                                 window, sm_scale, block_q, block_k,
+                                 interpret)
     return _unfold(out_f, b, h), (q, k, v, out_f, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
+def _flash_bwd(causal, window, sm_scale, block_q, block_k, interpret,
+               residuals, g):
     q, k, v, out_f, lse = residuals
     b, s, h, d = q.shape
     del s, d
@@ -336,7 +373,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
     delta = jnp.sum(gf.astype(jnp.float32) * out_f.astype(jnp.float32),
                     axis=-1)[:, None, :]
     dqf, dkf, dvf = _pallas_backward(qf, kf, vf, gf, lse, delta, causal,
-                                     sm_scale, block_q, block_k, interpret)
+                                     window, sm_scale, block_q, block_k,
+                                     interpret)
     dq = _unfold(dqf, b, h).astype(q.dtype)
     dk_full = _unfold(dkf, b, h)                     # (b, s, h, d)
     dv_full = _unfold(dvf, b, h)
@@ -361,7 +399,8 @@ def flash_attention(q: jax.Array,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     impl: str = 'auto',
-                    logit_softcap: float = 0.0) -> jax.Array:
+                    logit_softcap: float = 0.0,
+                    window: int = 0) -> jax.Array:
     """Multi-head attention with GQA support.
 
     Args:
@@ -373,6 +412,10 @@ def flash_attention(q: jax.Array,
       logit_softcap: Gemma-2-style tanh cap on attention logits (0 = off).
         Supported on the XLA path only; 'auto' routes capped attention to
         XLA, explicit 'pallas'/'ring' reject it.
+      window: sliding-window size in keys, Mistral-style — query row r
+        attends keys (r-window, r]. 0 = full causal. Requires causal;
+        the pallas kernels skip blocks entirely outside the window, so
+        compute drops from O(S²) to O(S·window) for long sequences.
     """
     b, s, h, d = q.shape
     if sm_scale is None:
@@ -380,6 +423,10 @@ def flash_attention(q: jax.Array,
     if h % k.shape[2]:
         raise ValueError(f'num_heads {h} not divisible by kv heads '
                          f'{k.shape[2]}')
+    if window and not causal:
+        raise ValueError('window requires causal attention')
+    if window < 0:
+        raise ValueError(f'window must be >= 0, got {window}')
     # Blocks never exceed the sequence (the 256-default would otherwise
     # reject short sequences that tile fine at their own length).
     block_q = min(block_q, s)
@@ -398,7 +445,7 @@ def flash_attention(q: jax.Array,
         n_rep = h // k.shape[2]
         return _reference_attention(q, _repeat_kv(k, n_rep),
                                     _repeat_kv(v, n_rep), causal, sm_scale,
-                                    logit_softcap)
+                                    logit_softcap, window)
     if logit_softcap:
         raise ValueError(
             f'logit_softcap is only supported on the XLA attention path '
@@ -407,6 +454,10 @@ def flash_attention(q: jax.Array,
         # Context parallelism: sequence sharded on the `sp` mesh axis,
         # K/V rotating around the ring (ops/ring_attention.py). Requires
         # an ambient mesh (jax.set_mesh) with an `sp` axis.
+        if window:
+            raise ValueError('window is not supported on the ring path; '
+                             'a sliding window makes ring rotation '
+                             'unnecessary — shard the sequence instead.')
         from skypilot_tpu.ops.ring_attention import ring_attention_ambient
         n_rep = h // k.shape[2]
         return ring_attention_ambient(
@@ -416,6 +467,6 @@ def flash_attention(q: jax.Array,
         if s % block_q or s % block_k:
             raise ValueError(f'seq {s} must tile by block_q={block_q}, '
                              f'block_k={block_k}')
-        return _flash(q, k, v, causal, sm_scale, block_q, block_k,
+        return _flash(q, k, v, causal, window, sm_scale, block_q, block_k,
                       impl == 'pallas_interpret')
     raise ValueError(f'Unknown impl {impl!r}')

@@ -78,6 +78,10 @@ def run_bench(size_mb: float = 64.0,
     """Measure each collective; returns one dict per collective with
     median seconds and busbw_gbps. `size_mb` is the TOTAL array size
     across devices (each device holds size_mb/n)."""
+    unknown = set(collectives) - set(COLLECTIVES)
+    if unknown:
+        raise ValueError(f'unknown collectives {sorted(unknown)}; '
+                         f'known: {list(COLLECTIVES)}')
     if mesh is None:
         import numpy as np
         devs = np.array(jax.devices(), dtype=object)
@@ -134,9 +138,14 @@ def main(argv=None) -> int:
         print(f'{r["collective"]:<{width}}  '
               f'{r["median_s"] * 1e3:8.3f} ms  '
               f'{r["busbw_gbps"]:8.2f} GB/s busbw')
-    print(json.dumps({'metric': 'ici_allreduce_busbw', 'unit': 'GB/s',
-                      'value': next(r['busbw_gbps'] for r in results
-                                    if r['collective'] == 'psum')}))
+    # Headline metric: psum (all-reduce) busbw when measured, else the
+    # first requested row.
+    head = next((r for r in results if r['collective'] == 'psum'),
+                results[0])
+    metric = {'psum': 'allreduce'}.get(head['collective'],
+                                       head['collective'])
+    print(json.dumps({'metric': f'ici_{metric}_busbw',
+                      'unit': 'GB/s', 'value': head['busbw_gbps']}))
     return 0
 
 

@@ -1,0 +1,62 @@
+"""`skytpu local up/down` (reference parity: `sky local up`,
+sky/cli.py:5076 — the local debug sandbox; here docker or the fake
+cloud)."""
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import cli as cli_mod
+from skypilot_tpu import global_user_state
+
+
+@pytest.fixture(autouse=True)
+def cli_env(_isolate_state):
+    global_user_state.set_enabled_clouds(['gcp'])
+    yield
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+def test_local_up_fake_enables_cloud(runner):
+    result = runner.invoke(cli_mod.cli, ['local', 'up', '--fake'],
+                           catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    assert 'fake backend enabled' in result.output
+    enabled = global_user_state.get_enabled_clouds()
+    assert 'fake' in enabled and 'gcp' in enabled  # merges, not replaces
+
+
+def test_local_down_disables_and_keeps_others(runner):
+    runner.invoke(cli_mod.cli, ['local', 'up', '--fake'],
+                  catch_exceptions=False)
+    result = runner.invoke(cli_mod.cli, ['local', 'down', '-y'],
+                           catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    enabled = global_user_state.get_enabled_clouds()
+    assert 'fake' not in enabled and 'gcp' in enabled
+
+
+def test_local_down_tears_down_local_clusters(runner):
+    global_user_state.set_enabled_clouds(['fake'])
+    result = runner.invoke(
+        cli_mod.cli,
+        ['launch', '-y', '-d', '--cloud', 'fake', '--accelerators',
+         'tpu-v5e-1', '--name', 'localc', 'echo hi'],
+        catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    assert any(r['name'] == 'localc'
+               for r in global_user_state.get_clusters())
+    result = runner.invoke(cli_mod.cli, ['local', 'down', '-y'],
+                           catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    assert 'localc' in result.output
+    assert not any(r['name'] == 'localc'
+                   for r in global_user_state.get_clusters())
+
+
+def test_local_up_help_in_cli(runner):
+    result = runner.invoke(cli_mod.cli, ['--help'],
+                           catch_exceptions=False)
+    assert 'local' in result.output
