@@ -388,8 +388,10 @@ def local_up(fake):
     name = 'fake' if fake else 'docker'
     if fake:
         # `local up --fake` IS the explicit opt-in the fake cloud's
-        # test-only guard asks for.
-        os.environ['SKYTPU_ENABLE_FAKE_CLOUD'] = '1'
+        # test-only guard asks for — persist it so later processes
+        # (`skytpu check`, launches) keep honoring it until local down.
+        from skypilot_tpu import sky_config
+        sky_config.write_user_config_key(('fake_cloud_enabled',), True)
     cloud = registry.get(name)
     ok, reason = cloud.check_credentials()
     if not ok:
@@ -428,6 +430,9 @@ def local_down(yes):
     enabled = set(global_user_state.get_enabled_clouds() or [])
     enabled -= {'docker', 'fake'}
     global_user_state.set_enabled_clouds(sorted(enabled))
+    from skypilot_tpu import sky_config
+    if sky_config.get_nested(('fake_cloud_enabled',), False):
+        sky_config.write_user_config_key(('fake_cloud_enabled',), False)
     click.echo('Local backends disabled.')
 
 

@@ -25,10 +25,15 @@ class Fake(gcp.GCP):
 
     @classmethod
     def check_credentials(cls) -> Tuple[bool, Optional[str]]:
-        if os.environ.get(ENABLE_ENV, '') not in ('1', 'true'):
-            return False, (f'fake cloud is test-only; set {ENABLE_ENV}=1 '
-                           'to enable.')
-        return True, None
+        if os.environ.get(ENABLE_ENV, '') in ('1', 'true'):
+            return True, None
+        # Persisted opt-in (`skytpu local up --fake`): survives new
+        # processes, so a later `skytpu check` doesn't undo local-up.
+        from skypilot_tpu import sky_config
+        if sky_config.get_nested(('fake_cloud_enabled',), False):
+            return True, None
+        return False, (f'fake cloud is test-only; set {ENABLE_ENV}=1 or '
+                       'run `skytpu local up --fake` to enable.')
 
     @classmethod
     def get_project_id(cls) -> str:

@@ -55,6 +55,11 @@ class ModelConfig:
     # The pallas kernels skip blocks outside the window, so long-sequence
     # attention compute drops from O(S²) to O(S·window).
     sliding_window: int = 0
+    # Weight-only quantization for SERVING ('none'|'int8'). Decode is
+    # HBM-bandwidth-bound on reading weights; int8 kernels + per-output-
+    # channel fp32 scales halve that traffic (models/quantize.py converts
+    # a float checkpoint; training always runs float).
+    weight_quant: str = 'none'
     # MoE (0 ⇒ dense SwiGLU MLP).
     num_experts: int = 0
     experts_per_token: int = 2
@@ -77,6 +82,10 @@ class ModelConfig:
     # llama3-1b/v5e vs 'full').
     remat_policy: str = 'dots'
     attention_impl: str = 'auto'      # 'auto'|'pallas'|'xla'|'ring'
+    # Pallas flash-attention tile sizes (0 ⇒ the kernel's default).
+    # Exposed for per-chip tuning: bench.py sweeps these on real hardware.
+    attn_block_q: int = 0
+    attn_block_k: int = 0
     dtype: str = 'bfloat16'           # activation/compute dtype
     param_dtype: str = 'float32'
     # Autoregressive decode mode: Attention reads/writes a KV cache (the

@@ -43,10 +43,15 @@ def temperature_sample(logits: jax.Array, rng: jax.Array,
 def _resolve_cfg_and_params(cfg: 'ModelConfig | str',
                             params: Optional[Any],
                             max_seq_len: Optional[int],
-                            rng_seed: int):
-    """Shared engine bring-up: normalize config to decode mode and init
+                            rng_seed: int,
+                            quantize: Optional[str] = None):
+    """Shared engine bring-up: normalize config to decode mode, init
     random weights when no checkpoint is given (bring-up / load-testing;
-    real deployments restore via train/checkpoints.py)."""
+    real deployments restore via train/checkpoints.py), and optionally
+    quantize the float params for weight-only int8 serving."""
+    if quantize not in (None, 'int8'):
+        raise ValueError(f'unknown quantize mode {quantize!r}; '
+                         f"supported: 'int8'")
     if isinstance(cfg, str):
         cfg = get_config(cfg)
     if max_seq_len is not None:
@@ -54,11 +59,17 @@ def _resolve_cfg_and_params(cfg: 'ModelConfig | str',
     cfg = dataclasses.replace(cfg, decode=True, remat=False)
     if params is None:
         logger.info('Initializing random weights for %s', cfg.name)
-        init_cfg = dataclasses.replace(cfg, decode=False)
+        init_cfg = dataclasses.replace(cfg, decode=False,
+                                       weight_quant='none')
         params = nn.unbox(
             Transformer(init_cfg).init(
                 jax.random.PRNGKey(rng_seed),
                 jnp.ones((1, 8), jnp.int32)))['params']
+    if quantize:
+        from skypilot_tpu.models.quantize import quantize_params
+        cfg = dataclasses.replace(cfg, weight_quant='int8')
+        params = quantize_params(params, cfg)
+        logger.info('Quantized %s weights to int8 for serving', cfg.name)
     return cfg, params
 
 
@@ -74,9 +85,10 @@ class InferenceEngine:
                  params: Optional[Any] = None,
                  batch_size: int = 1,
                  max_seq_len: Optional[int] = None,
-                 rng_seed: int = 0) -> None:
+                 rng_seed: int = 0,
+                 quantize: Optional[str] = None) -> None:
         self.cfg, self.params = _resolve_cfg_and_params(
-            cfg, params, max_seq_len, rng_seed)
+            cfg, params, max_seq_len, rng_seed, quantize)
         self.batch_size = batch_size
         self.model = Transformer(self.cfg)
         self._rng = jax.random.PRNGKey(rng_seed)
@@ -217,11 +229,12 @@ class ContinuousBatchingEngine:
                  num_slots: int = 4,
                  max_seq_len: Optional[int] = None,
                  rng_seed: int = 0,
-                 mesh: Optional[Any] = None) -> None:
+                 mesh: Optional[Any] = None,
+                 quantize: Optional[str] = None) -> None:
         import queue as queue_lib
         import threading
         self.cfg, self.params = _resolve_cfg_and_params(
-            cfg, params, max_seq_len, rng_seed)
+            cfg, params, max_seq_len, rng_seed, quantize)
         self.num_slots = num_slots
         self.mesh = mesh
         self.model = Transformer(self.cfg)

@@ -39,6 +39,78 @@ def _param_dtype(cfg: ModelConfig) -> Dtype:
     return jnp.dtype(cfg.param_dtype)
 
 
+class QuantDenseGeneral(nn.Module):
+    """Weight-only int8 dense: `kernel_q` (int8) + per-output-channel
+    `kernel_scale` (fp32), produced from a float checkpoint by
+    models/quantize.py. Decode reads half the weight bytes from HBM; the
+    int8→compute-dtype convert fuses into the matmul. Same submodule
+    name/shape contract as the nn.DenseGeneral it replaces, so only the
+    kernel params differ."""
+    cfg: ModelConfig
+    features: Any                 # int or tuple
+    kernel_axes: Tuple[str, ...]
+    axis: Any = -1                # int or tuple: contracted input dims
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        features = (self.features if isinstance(self.features, tuple)
+                    else (self.features,))
+        axis = (self.axis if isinstance(self.axis, tuple)
+                else (self.axis,))
+        axis = tuple(a % x.ndim for a in axis)
+        in_shape = tuple(x.shape[a] for a in axis)
+        kshape = in_shape + features
+        kernel_q = self.param(
+            'kernel_q',
+            nn.with_logical_partitioning(
+                lambda key, shape, dtype: jnp.zeros(shape, dtype),
+                self.kernel_axes),
+            kshape, jnp.int8)
+        scale = self.param(
+            'kernel_scale',
+            nn.with_logical_partitioning(
+                nn.initializers.ones, self.kernel_axes[len(in_shape):]),
+            features, jnp.float32)
+        y = jax.lax.dot_general(
+            x, kernel_q.astype(_dtype(cfg)),
+            ((axis, tuple(range(len(in_shape)))), ((), ())),
+            preferred_element_type=jnp.float32)
+        y = y * scale
+        y = y.astype(_dtype(cfg))
+        if self.use_bias:
+            bias = self.param(
+                'bias',
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros,
+                    self.kernel_axes[len(in_shape):]),
+                features, _param_dtype(cfg))
+            y = y + bias.astype(_dtype(cfg))
+        return y
+
+
+def dense_general(cfg: ModelConfig, features, kernel_axes, name: str,
+                  axis=-1, use_bias: bool = False):
+    """nn.DenseGeneral, or its int8-serving twin when
+    cfg.weight_quant == 'int8' — same module name either way, so the
+    param-tree paths line up and quantize_params is a leaf rewrite."""
+    if cfg.weight_quant == 'int8':
+        return QuantDenseGeneral(cfg, features=features,
+                                 kernel_axes=tuple(kernel_axes),
+                                 axis=axis, use_bias=use_bias, name=name)
+    return nn.DenseGeneral(
+        features=features, axis=axis, use_bias=use_bias,
+        dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), tuple(kernel_axes)),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros,
+            tuple(kernel_axes)[1:] if isinstance(axis, int)
+            else (tuple(kernel_axes)[-1],)),
+        name=name)
+
+
 class RMSNorm(nn.Module):
     """Pre-norm in the family's dialect: 'rms' (Llama), 'rms_plus1'
     (Gemma — the stored weight is a delta from 1), 'layernorm' (GPT-2 —
@@ -95,14 +167,8 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         cfg = self.cfg
-        dense = lambda feats, axes, name: nn.DenseGeneral(
-            features=feats, axis=-1, use_bias=cfg.qkv_bias,
-            dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), axes),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros, axes[1:]),
-            name=name)
+        dense = lambda feats, axes, name: dense_general(
+            cfg, feats, axes, name, use_bias=cfg.qkv_bias)
         q = dense((cfg.num_heads, cfg.head_dim),
                   ('embed', 'heads', 'qkv_dim'), 'q_proj')(x)
         k = dense((cfg.num_kv_heads, cfg.head_dim),
@@ -118,19 +184,18 @@ class Attention(nn.Module):
         if cfg.decode:
             out = self._decode_attention(q, k, v, positions)
         else:
+            block_kw = {}
+            if cfg.attn_block_q:
+                block_kw['block_q'] = cfg.attn_block_q
+            if cfg.attn_block_k:
+                block_kw['block_k'] = cfg.attn_block_k
             out = flash_attention(q, k, v, causal=True,
                                   impl=cfg.attention_impl,
                                   logit_softcap=cfg.attn_logit_softcap,
-                                  window=cfg.sliding_window)
-        out = nn.DenseGeneral(
-            features=cfg.d_model, axis=(-2, -1), use_bias=cfg.o_bias,
-            dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(),
-                ('heads', 'qkv_dim', 'embed')),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros, ('embed',)),
-            name='o_proj')(out)
+                                  window=cfg.sliding_window, **block_kw)
+        out = dense_general(cfg, cfg.d_model,
+                            ('heads', 'qkv_dim', 'embed'), 'o_proj',
+                            axis=(-2, -1), use_bias=cfg.o_bias)(out)
         return sharding.constrain(out, 'batch', 'seq', 'act_embed')
 
     def _decode_attention(self, q: jax.Array, k: jax.Array,
@@ -231,14 +296,8 @@ class SwiGLU(nn.Module):
         cfg = self.cfg
         act = nn.silu if cfg.mlp_activation == 'silu' else (
             lambda y: nn.gelu(y, approximate=True))
-        dense = lambda feats, axes, name: nn.DenseGeneral(
-            features=feats, axis=-1, use_bias=cfg.mlp_bias,
-            dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), axes),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros, axes[1:]),
-            name=name)
+        dense = lambda feats, axes, name: dense_general(
+            cfg, feats, axes, name, use_bias=cfg.mlp_bias)
         up = dense(cfg.d_mlp, ('embed', 'mlp'), 'up_proj')(x)
         if cfg.mlp_style == 'glu':
             gate = dense(cfg.d_mlp, ('embed', 'mlp'), 'gate_proj')(x)
@@ -345,12 +404,8 @@ class Transformer(nn.Module):
         if cfg.tie_embeddings:
             logits = embed.attend(x)
         else:
-            logits = nn.DenseGeneral(
-                features=cfg.vocab_size, axis=-1, use_bias=False,
-                dtype=_dtype(cfg), param_dtype=_param_dtype(cfg),
-                kernel_init=nn.with_logical_partitioning(
-                    nn.initializers.lecun_normal(), ('embed', 'vocab')),
-                name='lm_head')(x)
+            logits = dense_general(cfg, cfg.vocab_size,
+                                   ('embed', 'vocab'), 'lm_head')(x)
         if cfg.final_logit_softcap:
             cap = cfg.final_logit_softcap
             logits = (cap * jnp.tanh(

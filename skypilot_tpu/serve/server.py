@@ -49,7 +49,8 @@ class InferenceServer:
     def __init__(self, model: str, max_seq_len: Optional[int] = None,
                  tokenizer: str = 'byte',
                  checkpoint_dir: Optional[str] = None,
-                 num_slots: int = 4) -> None:
+                 num_slots: int = 4,
+                 quantize: Optional[str] = None) -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
@@ -62,7 +63,8 @@ class InferenceServer:
         # other (the old engine serialized behind an asyncio lock).
         self.engine = ContinuousBatchingEngine(model, params=params,
                                                num_slots=num_slots,
-                                               max_seq_len=max_seq_len)
+                                               max_seq_len=max_seq_len,
+                                               quantize=quantize)
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
         if tokenizer.startswith('hf:'):
@@ -158,6 +160,9 @@ def main(argv=None) -> int:
     parser.add_argument('--num-slots', type=int, default=4,
                         help='concurrent decode slots (continuous '
                              'batching width)')
+    parser.add_argument('--quantize', default=None, choices=['int8'],
+                        help='weight-only int8 serving: halves the HBM '
+                             'weight traffic that bounds decode')
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -166,7 +171,8 @@ def main(argv=None) -> int:
     server = InferenceServer(args.model, max_seq_len=args.max_seq_len,
                              tokenizer=args.tokenizer,
                              checkpoint_dir=args.checkpoint_dir,
-                             num_slots=args.num_slots)
+                             num_slots=args.num_slots,
+                             quantize=args.quantize)
     server.warmup()
     web.run_app(server.make_app(), host='0.0.0.0', port=args.port,
                 handle_signals=False)

@@ -84,3 +84,27 @@ def set_nested(keys: Tuple[str, ...], value: Any) -> Dict[str, Any]:
 def to_dict() -> Dict[str, Any]:
     _ensure_loaded()
     return copy.deepcopy(_dict) if _dict else {}
+
+
+def write_user_config_key(keys: Tuple[str, ...], value: Any) -> str:
+    """Persist one nested key into the user config file (atomic write +
+    in-process reload). Returns the path written."""
+    with _lock:
+        path = os.path.expanduser(
+            os.environ.get(ENV_VAR_CONFIG, CONFIG_PATH))
+        config: Dict[str, Any] = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                config = yaml.safe_load(f) or {}
+        node = config
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = value
+        schemas.validate_config(config)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f'{path}.tmp-{os.getpid()}'
+        with open(tmp, 'w') as f:
+            yaml.safe_dump(config, f)
+        os.replace(tmp, path)
+        _load()
+        return path

@@ -28,6 +28,10 @@ def main(argv=None) -> int:
     parser.add_argument('--data-dir', default=None,
                         help='directory of SKYTOK token shards (*.bin); '
                         'omit for synthetic batches')
+    parser.add_argument('--sft-data', default=None,
+                        help='JSONL of pre-tokenized {"prompt", '
+                        '"completion"} examples; loss is masked to '
+                        'completion tokens (SFT)')
     parser.add_argument('--data-seed', type=int, default=0)
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=100)
@@ -37,6 +41,10 @@ def main(argv=None) -> int:
     parser.add_argument('--ep', type=int, default=None,
                         help='expert-parallel axis size (MoE models)')
     parser.add_argument('--log-every', type=int, default=10)
+    parser.add_argument('--profile-dir', default=None,
+                        help='capture an XLA/jax.profiler trace of steps '
+                        '2-4 into this directory (view with xprof/'
+                        'tensorboard)')
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO,
                         format='%(asctime)s %(levelname)s: %(message)s')
@@ -81,7 +89,20 @@ def main(argv=None) -> int:
     step_fn = make_train_step(cfg, mesh, shardings)
     callbacks.init(total_steps=args.steps)
     dataset = None
-    if args.data_dir:
+    if args.data_dir and args.sft_data:
+        raise SystemExit('--data-dir and --sft-data are mutually '
+                         'exclusive')
+    if args.sft_data:
+        from skypilot_tpu.train.data import SftJsonlDataset
+        dataset = SftJsonlDataset(args.sft_data, args.batch, args.seq,
+                                  host_rank=topology.host_rank,
+                                  num_hosts=topology.num_hosts,
+                                  seed=args.data_seed,
+                                  start_batch=start_step)
+        logger.info('sft data: %d examples/host',
+                    dataset.num_examples)
+        batch_for = lambda step: dataset.next_batch()  # noqa: E731
+    elif args.data_dir:
         from skypilot_tpu.train.data import TokenDataset
         dataset = TokenDataset(args.data_dir, args.batch, args.seq,
                                host_rank=topology.host_rank,
@@ -99,10 +120,31 @@ def main(argv=None) -> int:
         ]
         batch_for = lambda step: batches[step % len(batches)]  # noqa: E731
     loss = float('nan')
+    # Profile a small steady-state slice: step 2 (past compile+warmup)
+    # through step 4 — falling back to the first steps when the run is
+    # too short, so an explicit --profile-dir always yields a trace.
+    profile_start = start_step + 2
+    if profile_start >= args.steps:
+        profile_start = start_step
+    profile_stop = min(profile_start + 3, args.steps)
+    if args.profile_dir and profile_start >= args.steps:
+        logger.warning('--profile-dir given but no steps remain to '
+                       'profile (start_step=%d, steps=%d)', start_step,
+                       args.steps)
+    profiling = False
     with mesh:
         for step in range(start_step, args.steps):
+            if args.profile_dir and step == profile_start:
+                jax.profiler.start_trace(args.profile_dir)
+                profiling = True
             with callbacks.step():
                 state, metrics = step_fn(state, batch_for(step))
+            if profiling and step + 1 >= profile_stop:
+                jax.block_until_ready(metrics['loss'])
+                jax.profiler.stop_trace()
+                profiling = False
+                logger.info('profile trace written to %s',
+                            args.profile_dir)
             if manager is not None:
                 manager.save(step + 1, state)
             if step % args.log_every == 0 or step == args.steps - 1:
@@ -110,6 +152,9 @@ def main(argv=None) -> int:
                 logger.info('step %d/%d loss=%.4f grad_norm=%.3f', step,
                             args.steps, loss,
                             float(metrics['grad_norm']))
+    if profiling:  # --steps ended inside the profile window
+        jax.profiler.stop_trace()
+        logger.info('profile trace written to %s', args.profile_dir)
     if dataset is not None:
         dataset.close()
     if manager is not None:

@@ -176,6 +176,10 @@ CONFIG_SCHEMA: Dict[str, Any] = {
             },
         },
         'allowed_clouds': {'type': 'array', 'items': {'type': 'string'}},
+        # Persisted opt-in for the test-only fake cloud (`skytpu local up
+        # --fake` writes it; clouds/fake.py honors it alongside the env
+        # var so a later `skytpu check` doesn't silently undo local-up).
+        'fake_cloud_enabled': {'type': 'boolean'},
         'usage': {
             'type': 'object',
             'additionalProperties': False,

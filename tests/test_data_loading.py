@@ -164,6 +164,80 @@ class TestLoader:
         assert ds.num_windows == 2 * (4095 // 32)
 
 
+class TestSftDataset:
+
+    def _write(self, tmp_path, examples):
+        import json
+        p = tmp_path / 'sft.jsonl'
+        with open(p, 'w', encoding='utf-8') as f:
+            for prompt, completion in examples:
+                f.write(json.dumps({'prompt': prompt,
+                                    'completion': completion}) + '\n')
+        return str(p)
+
+    def test_mask_covers_exactly_completion_targets(self, tmp_path):
+        path = self._write(tmp_path, [([1, 2, 3], [4, 5])] * 2)
+        ds = data_lib.SftJsonlDataset(path, batch_size=2, seq_len=8)
+        b = ds.next_batch()
+        row, mask = b['inputs'][0], b['mask'][0]
+        np.testing.assert_array_equal(row[:5], [1, 2, 3, 4, 5])
+        # Targets at positions 2,3 are tokens 4,5 (the completion);
+        # everything else — prompt predictions and padding — is masked.
+        np.testing.assert_array_equal(mask, [0, 0, 1, 1, 0, 0, 0, 0])
+        np.testing.assert_array_equal(b['targets'][0][2:4], [4, 5])
+
+    def test_truncation_keeps_partial_completion(self, tmp_path):
+        path = self._write(tmp_path, [([1, 2, 3, 4], [5, 6, 7, 8])] * 1)
+        ds = data_lib.SftJsonlDataset(path, batch_size=1, seq_len=5)
+        b = ds.next_batch()
+        # Window = 6 tokens: [1,2,3,4,5,6]; completion targets at
+        # positions 3,4 (tokens 5,6).
+        np.testing.assert_array_equal(b['mask'][0], [0, 0, 0, 1, 1])
+
+    def test_prompt_longer_than_window_all_masked(self, tmp_path):
+        path = self._write(tmp_path, [(list(range(20)), [99])] * 1)
+        ds = data_lib.SftJsonlDataset(path, batch_size=1, seq_len=5)
+        b = ds.next_batch()
+        assert b['mask'][0].sum() == 0
+
+    def test_epoch_determinism_and_resume(self, tmp_path):
+        path = self._write(
+            tmp_path, [([i], [i + 100, i + 200]) for i in range(16)])
+        kw = dict(batch_size=4, seq_len=8, seed=3)
+        ds = data_lib.SftJsonlDataset(path, **kw)
+        for _ in range(2):
+            ds.next_batch()
+        expected = ds.next_batch()
+        resumed = data_lib.SftJsonlDataset(path, start_batch=2, **kw)
+        got = resumed.next_batch()
+        np.testing.assert_array_equal(got['inputs'], expected['inputs'])
+
+    def test_host_sharding_splits_examples(self, tmp_path):
+        path = self._write(
+            tmp_path, [([i], [i + 100]) for i in range(8)])
+        a = data_lib.SftJsonlDataset(path, batch_size=2, seq_len=4,
+                                     host_rank=0, num_hosts=2)
+        b = data_lib.SftJsonlDataset(path, batch_size=2, seq_len=4,
+                                     host_rank=1, num_hosts=2)
+        assert a.num_examples == b.num_examples == 4
+
+    def test_empty_completion_rejected(self, tmp_path):
+        path = self._write(tmp_path, [([1], [])])
+        with pytest.raises(ValueError, match='empty completion'):
+            data_lib.SftJsonlDataset(path, batch_size=1, seq_len=4)
+
+    def test_trainer_sft_smoke(self, tmp_path):
+        path = self._write(
+            tmp_path,
+            [([i % 50, i % 7], [i % 11 + 50, i % 13 + 100])
+             for i in range(16)])
+        from skypilot_tpu.train import run as train_run
+        rc = train_run.main([
+            '--model', 'test-tiny', '--batch', '8', '--seq', '32',
+            '--steps', '2', '--sft-data', path, '--log-every', '1'])
+        assert rc == 0
+
+
 class TestTrainerIntegration:
 
     def test_train_run_with_data_dir(self, tmp_path):
@@ -174,3 +248,16 @@ class TestTrainerIntegration:
             '--steps', '2', '--data-dir', str(tmp_path),
             '--log-every', '1'])
         assert rc == 0
+
+    def test_train_run_profile_writes_trace(self, tmp_path):
+        import glob as glob_lib
+        prof = tmp_path / 'prof'
+        from skypilot_tpu.train import run as train_run
+        rc = train_run.main([
+            '--model', 'test-tiny', '--batch', '8', '--seq', '32',
+            '--steps', '4', '--profile-dir', str(prof),
+            '--log-every', '1'])
+        assert rc == 0
+        traces = glob_lib.glob(str(prof / '**' / '*.xplane.pb'),
+                               recursive=True)
+        assert traces, 'no xplane trace written'

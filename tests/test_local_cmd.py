@@ -56,6 +56,23 @@ def test_local_down_tears_down_local_clusters(runner):
                    for r in global_user_state.get_clusters())
 
 
+def test_local_up_fake_survives_check(runner):
+    """The --fake opt-in must persist beyond this process's env: a later
+    `skytpu check` (fresh process, no SKYTPU_ENABLE_FAKE_CLOUD) must not
+    silently disable the fake backend again."""
+    import os
+    runner.invoke(cli_mod.cli, ['local', 'up', '--fake'],
+                  catch_exceptions=False)
+    os.environ.pop('SKYTPU_ENABLE_FAKE_CLOUD', None)
+    from skypilot_tpu import check as check_lib
+    enabled = check_lib.check(quiet=True)
+    assert 'fake' in enabled
+    runner.invoke(cli_mod.cli, ['local', 'down', '-y'],
+                  catch_exceptions=False)
+    enabled = check_lib.check(quiet=True)
+    assert 'fake' not in enabled
+
+
 def test_local_up_help_in_cli(runner):
     result = runner.invoke(cli_mod.cli, ['--help'],
                            catch_exceptions=False)

@@ -1,0 +1,133 @@
+"""Weight-only int8 serving: quantized params must reproduce float
+logits closely, halve kernel bytes, and decode correctly through the
+KV-cache engine.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import Transformer, get_config
+from skypilot_tpu.models.inference import InferenceEngine
+from skypilot_tpu.models.quantize import quantize_kernel, quantize_params
+
+
+def _cfg(**kw):
+    cfg = get_config('test-tiny')
+    return dataclasses.replace(cfg, dtype='float32',
+                               param_dtype='float32', max_seq_len=64,
+                               remat=False, **kw)
+
+
+class TestQuantizeKernel:
+
+    def test_round_trip_error_bounded(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
+        q, scale = quantize_kernel(w, input_ndim=1, feature_ndim=1)
+        assert q.dtype == jnp.int8 and scale.shape == (128,)
+        deq = q.astype(jnp.float32) * scale[None, :]
+        err = jnp.abs(deq - w).max() / jnp.abs(w).max()
+        assert float(err) < 1.0 / 127 + 1e-3
+
+    def test_stacked_layers_get_per_layer_scales(self):
+        w = jax.random.normal(jax.random.PRNGKey(1), (3, 64, 8, 16))
+        q, scale = quantize_kernel(w, input_ndim=1, feature_ndim=2)
+        assert q.shape == w.shape
+        assert scale.shape == (3, 8, 16)      # layers dim preserved
+
+    def test_extreme_channel_isolated(self):
+        """A huge outlier in one output channel must not degrade other
+        channels (per-channel scales)."""
+        w = jnp.ones((32, 4)).at[:, 0].mul(1000.0)
+        q, scale = quantize_kernel(w, 1, 1)
+        deq = q.astype(jnp.float32) * scale[None, :]
+        np.testing.assert_allclose(np.asarray(deq[:, 1:]),
+                                   np.asarray(w[:, 1:]), rtol=0.02)
+
+
+class TestQuantizedModel:
+
+    def _float_and_quant(self, cfg_kw=None):
+        cfg = _cfg(**(cfg_kw or {}))
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0,
+                                    cfg.vocab_size, jnp.int32)
+        from flax.core import meta
+        fparams = meta.unbox(
+            Transformer(cfg).init(jax.random.PRNGKey(1), tokens)['params'])
+        qcfg = dataclasses.replace(cfg, weight_quant='int8')
+        qparams = quantize_params(fparams, qcfg)
+        return cfg, qcfg, fparams, qparams, tokens
+
+    def test_param_tree_rewritten(self):
+        _, _, fparams, qparams, _ = self._float_and_quant()
+        attn = qparams['layers']['layer']['attn']
+        assert 'kernel_q' in attn['q_proj']
+        assert attn['q_proj']['kernel_q'].dtype == jnp.int8
+        assert 'kernel' not in attn['q_proj']
+        # Non-dense params untouched.
+        np.testing.assert_array_equal(
+            np.asarray(qparams['embed']['embedding']),
+            np.asarray(fparams['embed']['embedding']))
+
+    def test_logits_close_to_float(self):
+        cfg, qcfg, fparams, qparams, tokens = self._float_and_quant()
+        f = Transformer(cfg).apply({'params': fparams}, tokens)
+        q = Transformer(qcfg).apply({'params': qparams}, tokens)
+        assert q.shape == f.shape
+        # Weight-only int8: logits stay close; argmax mostly agrees.
+        f32, q32 = np.asarray(f, np.float32), np.asarray(q, np.float32)
+        denom = np.abs(f32).max()
+        assert np.abs(q32 - f32).max() / denom < 0.12
+        agree = (f32.argmax(-1) == q32.argmax(-1)).mean()
+        assert agree > 0.9
+
+    def test_kernel_bytes_halved(self):
+        _, _, fparams, qparams, _ = self._float_and_quant()
+
+        def kernel_bytes(tree, key):
+            total = 0
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    tree)[0]:
+                if any(getattr(k, 'key', '') == key for k in path):
+                    total += leaf.size * leaf.dtype.itemsize
+            return total
+
+        fb = kernel_bytes(fparams, 'kernel')
+        qb = kernel_bytes(qparams, 'kernel_q')
+        assert qb * 3.5 < fb  # fp32 → int8: 4x smaller
+
+    def test_engine_generates_with_quantize(self):
+        cfg = _cfg()
+        eng = InferenceEngine(cfg, batch_size=1, quantize='int8')
+        assert eng.cfg.weight_quant == 'int8'
+        out, stats = eng.generate(jnp.asarray([[5, 7, 11]], jnp.int32),
+                                  max_new_tokens=6)
+        assert out.shape == (1, 6)
+        assert stats['new_tokens'] == 6
+
+    def test_quantized_decode_matches_quantized_full(self):
+        cfg, qcfg, _, qparams, tokens = self._float_and_quant()
+        del cfg
+        # Build the engine directly from the quant cfg+params.
+        eng = InferenceEngine(
+            dataclasses.replace(qcfg, decode=False), params=qparams,
+            batch_size=1)
+        full = Transformer(dataclasses.replace(eng.cfg, decode=False)
+                           ).apply({'params': qparams}, tokens[:1])
+        cache = eng.init_cache()
+        logits, _ = eng._prefill(  # pylint: disable=protected-access
+            eng.params, cache, tokens[:1], prompt_len=16)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, -1, :]), atol=2e-4,
+                                   rtol=2e-4)
+
+    def test_moe_rejected(self):
+        cfg = get_config('test-tiny-moe')
+        with pytest.raises(NotImplementedError, match='MoE'):
+            quantize_params({}, cfg)
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match='quantize'):
+            InferenceEngine(_cfg(), quantize='int4')
