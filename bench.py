@@ -46,9 +46,14 @@ def _parse_args(argv=None):
                         help='tiny model, few steps (smoke)')
     parser.add_argument('--serve', action='store_true',
                         help='also measure inference p50 TTFT')
+    parser.add_argument('--quantize', default=None, choices=['int8'],
+                        help='with --serve: int8 weight-only engine')
     parser.add_argument('--worker', action='store_true',
                         help='run the measurement directly (no supervisor)')
-    return parser.parse_args(argv)
+    args = parser.parse_args(argv)
+    if args.quantize and not args.serve:
+        parser.error('--quantize only applies to the --serve measurement')
+    return args
 
 
 def _env_diagnostics() -> str:
@@ -108,13 +113,14 @@ def _supervise(argv) -> int:
     return 1
 
 
-def _measure_ttft(cfg, mesh) -> dict:
+def _measure_ttft(cfg, mesh, quantize=None) -> dict:
     """p50 time-to-first-token under concurrent requests on the local
     chip(s) via the continuous-batching engine (models/inference.py) —
     the BASELINE.md serving row."""
     from skypilot_tpu.models import inference as inference_lib
     engine = inference_lib.ContinuousBatchingEngine(cfg, num_slots=4,
-                                                    mesh=mesh)
+                                                    mesh=mesh,
+                                                    quantize=quantize)
     prompt = list(range(1, 33))
     # Warmup: compile prefill + decode.
     engine.generate(prompt, max_new_tokens=4)
@@ -202,7 +208,7 @@ def _worker(args) -> int:
         del state, batches, step_fn
         serve_cfg = get_config('test-tiny' if (args.quick or not on_tpu)
                                else args.model, param_dtype='bfloat16')
-        ttft = _measure_ttft(serve_cfg, mesh)
+        ttft = _measure_ttft(serve_cfg, mesh, quantize=args.quantize)
         print(f'serve: {ttft}', file=sys.stderr)
         result.update(ttft)
     print(json.dumps(result))

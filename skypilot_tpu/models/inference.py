@@ -61,8 +61,11 @@ def _resolve_cfg_and_params(cfg: 'ModelConfig | str',
         logger.info('Initializing random weights for %s', cfg.name)
         init_cfg = dataclasses.replace(cfg, decode=False,
                                        weight_quant='none')
+        # jit the whole init: unjitted flax init dispatches hundreds of
+        # small ops one by one — on a remote/tunneled device each pays a
+        # round trip and a 1B-model bring-up stretches to many minutes.
         params = nn.unbox(
-            Transformer(init_cfg).init(
+            jax.jit(Transformer(init_cfg).init)(
                 jax.random.PRNGKey(rng_seed),
                 jnp.ones((1, 8), jnp.int32)))['params']
     if quantize:
@@ -86,10 +89,16 @@ class InferenceEngine:
                  batch_size: int = 1,
                  max_seq_len: Optional[int] = None,
                  rng_seed: int = 0,
-                 quantize: Optional[str] = None) -> None:
+                 quantize: Optional[str] = None,
+                 decode_chunk: int = 1) -> None:
         self.cfg, self.params = _resolve_cfg_and_params(
             cfg, params, max_seq_len, rng_seed, quantize)
         self.batch_size = batch_size
+        # >1 ⇒ generate() emits this many tokens per device dispatch
+        # (lax.scan inside one jit): fewer host↔device round trips —
+        # the dominant per-token cost on remote/tunneled chips — at the
+        # price of EOS being honored at chunk granularity.
+        self.decode_chunk = max(1, decode_chunk)
         self.model = Transformer(self.cfg)
         self._rng = jax.random.PRNGKey(rng_seed)
 
@@ -97,6 +106,9 @@ class InferenceEngine:
                                 static_argnames=('prompt_len',))
         self._decode_step = jax.jit(self._decode_impl,
                                     donate_argnames=('cache',))
+        self._decode_chunk_fn = jax.jit(
+            self._decode_chunk_impl, donate_argnames=('cache',),
+            static_argnames=('greedy',))
 
     # ---------------- cache ----------------
 
@@ -133,6 +145,26 @@ class InferenceEngine:
             mutable=['cache'])
         return logits[:, -1, :], mutated['cache']
 
+    def _decode_chunk_impl(self, params, cache, token, start_index, rngs,
+                           temperature, *, greedy: bool):
+        """K decode+sample steps in ONE dispatch (lax.scan), K = the
+        leading dim of `rngs`: returns ((B, K) tokens, cache). token:
+        (B,) the last emitted token; temperature is TRACED so
+        per-request temperatures never recompile (only greedy-vs-sampled
+        is static)."""
+        sampler = greedy_sample if greedy else temperature_sample
+
+        def body(carry, rng):
+            cache, token, index = carry
+            logits, cache = self._decode_impl(params, cache,
+                                              token[:, None], index)
+            nxt = sampler(logits, rng, temperature)
+            return (cache, nxt, index + 1), nxt
+
+        (cache, _, _), toks = jax.lax.scan(
+            body, (cache, token, start_index), rngs)
+        return toks.swapaxes(0, 1), cache  # (B, num_steps)
+
     # ---------------- generation ----------------
 
     def generate(self,
@@ -163,17 +195,54 @@ class InferenceEngine:
         token.block_until_ready()
         ttft = time.time() - t0
 
-        out = [token]
-        for step in range(1, max_new_tokens):
-            self._rng, rng = jax.random.split(self._rng)
-            logits, cache = self._decode_step(
-                self.params, cache, out[-1][:, None],
-                jnp.asarray(prompt_len + step - 1, jnp.int32))
-            token = sampler(logits, rng, temperature)
-            out.append(token)
-            if eos_id is not None and bool((token == eos_id).all()):
-                break
-        generated = jnp.stack(out, axis=1)
+        if self.decode_chunk > 1:
+            # Chunked: K tokens per dispatch. EOS honored at chunk
+            # granularity (the host truncates at the first all-EOS
+            # column after readback). The chunk size stays FIXED even on
+            # the final partial chunk when the cache window allows —
+            # overshoot is truncated on the host — so generate compiles
+            # exactly one scan program per engine.
+            chunks = [token[:, None]]
+            last = token
+            step = 1
+            done = False
+            while step < max_new_tokens and not done:
+                remaining = max_new_tokens - step
+                k = self.decode_chunk
+                if (k > remaining and
+                        prompt_len + step - 1 + k > self.cfg.max_seq_len):
+                    k = remaining
+                self._rng, sub = jax.random.split(self._rng)
+                rngs = jax.random.split(sub, k)
+                toks, cache = self._decode_chunk_fn(
+                    self.params, cache, last,
+                    jnp.asarray(prompt_len + step - 1, jnp.int32), rngs,
+                    jnp.asarray(temperature, jnp.float32),
+                    greedy=temperature <= 0)
+                toks = toks[:, :remaining]
+                if eos_id is not None:
+                    cols = jax.device_get(toks)
+                    for c in range(cols.shape[1]):
+                        if (cols[:, c] == eos_id).all():
+                            toks = toks[:, :c + 1]
+                            done = True
+                            break
+                chunks.append(toks)
+                last = toks[:, -1]
+                step += int(toks.shape[1])
+            generated = jnp.concatenate(chunks, axis=1)
+        else:
+            out = [token]
+            for step in range(1, max_new_tokens):
+                self._rng, rng = jax.random.split(self._rng)
+                logits, cache = self._decode_step(
+                    self.params, cache, out[-1][:, None],
+                    jnp.asarray(prompt_len + step - 1, jnp.int32))
+                token = sampler(logits, rng, temperature)
+                out.append(token)
+                if eos_id is not None and bool((token == eos_id).all()):
+                    break
+            generated = jnp.stack(out, axis=1)
         generated.block_until_ready()
         total = time.time() - t0
         num_tokens = int(generated.shape[1])

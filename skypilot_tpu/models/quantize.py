@@ -79,4 +79,7 @@ def quantize_params(params: Any, cfg: ModelConfig) -> Any:
                 out[name] = walk(sub)
         return out
 
-    return walk(params)
+    # One jitted dispatch for the whole tree: eager per-leaf quantize
+    # costs a device round trip per op, which dominates on tunneled
+    # devices.
+    return jax.jit(walk)(params)

@@ -93,6 +93,52 @@ class TestKVCacheCorrectness:
         assert len(outs) > 1  # hot sampling should not collapse
 
 
+class TestChunkedDecode:
+    """decode_chunk>1 runs K decode steps per device dispatch (lax.scan
+    in one jit) — it must emit exactly the same greedy tokens as the
+    step-at-a-time path."""
+
+    def test_chunked_matches_stepwise_greedy(self):
+        prompt = jnp.asarray([[5, 7, 11, 13]], jnp.int32)
+        base = InferenceEngine(_cfg(), batch_size=1)
+        want, _ = base.generate(prompt, max_new_tokens=12)
+        chunked = InferenceEngine(_cfg(), batch_size=1, decode_chunk=5)
+        got, stats = chunked.generate(prompt, max_new_tokens=12)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert stats['new_tokens'] == 12
+
+    def test_chunked_partial_final_chunk_exact_length(self):
+        """max_new_tokens not a multiple of the chunk: the host truncates
+        the overshoot and the output length is exact."""
+        prompt = jnp.asarray([[5, 7, 11]], jnp.int32)
+        eng = InferenceEngine(_cfg(), batch_size=1, decode_chunk=8)
+        got, stats = eng.generate(prompt, max_new_tokens=10)
+        assert np.asarray(got).shape == (1, 10)
+        assert stats['new_tokens'] == 10
+
+    def test_chunked_sampled_temperature_traced(self):
+        """Different temperatures must reuse the same compiled chunk
+        program (temperature is a traced operand, not a static arg)."""
+        prompt = jnp.asarray([[5, 7, 11]], jnp.int32)
+        eng = InferenceEngine(_cfg(), batch_size=1, decode_chunk=4)
+        eng.generate(prompt, max_new_tokens=8, temperature=0.7)
+        before = eng._decode_chunk_fn._cache_size()  # pylint: disable=protected-access
+        eng.generate(prompt, max_new_tokens=8, temperature=1.3)
+        assert eng._decode_chunk_fn._cache_size() == before  # pylint: disable=protected-access
+
+    def test_chunked_eos_truncates(self):
+        prompt = jnp.asarray([[5, 7, 11]], jnp.int32)
+        base = InferenceEngine(_cfg(), batch_size=1)
+        ref, _ = base.generate(prompt, max_new_tokens=12)
+        eos = int(np.asarray(ref)[0, 4])  # force EOS at step 5
+        chunked = InferenceEngine(_cfg(), batch_size=1, decode_chunk=4)
+        got, _ = chunked.generate(prompt, max_new_tokens=12, eos_id=eos)
+        got = np.asarray(got)
+        # Truncated at the first all-EOS column, within one chunk of it.
+        assert got.shape[1] <= 8
+        assert (got[:, -1] == eos).all() or got.shape[1] == 12
+
+
 class TestContinuousBatching:
 
     @pytest.fixture(scope='class')
