@@ -211,8 +211,11 @@ GEMMA_7B = _register(ModelConfig(
     scale_embed_by_dim=True))
 
 # Gemma-2 adds attention/final logit softcaps (tanh-capped on the XLA
-# attention path; Gemma-2's interleaved sliding-window layers are not
-# modeled — full causal attention everywhere, a strict superset window).
+# attention path). Approximations vs the released architecture: the
+# interleaved sliding-window layers are not modeled (full causal
+# attention everywhere — a strict superset window) and the per-block
+# POST-norms are omitted (pre-norm only), so released Gemma-2 weights
+# are not load-compatible; gemma-1 weights are (tests/test_convert.py).
 GEMMA2_9B = _register(ModelConfig(
     name='gemma2-9b', vocab_size=256128, d_model=3584, num_layers=42,
     num_heads=16, num_kv_heads=8, d_mlp=14336, max_seq_len=8192,

@@ -1,0 +1,215 @@
+"""HuggingFace checkpoint → skypilot_tpu param tree.
+
+A user switching from the reference arrives with HF checkpoints (the
+reference's recipes pull them for vLLM/torchtune — SURVEY §2.9); this
+module maps the `transformers` state_dicts of the supported families
+onto the mesh-first Transformer's param tree:
+
+    Llama / Mistral / Qwen2  (LlamaForCausalLM-shaped keys, QKV bias ok)
+    Gemma / Gemma-2          (same keys; (1+w)-norm deltas map directly)
+    GPT-2                    (Conv1D [in,out] weights, combined c_attn)
+    Mixtral                  (block_sparse_moe expert stacks)
+
+Conventions verified against the HF implementations:
+- torch Linear stores [out, in] → our kernels are the transpose.
+- GPT-2 Conv1D already stores [in, out] → no transpose.
+- Rotary embeddings: both sides use the non-interleaved (GPT-NeoX)
+  half-split convention with inv_freq = theta^(-2i/d), so Q/K map with
+  no permutation (pinned by the cross-framework logit-parity tests,
+  tests/test_convert.py).
+- Tied unembeds (Gemma, GPT-2) load the embedding once.
+- Vocab padding (e.g. GPT-2 50257 → 50304 for MXU tiling) zero-fills
+  the extra rows.
+
+Everything is numpy on the host; shard/device placement happens when
+the caller feeds the tree into a jitted step with shardings.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+from skypilot_tpu.models.configs import ModelConfig
+
+logger = logging.getLogger(__name__)
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, 'detach'):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _pad_vocab(w: np.ndarray, vocab: int) -> np.ndarray:
+    """Zero-pad embedding/unembed rows up to cfg.vocab_size."""
+    if w.shape[0] == vocab:
+        return w
+    if w.shape[0] > vocab:
+        raise ValueError(f'checkpoint vocab {w.shape[0]} exceeds config '
+                         f'vocab {vocab}')
+    pad = np.zeros((vocab - w.shape[0], w.shape[1]), w.dtype)
+    return np.concatenate([w, pad], axis=0)
+
+
+def from_hf(state_dict: Mapping[str, Any],
+            cfg: ModelConfig) -> Dict[str, Any]:
+    """HF state_dict → param tree matching Transformer(cfg) with
+    scan_layers=True (per-layer tensors stacked on a leading axis)."""
+    if not cfg.scan_layers:
+        raise NotImplementedError('from_hf targets the scanned layout; '
+                                  'use scan_layers=True')
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    gpt2 = cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain'
+    if gpt2:
+        params, layer = _gpt2_top(sd, cfg), _gpt2_layer
+    else:
+        params, layer = _llama_top(sd, cfg), _llama_layer
+    per_layer = [layer(sd, cfg, i) for i in range(cfg.num_layers)]
+    import jax
+    params['layers'] = {
+        'layer': jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs, axis=0), *per_layer)
+    }
+    return params
+
+
+def load_hf_model(hf_model, cfg: ModelConfig) -> Dict[str, Any]:
+    """Convenience: convert a live transformers model."""
+    return from_hf(hf_model.state_dict(), cfg)
+
+
+def load_hf_checkpoint(path: str, cfg: ModelConfig) -> Dict[str, Any]:
+    """Load a LOCAL HF checkpoint dir and convert it, casting to
+    cfg.param_dtype. The one entry point serve/server.py and
+    train/run.py share — cfg must already carry any max_seq_len
+    override, since conversion validates/slices position tables
+    against it."""
+    import jax.numpy as jnp
+    import transformers
+    hf = transformers.AutoModelForCausalLM.from_pretrained(path)
+    params = load_hf_model(hf, cfg)
+    del hf
+    # jnp.dtype resolves extension dtypes (bfloat16) numpy alone lacks.
+    dtype = jnp.dtype(cfg.param_dtype)
+    return {k: _cast_tree(v, dtype) for k, v in params.items()}
+
+
+def _cast_tree(tree, dtype):
+    if isinstance(tree, dict):
+        return {k: _cast_tree(v, dtype) for k, v in tree.items()}
+    return np.asarray(tree, dtype)
+
+
+# ---------------- Llama-family (Llama/Mistral/Qwen2/Gemma/Mixtral) ----
+
+
+def _llama_top(sd, cfg: ModelConfig) -> Dict[str, Any]:
+    embed = _pad_vocab(sd['model.embed_tokens.weight'], cfg.vocab_size)
+    params: Dict[str, Any] = {
+        'embed': {'embedding': embed},
+        'final_norm': {'scale': sd['model.norm.weight']},
+    }
+    if not cfg.tie_embeddings:
+        params['lm_head'] = {
+            'kernel': _pad_vocab(sd['lm_head.weight'], cfg.vocab_size).T}
+    return params
+
+
+def _llama_layer(sd, cfg: ModelConfig, i: int) -> Dict[str, Any]:
+    p = f'model.layers.{i}.'
+    d, nh, nkv, hd = (cfg.d_model, cfg.num_heads, cfg.num_kv_heads,
+                      cfg.head_dim)
+
+    def proj(name, heads):
+        w = sd[p + f'self_attn.{name}.weight']      # (heads*hd, d)
+        out = {'kernel': w.T.reshape(d, heads, hd)}
+        if cfg.qkv_bias:
+            out['bias'] = sd[p + f'self_attn.{name}.bias'].reshape(
+                heads, hd)
+        return out
+
+    attn = {
+        'q_proj': proj('q_proj', nh),
+        'k_proj': proj('k_proj', nkv),
+        'v_proj': proj('v_proj', nkv),
+        'o_proj': {
+            'kernel':
+                sd[p + 'self_attn.o_proj.weight'].T.reshape(nh, hd, d)},
+    }
+    layer = {
+        'attn_norm': {'scale': sd[p + 'input_layernorm.weight']},
+        'attn': attn,
+        'mlp_norm': {'scale': sd[p + 'post_attention_layernorm.weight']},
+    }
+    if cfg.is_moe:
+        e = cfg.num_experts
+        moe = p + 'block_sparse_moe.'
+        layer['moe'] = {
+            'router': sd[moe + 'gate.weight'].T,            # (d, e)
+            'w_gate': np.stack([
+                sd[moe + f'experts.{j}.w1.weight'].T for j in range(e)]),
+            'w_up': np.stack([
+                sd[moe + f'experts.{j}.w3.weight'].T for j in range(e)]),
+            'w_down': np.stack([
+                sd[moe + f'experts.{j}.w2.weight'].T for j in range(e)]),
+        }
+    else:
+        layer['mlp'] = {
+            'gate_proj': {'kernel': sd[p + 'mlp.gate_proj.weight'].T},
+            'up_proj': {'kernel': sd[p + 'mlp.up_proj.weight'].T},
+            'down_proj': {'kernel': sd[p + 'mlp.down_proj.weight'].T},
+        }
+    return layer
+
+
+# ---------------- GPT-2 ----------------------------------------------
+
+
+def _gpt2_top(sd, cfg: ModelConfig) -> Dict[str, Any]:
+    wpe = sd['transformer.wpe.weight']
+    if wpe.shape[0] < cfg.max_seq_len:
+        raise ValueError(f'checkpoint supports {wpe.shape[0]} positions '
+                         f'< max_seq_len {cfg.max_seq_len}')
+    return {
+        'embed': {'embedding': _pad_vocab(sd['transformer.wte.weight'],
+                                          cfg.vocab_size)},
+        'pos_embed': {'embedding': wpe[:cfg.max_seq_len]},
+        'final_norm': {'scale': sd['transformer.ln_f.weight'],
+                       'bias': sd['transformer.ln_f.bias']},
+    }
+
+
+def _gpt2_layer(sd, cfg: ModelConfig, i: int) -> Dict[str, Any]:
+    p = f'transformer.h.{i}.'
+    d, nh, hd = cfg.d_model, cfg.num_heads, cfg.head_dim
+    # Conv1D stores [in, out]; c_attn packs q,k,v along out.
+    w = sd[p + 'attn.c_attn.weight']                 # (d, 3d)
+    b = sd[p + 'attn.c_attn.bias']                   # (3d,)
+    wq, wk, wv = np.split(w, 3, axis=1)
+    bq, bk, bv = np.split(b, 3)
+    attn = {
+        'q_proj': {'kernel': wq.reshape(d, nh, hd),
+                   'bias': bq.reshape(nh, hd)},
+        'k_proj': {'kernel': wk.reshape(d, nh, hd),
+                   'bias': bk.reshape(nh, hd)},
+        'v_proj': {'kernel': wv.reshape(d, nh, hd),
+                   'bias': bv.reshape(nh, hd)},
+        'o_proj': {'kernel': sd[p + 'attn.c_proj.weight'].reshape(
+            nh, hd, d),
+                   'bias': sd[p + 'attn.c_proj.bias']},
+    }
+    return {
+        'attn_norm': {'scale': sd[p + 'ln_1.weight'],
+                      'bias': sd[p + 'ln_1.bias']},
+        'attn': attn,
+        'mlp_norm': {'scale': sd[p + 'ln_2.weight'],
+                     'bias': sd[p + 'ln_2.bias']},
+        'mlp': {
+            'up_proj': {'kernel': sd[p + 'mlp.c_fc.weight'],
+                        'bias': sd[p + 'mlp.c_fc.bias']},
+            'down_proj': {'kernel': sd[p + 'mlp.c_proj.weight'],
+                          'bias': sd[p + 'mlp.c_proj.bias']},
+        },
+    }

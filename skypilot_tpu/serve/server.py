@@ -49,15 +49,30 @@ class InferenceServer:
     def __init__(self, model: str, max_seq_len: Optional[int] = None,
                  tokenizer: str = 'byte',
                  checkpoint_dir: Optional[str] = None,
+                 hf_model_path: Optional[str] = None,
                  num_slots: int = 4,
                  quantize: Optional[str] = None) -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
+        if checkpoint_dir and hf_model_path:
+            raise ValueError('--checkpoint-dir and --hf-model-path are '
+                             'mutually exclusive')
         params = None
         if checkpoint_dir:
             params = load_params_from_checkpoint(get_config(model),
                                                  checkpoint_dir)
+        elif hf_model_path:
+            # A local HF checkpoint dir (safetensors): convert into the
+            # mesh-first tree. The cfg carries the max_seq_len override
+            # so the converter validates position tables against what
+            # the engine will actually run with.
+            from skypilot_tpu.models.convert import load_hf_checkpoint
+            cfg = get_config(model)
+            if max_seq_len is not None:
+                import dataclasses
+                cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
+            params = load_hf_checkpoint(hf_model_path, cfg)
         # Continuous batching: requests stream into free decode slots, so
         # concurrent requests interleave instead of queueing behind each
         # other (the old engine serialized behind an asyncio lock).
@@ -157,6 +172,9 @@ def main(argv=None) -> int:
     parser.add_argument('--tokenizer', default='byte')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='Orbax checkpoint dir (train/run.py output).')
+    parser.add_argument('--hf-model-path', default=None,
+                        help='local HuggingFace checkpoint dir; '
+                        'converted at load (models/convert.py)')
     parser.add_argument('--num-slots', type=int, default=4,
                         help='concurrent decode slots (continuous '
                              'batching width)')
@@ -171,6 +189,7 @@ def main(argv=None) -> int:
     server = InferenceServer(args.model, max_seq_len=args.max_seq_len,
                              tokenizer=args.tokenizer,
                              checkpoint_dir=args.checkpoint_dir,
+                             hf_model_path=args.hf_model_path,
                              num_slots=args.num_slots,
                              quantize=args.quantize)
     server.warmup()

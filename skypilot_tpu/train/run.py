@@ -35,6 +35,10 @@ def main(argv=None) -> int:
     parser.add_argument('--data-seed', type=int, default=0)
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=100)
+    parser.add_argument('--init-from-hf', default=None,
+                        help='local HuggingFace checkpoint dir to '
+                        'initialize params from (models/convert.py); an '
+                        'existing Orbax checkpoint still wins (resume)')
     parser.add_argument('--tp', type=int, default=None)
     parser.add_argument('--sp', type=int, default=None)
     parser.add_argument('--dp', type=int, default=None)
@@ -84,6 +88,20 @@ def main(argv=None) -> int:
             args.checkpoint_dir,
             save_interval_steps=args.checkpoint_every)
         state, start_step = manager.maybe_restore(state)
+    if args.init_from_hf and start_step == 0:
+        # Fine-tune from a local HF checkpoint: convert on host, place
+        # each leaf straight onto its mesh sharding. Skipped entirely on
+        # preemption resume (start_step > 0) — the Orbax restore already
+        # holds the fine-tuned params, and re-converting a multi-GB HF
+        # checkpoint only to discard it is dead work.
+        from skypilot_tpu.models.convert import load_hf_checkpoint
+        hf_params = load_hf_checkpoint(args.init_from_hf, cfg)
+        placed = jax.tree.map(
+            lambda x, s: jax.device_put(x, s),
+            hf_params, shardings.params)
+        state = state.replace(params=placed)
+        logger.info('initialized params from HF checkpoint %s',
+                    args.init_from_hf)
 
     # 4. The step loop.
     step_fn = make_train_step(cfg, mesh, shardings)
