@@ -176,7 +176,8 @@ def _worker(args) -> int:
     # Cycle a few distinct batches so the loss stays an honest LM loss
     # instead of memorizing one batch.
     batches = [
-        synthetic_batch(jax.random.PRNGKey(i), batch, seq, cfg.vocab_size)
+        synthetic_batch(jax.random.PRNGKey(i), batch, seq,
+                        cfg.unpadded_vocab_size or cfg.vocab_size)
         for i in range(4)
     ]
 

@@ -60,6 +60,12 @@ class ModelConfig:
     # channel fp32 scales halve that traffic (models/quantize.py converts
     # a float checkpoint; training always runs float).
     weight_quant: str = 'none'
+    # When vocab_size is padded for MXU tiling (e.g. GPT-2 50257→50304),
+    # the REAL vocabulary size: logits beyond it are masked to -inf so
+    # temperature sampling can never emit an invalid token id (padded
+    # embedding rows are zeros, which would otherwise score ~0 — often
+    # above real tokens). 0 ⇒ no padding.
+    unpadded_vocab_size: int = 0
     # MoE (0 ⇒ dense SwiGLU MLP).
     num_experts: int = 0
     experts_per_token: int = 2
@@ -252,14 +258,14 @@ GPT2_124M = _register(ModelConfig(
     num_heads=12, num_kv_heads=12, d_mlp=3072, max_seq_len=1024,
     mlp_activation='gelu', mlp_style='plain', norm_style='layernorm',
     pos_embedding='learned', qkv_bias=True, o_bias=True, mlp_bias=True,
-    tie_embeddings=True))
+    tie_embeddings=True, unpadded_vocab_size=50257))
 
 GPT2_1_5B = _register(ModelConfig(
     name='gpt2-1.5b', vocab_size=50304, d_model=1600, num_layers=48,
     num_heads=25, num_kv_heads=25, d_mlp=6400, max_seq_len=1024,
     mlp_activation='gelu', mlp_style='plain', norm_style='layernorm',
     pos_embedding='learned', qkv_bias=True, o_bias=True, mlp_bias=True,
-    tie_embeddings=True))
+    tie_embeddings=True, unpadded_vocab_size=50257))
 
 
 def get_config(name: str, **overrides) -> ModelConfig:

@@ -38,8 +38,32 @@ logger = logging.getLogger(__name__)
 
 def _np(t) -> np.ndarray:
     if hasattr(t, 'detach'):
-        t = t.detach().cpu().numpy()
+        t = t.detach().cpu()
+        if str(t.dtype) == 'torch.bfloat16':
+            # numpy has no bf16: widen first (users commonly hold HF
+            # weights as bf16 via torch_dtype='auto').
+            t = t.float()
+        t = t.numpy()
     return np.asarray(t)
+
+
+class _TrackedDict(dict):
+    """Records key reads so from_hf can prove it consumed every weight
+    (an architecturally incompatible checkpoint must fail loudly, not
+    silently drop tensors)."""
+
+    def __init__(self, d):
+        super().__init__(d)
+        self.used = set()
+
+    def __getitem__(self, k):
+        self.used.add(k)
+        return super().__getitem__(k)
+
+
+# Non-weight buffers HF state_dicts carry that have no place in the
+# param tree: rotary caches and GPT-2's causal-mask buffers.
+_IGNORABLE = ('rotary_emb.inv_freq', '.attn.bias', '.attn.masked_bias')
 
 
 def _pad_vocab(w: np.ndarray, vocab: int) -> np.ndarray:
@@ -60,7 +84,7 @@ def from_hf(state_dict: Mapping[str, Any],
     if not cfg.scan_layers:
         raise NotImplementedError('from_hf targets the scanned layout; '
                                   'use scan_layers=True')
-    sd = {k: _np(v) for k, v in state_dict.items()}
+    sd = _TrackedDict({k: _np(v) for k, v in state_dict.items()})
     gpt2 = cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain'
     if gpt2:
         params, layer = _gpt2_top(sd, cfg), _gpt2_layer
@@ -72,6 +96,17 @@ def from_hf(state_dict: Mapping[str, Any],
         'layer': jax.tree_util.tree_map(
             lambda *xs: np.stack(xs, axis=0), *per_layer)
     }
+    if cfg.tie_embeddings:
+        sd.used.add('lm_head.weight')  # tied alias of the embedding
+    leftover = sorted(
+        k for k in sd if k not in sd.used
+        and not any(k.endswith(s) or s in k for s in _IGNORABLE))
+    if leftover:
+        raise ValueError(
+            f'checkpoint has {len(leftover)} weight tensor(s) this '
+            f'architecture does not consume (incompatible checkpoint? '
+            f'e.g. Gemma-2 post-norms are not modeled): '
+            f'{leftover[:6]}{"..." if len(leftover) > 6 else ""}')
     return params
 
 
@@ -100,6 +135,164 @@ def _cast_tree(tree, dtype):
     if isinstance(tree, dict):
         return {k: _cast_tree(v, dtype) for k, v in tree.items()}
     return np.asarray(tree, dtype)
+
+
+def to_hf(params: Mapping[str, Any],
+          cfg: ModelConfig) -> Dict[str, np.ndarray]:
+    """Param tree → HF state_dict (numpy, float32) — the inverse of
+    from_hf, so a model fine-tuned here loads into `transformers` (and
+    therefore into anything that serves HF checkpoints). Round-trip and
+    HF-side logit parity are pinned in tests/test_convert.py.
+
+    GPT-2's packed-Conv1D layout is reconstructed; tied models emit the
+    embedding under both the embed and lm_head keys the way HF ties
+    them. Vocab padding rows (if any) are NOT stripped — pass the padded
+    vocab_size in the HF config or slice the two vocab tensors yourself.
+    """
+    p = {k: _cast_tree(v, np.float32) for k, v in params.items()}
+    layers = p['layers']['layer']
+    gpt2 = cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain'
+    sd: Dict[str, np.ndarray] = {}
+    if gpt2:
+        sd['transformer.wte.weight'] = p['embed']['embedding']
+        sd['transformer.wpe.weight'] = p['pos_embed']['embedding']
+        sd['transformer.ln_f.weight'] = p['final_norm']['scale']
+        sd['transformer.ln_f.bias'] = p['final_norm']['bias']
+        sd['lm_head.weight'] = p['embed']['embedding']
+        for i in range(cfg.num_layers):
+            li = jax_tree_index(layers, i)
+            pre = f'transformer.h.{i}.'
+            d = cfg.d_model
+            attn = li['attn']
+            wq = attn['q_proj']['kernel'].reshape(d, -1)
+            wk = attn['k_proj']['kernel'].reshape(d, -1)
+            wv = attn['v_proj']['kernel'].reshape(d, -1)
+            sd[pre + 'attn.c_attn.weight'] = np.concatenate(
+                [wq, wk, wv], axis=1)
+            sd[pre + 'attn.c_attn.bias'] = np.concatenate([
+                attn['q_proj']['bias'].reshape(-1),
+                attn['k_proj']['bias'].reshape(-1),
+                attn['v_proj']['bias'].reshape(-1)])
+            sd[pre + 'attn.c_proj.weight'] = \
+                attn['o_proj']['kernel'].reshape(-1, d)
+            sd[pre + 'attn.c_proj.bias'] = attn['o_proj']['bias']
+            sd[pre + 'ln_1.weight'] = li['attn_norm']['scale']
+            sd[pre + 'ln_1.bias'] = li['attn_norm']['bias']
+            sd[pre + 'ln_2.weight'] = li['mlp_norm']['scale']
+            sd[pre + 'ln_2.bias'] = li['mlp_norm']['bias']
+            sd[pre + 'mlp.c_fc.weight'] = li['mlp']['up_proj']['kernel']
+            sd[pre + 'mlp.c_fc.bias'] = li['mlp']['up_proj']['bias']
+            sd[pre + 'mlp.c_proj.weight'] = \
+                li['mlp']['down_proj']['kernel']
+            sd[pre + 'mlp.c_proj.bias'] = li['mlp']['down_proj']['bias']
+        return sd
+
+    sd['model.embed_tokens.weight'] = p['embed']['embedding']
+    sd['model.norm.weight'] = p['final_norm']['scale']
+    sd['lm_head.weight'] = (p['embed']['embedding']
+                            if cfg.tie_embeddings
+                            else p['lm_head']['kernel'].T)
+    d = cfg.d_model
+    for i in range(cfg.num_layers):
+        li = jax_tree_index(layers, i)
+        pre = f'model.layers.{i}.'
+        attn = li['attn']
+        sd[pre + 'input_layernorm.weight'] = li['attn_norm']['scale']
+        sd[pre + 'post_attention_layernorm.weight'] = \
+            li['mlp_norm']['scale']
+        for name in ('q_proj', 'k_proj', 'v_proj'):
+            sd[pre + f'self_attn.{name}.weight'] = \
+                attn[name]['kernel'].reshape(d, -1).T
+            if cfg.qkv_bias:
+                sd[pre + f'self_attn.{name}.bias'] = \
+                    attn[name]['bias'].reshape(-1)
+        sd[pre + 'self_attn.o_proj.weight'] = \
+            attn['o_proj']['kernel'].reshape(-1, d).T
+        if cfg.is_moe:
+            moe = li['moe']
+            sd[pre + 'block_sparse_moe.gate.weight'] = moe['router'].T
+            for j in range(cfg.num_experts):
+                sd[pre + f'block_sparse_moe.experts.{j}.w1.weight'] = \
+                    moe['w_gate'][j].T
+                sd[pre + f'block_sparse_moe.experts.{j}.w3.weight'] = \
+                    moe['w_up'][j].T
+                sd[pre + f'block_sparse_moe.experts.{j}.w2.weight'] = \
+                    moe['w_down'][j].T
+        else:
+            sd[pre + 'mlp.gate_proj.weight'] = \
+                li['mlp']['gate_proj']['kernel'].T
+            sd[pre + 'mlp.up_proj.weight'] = \
+                li['mlp']['up_proj']['kernel'].T
+            sd[pre + 'mlp.down_proj.weight'] = \
+                li['mlp']['down_proj']['kernel'].T
+    return sd
+
+
+def jax_tree_index(tree, i: int):
+    """Slice layer i out of a scan-stacked layer tree."""
+    if isinstance(tree, dict):
+        return {k: jax_tree_index(v, i) for k, v in tree.items()}
+    return np.asarray(tree)[i]
+
+
+def hf_config_for(cfg: ModelConfig):
+    """Build the matching transformers config (family chosen from the
+    same flags the forward pass branches on)."""
+    import transformers
+    if cfg.attn_logit_softcap or cfg.final_logit_softcap:
+        raise NotImplementedError(
+            'softcapped (Gemma-2-style) configs have no faithful HF '
+            'export: this architecture omits Gemma-2 post-norms, so '
+            'neither GemmaConfig nor Gemma2Config reproduces it')
+    if cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain':
+        return transformers.GPT2Config(
+            vocab_size=cfg.vocab_size, n_embd=cfg.d_model,
+            n_layer=cfg.num_layers, n_head=cfg.num_heads,
+            n_inner=cfg.d_mlp, n_positions=cfg.max_seq_len,
+            layer_norm_epsilon=cfg.norm_eps)
+    common = dict(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        intermediate_size=cfg.d_mlp, num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        max_position_embeddings=cfg.max_seq_len,
+        rope_theta=cfg.rope_theta, rms_norm_eps=cfg.norm_eps,
+        tie_word_embeddings=cfg.tie_embeddings)
+    if cfg.is_moe:
+        return transformers.MixtralConfig(
+            num_local_experts=cfg.num_experts,
+            num_experts_per_tok=cfg.experts_per_token, **common)
+    if cfg.norm_style == 'rms_plus1':
+        return transformers.GemmaConfig(head_dim=cfg.head_dim, **common)
+    if cfg.sliding_window:
+        return transformers.MistralConfig(
+            sliding_window=cfg.sliding_window, **common)
+    if cfg.qkv_bias:
+        return transformers.Qwen2Config(**common)
+    return transformers.LlamaConfig(**common)
+
+
+def export_hf_checkpoint(params: Mapping[str, Any], cfg: ModelConfig,
+                         out_dir: str) -> str:
+    """Write a loadable HF checkpoint dir (config + safetensors) from a
+    param tree — the "fine-tune on TPU, serve anywhere" exit ramp."""
+    import torch
+    import transformers
+    sd = {k: torch.tensor(np.ascontiguousarray(v))
+          for k, v in to_hf(params, cfg).items()}
+    model = transformers.AutoModelForCausalLM.from_config(
+        hf_config_for(cfg))
+    missing, unexpected = model.load_state_dict(sd, strict=False)
+    unexpected = [k for k in unexpected]
+    if unexpected:
+        raise ValueError(f'export produced unexpected keys: {unexpected}')
+    real_missing = [k for k in missing if 'inv_freq' not in k]
+    if real_missing:
+        raise ValueError(f'export left weights uninitialized: '
+                         f'{real_missing}')
+    model.save_pretrained(out_dir)
+    logger.info('exported HF checkpoint to %s', out_dir)
+    return out_dir
 
 
 # ---------------- Llama-family (Llama/Mistral/Qwen2/Gemma/Mixtral) ----

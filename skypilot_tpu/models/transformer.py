@@ -410,4 +410,10 @@ class Transformer(nn.Module):
             cap = cfg.final_logit_softcap
             logits = (cap * jnp.tanh(
                 logits.astype(jnp.float32) / cap)).astype(logits.dtype)
+        if 0 < cfg.unpadded_vocab_size < cfg.vocab_size:
+            # Tiling-padded vocab rows score ~0 (zero embeddings) —
+            # mask them so sampling can never emit an invalid id.
+            valid = jnp.arange(cfg.vocab_size) < cfg.unpadded_vocab_size
+            logits = jnp.where(valid[None, None, :], logits,
+                               jnp.asarray(-1e30, logits.dtype))
         return sharding.constrain(logits, 'batch', 'seq', 'vocab')

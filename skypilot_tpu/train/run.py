@@ -39,6 +39,9 @@ def main(argv=None) -> int:
                         help='local HuggingFace checkpoint dir to '
                         'initialize params from (models/convert.py); an '
                         'existing Orbax checkpoint still wins (resume)')
+    parser.add_argument('--export-hf', default=None,
+                        help='after training, write a loadable HF '
+                        'checkpoint dir (config + safetensors) here')
     parser.add_argument('--tp', type=int, default=None)
     parser.add_argument('--sp', type=int, default=None)
     parser.add_argument('--dp', type=int, default=None)
@@ -134,7 +137,8 @@ def main(argv=None) -> int:
     else:
         batches = [
             synthetic_batch(jax.random.PRNGKey(i), args.batch, args.seq,
-                            cfg.vocab_size) for i in range(8)
+                            cfg.unpadded_vocab_size or cfg.vocab_size)
+            for i in range(8)
         ]
         batch_for = lambda step: batches[step % len(batches)]  # noqa: E731
     loss = float('nan')
@@ -179,6 +183,13 @@ def main(argv=None) -> int:
         if manager.latest_step() != args.steps:
             manager.save(args.steps, state, force=True)
         manager.close()
+    if args.export_hf:
+        from skypilot_tpu.models.convert import export_hf_checkpoint
+        # to_hf casts to float32 itself — device_get only here, or a
+        # multi-GB bf16 tree would make two full fp32 host copies.
+        host_params = jax.tree.map(jax.device_get, state.params)
+        export_hf_checkpoint(host_params, cfg, args.export_hf)
+        logger.info('exported HF checkpoint to %s', args.export_hf)
     logger.info('done: %d steps, final loss %.4f', args.steps, loss)
     return 0
 

@@ -126,6 +126,26 @@ class TestGPT2:
                         norm_eps=1e-5)
         _logit_parity(model, cfg)
 
+    def test_padded_vocab_logits_masked(self):
+        """unpadded_vocab_size masks padding-id logits to -inf so
+        sampling can never emit an invalid id."""
+        hf_cfg = transformers.GPT2Config(
+            vocab_size=96, n_embd=48, n_layer=2, n_head=4,
+            n_positions=64, attn_implementation='eager')
+        model = transformers.GPT2LMHeadModel(hf_cfg)
+        cfg = _base_cfg(vocab_size=128, unpadded_vocab_size=96,
+                        d_model=48, num_heads=4, num_kv_heads=4,
+                        d_mlp=192, mlp_activation='gelu',
+                        mlp_style='plain', norm_style='layernorm',
+                        pos_embedding='learned', qkv_bias=True,
+                        o_bias=True, mlp_bias=True, tie_embeddings=True,
+                        norm_eps=1e-5)
+        params = load_hf_model(model, cfg)
+        logits = np.asarray(Transformer(cfg).apply(
+            {'params': params}, jnp.asarray([[1, 2, 3]], jnp.int32)))
+        assert (logits[..., 96:] < -1e29).all()
+        assert np.isfinite(logits[..., :96]).all()
+
     def test_gpt2_vocab_padding(self):
         """Converting into a padded-vocab config (50257-style → ×128)
         zero-fills the extra rows; real-token logits are unchanged."""
@@ -178,6 +198,46 @@ class TestConversionErrors:
             str(tmp_path / 'hf'), _base_cfg(param_dtype='bfloat16'))
         assert str(params['embed']['embedding'].dtype) == 'bfloat16'
 
+    def test_unconsumed_weights_rejected(self):
+        """An architecturally incompatible checkpoint (extra weight
+        tensors, e.g. Gemma-2 post-norms) must fail loudly instead of
+        silently dropping weights."""
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2)
+        model = transformers.LlamaForCausalLM(hf_cfg)
+        sd = dict(model.state_dict())
+        sd['model.layers.0.post_feedforward_layernorm.weight'] = \
+            torch.ones(64)
+        with pytest.raises(ValueError, match='does not consume'):
+            from_hf(sd, _base_cfg())
+
+    def test_dropped_bias_rejected(self):
+        """Qwen2 checkpoint into a no-bias config: the biases would be
+        silently dropped — must raise."""
+        hf_cfg = transformers.Qwen2Config(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2)
+        model = transformers.Qwen2ForCausalLM(hf_cfg)
+        with pytest.raises(ValueError, match='does not consume'):
+            load_hf_model(model, _base_cfg(qkv_bias=False))
+
+    def test_bf16_checkpoint_converts(self):
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2)
+        model = transformers.LlamaForCausalLM(hf_cfg).to(torch.bfloat16)
+        params = load_hf_model(model, _base_cfg())
+        assert params['embed']['embedding'].dtype == np.float32
+
+    def test_softcap_config_export_rejected(self):
+        from skypilot_tpu.models.convert import hf_config_for
+        with pytest.raises(NotImplementedError, match='softcap'):
+            hf_config_for(_base_cfg(attn_logit_softcap=30.0))
+
     def test_unscanned_layout_rejected(self):
         with pytest.raises(NotImplementedError, match='scan'):
             from_hf({}, dataclasses.replace(_base_cfg(),
@@ -203,6 +263,107 @@ class TestTrainerInitFromHf:
             '--steps', '2', '--init-from-hf', str(tmp_path / 'hf'),
             '--log-every', '1'])
         assert rc == 0
+
+
+class TestToHf:
+    """Reverse conversion: a model trained here must load back into
+    transformers bit-for-bit."""
+
+    def _hf_llama(self):
+        return transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            rope_theta=10000.0, rms_norm_eps=1e-6,
+            attn_implementation='eager'))
+
+    def test_round_trip_llama(self):
+        from skypilot_tpu.models.convert import to_hf
+        model = self._hf_llama()
+        cfg = _base_cfg()
+        params = load_hf_model(model, cfg)
+        back = from_hf(to_hf(params, cfg), cfg)
+
+        def assert_same(a, b, path=''):
+            if isinstance(a, dict):
+                assert a.keys() == b.keys(), path
+                for k in a:
+                    assert_same(a[k], b[k], f'{path}/{k}')
+            else:
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b), err_msg=path)
+
+        assert_same(params, back)
+
+    def test_exported_weights_load_into_transformers(self):
+        """Strongest check: load_state_dict into a fresh HF model and
+        compare ITS logits against ours."""
+        from skypilot_tpu.models.convert import to_hf
+        src = self._hf_llama()
+        cfg = _base_cfg()
+        params = load_hf_model(src, cfg)
+        sd = {k: torch.tensor(v) for k, v in to_hf(params, cfg).items()}
+        dst = self._hf_llama()
+        missing, unexpected = dst.load_state_dict(sd, strict=False)
+        assert not unexpected
+        # rotary inv_freq buffers may be reported missing; no weights.
+        assert all('inv_freq' in k for k in missing)
+        dst.eval()
+        rng = np.random.default_rng(3)
+        tokens = rng.integers(0, cfg.vocab_size, size=(1, 10))
+        with torch.no_grad():
+            want = dst(torch.tensor(tokens)).logits.numpy()
+        got = np.asarray(Transformer(cfg).apply(
+            {'params': params}, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, want, atol=ATOL, rtol=ATOL)
+
+    def test_round_trip_gpt2(self):
+        from skypilot_tpu.models.convert import to_hf
+        model = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+            vocab_size=96, n_embd=48, n_layer=2, n_head=4,
+            n_positions=64, attn_implementation='eager'))
+        cfg = _base_cfg(vocab_size=96, d_model=48, num_heads=4,
+                        num_kv_heads=4, d_mlp=192, mlp_activation='gelu',
+                        mlp_style='plain', norm_style='layernorm',
+                        pos_embedding='learned', qkv_bias=True,
+                        o_bias=True, mlp_bias=True, tie_embeddings=True,
+                        norm_eps=1e-5)
+        params = load_hf_model(model, cfg)
+        back = from_hf(to_hf(params, cfg), cfg)
+        leaf_a = params['layers']['layer']['attn']['q_proj']['kernel']
+        leaf_b = back['layers']['layer']['attn']['q_proj']['kernel']
+        np.testing.assert_array_equal(np.asarray(leaf_a),
+                                      np.asarray(leaf_b))
+
+
+class TestExportHfCheckpoint:
+
+    def test_train_then_export_reloads_in_transformers(self, tmp_path):
+        """Full exit ramp: train 2 steps → --export-hf → transformers
+        loads the result and produces logits matching ours."""
+        from skypilot_tpu.train import run as train_run
+        out = str(tmp_path / 'export')
+        rc = train_run.main([
+            '--model', 'test-tiny', '--batch', '8', '--seq', '32',
+            '--steps', '2', '--export-hf', out, '--log-every', '1'])
+        assert rc == 0
+        hf = transformers.AutoModelForCausalLM.from_pretrained(out)
+        hf.eval()
+        from skypilot_tpu.models import get_config
+        cfg = get_config('test-tiny', dtype='float32',
+                         param_dtype='float32')
+        params = load_hf_model(hf, cfg)
+        rng = np.random.default_rng(5)
+        tokens = rng.integers(0, cfg.vocab_size, size=(1, 8))
+        with torch.no_grad():
+            want = hf(torch.tensor(tokens)).logits.numpy()
+        got = np.asarray(Transformer(cfg).apply(
+            {'params': params}, jnp.asarray(tokens, jnp.int32)),
+            np.float32)
+        # The exported weights were trained in bf16: the comparison is
+        # HF-vs-us on the SAME (exported) float32 weights, so it is
+        # tight.
+        np.testing.assert_allclose(got, want, atol=ATOL, rtol=ATOL)
 
 
 class TestQuantizeAfterConvert:
