@@ -10,8 +10,11 @@ import os
 
 AGENT_TICK_SECONDS = 5
 AGENT_PORT = 46580           # reserved for a future HTTP fast-path
+# Base ports; the gang driver adds job_id % 512, so each base owns a
+# disjoint 512-wide range (8476-8987 and 9100-9611) — concurrent jobs
+# on one host can't cross-collide between the two coordinators.
 JAX_COORDINATOR_PORT = 8476  # jax.distributed default
-MEGASCALE_PORT = 8081
+MEGASCALE_PORT = 9100
 
 # All agent state lives under this root (jobs.db, logs/, config.db). The
 # env override is what lets fake-cloud "hosts" on one machine each get

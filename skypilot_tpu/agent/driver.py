@@ -84,21 +84,26 @@ def rank_env(spec: Dict[str, Any], rank: int) -> Dict[str, str]:
         constants.ENV_CHIPS_PER_HOST: str(spec.get('chips_per_host', 0)),
         constants.ENV_ACCELERATOR: spec.get('accelerator', ''),
     }
+    # Per-job port offset: back-to-back jobs (and fake-cloud "hosts"
+    # sharing one machine's port namespace) must not race a previous
+    # coordinator socket lingering in TIME_WAIT on a fixed port.
+    port_off = int(spec['job_id']) % 512
     if len(hosts) > 1:
         # Explicit JAX coordinator wiring for multi-host single-slice (on
         # real TPU pods jax.distributed.initialize() can also self-discover
         # via the TPU metadata server; exporting these works for both and
         # is the only option for CPU-simulated meshes).
         env[constants.ENV_JAX_COORDINATOR] = (
-            f'{head_ip}:{constants.JAX_COORDINATOR_PORT}')
+            f'{head_ip}:{constants.JAX_COORDINATOR_PORT + port_off}')
         env[constants.ENV_JAX_NUM_PROCESSES] = str(len(hosts))
         env[constants.ENV_JAX_PROCESS_ID] = str(rank)
     if num_slices > 1:
+        megascale_port = constants.MEGASCALE_PORT + port_off
         env[constants.ENV_MEGASCALE_COORDINATOR] = (
-            f'{head_ip}:{constants.MEGASCALE_PORT}')
+            f'{head_ip}:{megascale_port}')
         env[constants.ENV_MEGASCALE_NUM_SLICES] = str(num_slices)
         env[constants.ENV_MEGASCALE_SLICE_ID] = str(host['slice'])
-        env[constants.ENV_MEGASCALE_PORT] = str(constants.MEGASCALE_PORT)
+        env[constants.ENV_MEGASCALE_PORT] = str(megascale_port)
     return env
 
 
@@ -168,13 +173,28 @@ class GangRun:
                     return
 
     def _pump(self, rank: int, proc, prefix: str) -> None:
-        """Pure-Python fallback pump (one thread per rank)."""
+        """Pure-Python fallback pump: one thread per stream, whole lines
+        under one lock, so stdout/stderr of the same rank (separate
+        pipes) never interleave mid-line in the rank log."""
         rank_log = os.path.join(self.log_dir, f'rank-{rank}.log')
+        lock = threading.Lock()
         with open(rank_log, 'a', buffering=1, encoding='utf-8') as rf:
-            for line in proc.stdout:
-                rf.write(line)
-                with self._lock:
-                    self._combined.write(prefix + line)
+
+            def drain(stream):
+                for line in stream:
+                    with lock:
+                        rf.write(line)
+                    with self._lock:
+                        self._combined.write(prefix + line)
+
+            err_thread = None
+            if proc.stderr is not None:
+                err_thread = threading.Thread(
+                    target=drain, args=(proc.stderr,), daemon=True)
+                err_thread.start()
+            drain(proc.stdout)
+            if err_thread is not None:
+                err_thread.join()
         self._reap(rank, proc)
 
     def _reap(self, rank: int, proc) -> None:
@@ -240,12 +260,14 @@ class GangRun:
             env.update(rank_env(self.spec, rank))
             env[constants.ENV_JOB_MARKER] = self.marker
             runner = make_runner(host)
-            proc = runner.popen(cmd, env=env)
+            proc = runner.popen(cmd, env=env, separate_stderr=True)
             self._procs[rank] = proc
             prefix = f'(rank {rank}) ' if many else ''
             rank_log = os.path.join(self.log_dir, f'rank-{rank}.log')
             if mux is not None:
                 mux.add_stream(proc.stdout.fileno(), rank_log, prefix)
+                if proc.stderr is not None:
+                    mux.add_stream(proc.stderr.fileno(), rank_log, prefix)
                 t = threading.Thread(target=self._reap, args=(rank, proc),
                                      daemon=True)
             else:
@@ -277,11 +299,13 @@ class GangRun:
             # kill found no python); force-close to unblock pump readline —
             # the job must reach a terminal status no matter what.
             for proc in self._procs:
-                if proc is not None and proc.stdout is not None:
-                    try:
-                        proc.stdout.close()
-                    except OSError:
-                        pass
+                for stream in (getattr(proc, 'stdout', None),
+                               getattr(proc, 'stderr', None)):
+                    if stream is not None:
+                        try:
+                            stream.close()
+                        except OSError:
+                            pass
             for t in threads:
                 t.join(timeout=5.0)
         if self._mux is not None:
@@ -298,11 +322,13 @@ class GangRun:
             self._mux.close()
             self._mux = None
             for proc in self._procs:
-                if proc is not None and proc.stdout is not None:
-                    try:
-                        proc.stdout.close()
-                    except OSError:
-                        pass
+                for stream in (getattr(proc, 'stdout', None),
+                               getattr(proc, 'stderr', None)):
+                    if stream is not None:
+                        try:
+                            stream.close()
+                        except OSError:
+                            pass
         self._done.set()
         self._combined.flush()
         return [rc if rc is not None else 137 for rc in self._rcs]

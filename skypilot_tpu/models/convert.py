@@ -283,7 +283,6 @@ def export_hf_checkpoint(params: Mapping[str, Any], cfg: ModelConfig,
     model = transformers.AutoModelForCausalLM.from_config(
         hf_config_for(cfg))
     missing, unexpected = model.load_state_dict(sd, strict=False)
-    unexpected = [k for k in unexpected]
     if unexpected:
         raise ValueError(f'export produced unexpected keys: {unexpected}')
     real_missing = [k for k in missing if 'inv_freq' not in k]

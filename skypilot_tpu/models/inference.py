@@ -299,13 +299,18 @@ class ContinuousBatchingEngine:
                  max_seq_len: Optional[int] = None,
                  rng_seed: int = 0,
                  mesh: Optional[Any] = None,
-                 quantize: Optional[str] = None) -> None:
+                 quantize: Optional[str] = None,
+                 decode_chunk: int = 1) -> None:
         import queue as queue_lib
         import threading
         self.cfg, self.params = _resolve_cfg_and_params(
             cfg, params, max_seq_len, rng_seed, quantize)
         self.num_slots = num_slots
         self.mesh = mesh
+        # >1 ⇒ when no request is waiting to be admitted, a tick decodes
+        # this many steps per dispatch (scan in one jit) — fewer
+        # host round trips; admission latency is bounded by one chunk.
+        self.decode_chunk = max(1, decode_chunk)
         self.model = Transformer(self.cfg)
         self._rng = jax.random.PRNGKey(rng_seed)
 
@@ -314,6 +319,8 @@ class ContinuousBatchingEngine:
                                donate_argnames=('cache',))
         self._decode = jax.jit(self._decode_impl,
                                donate_argnames=('cache',))
+        self._decode_multi = jax.jit(self._decode_multi_impl,
+                                     donate_argnames=('cache',))
 
         self._queue: 'queue_lib.Queue[_Request]' = queue_lib.Queue()
         self._slots: list = [None] * num_slots  # _Request or None
@@ -389,6 +396,22 @@ class ContinuousBatchingEngine:
             rng, last / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
         out = jnp.where(temps <= 0, greedy, sampled).astype(jnp.int32)
         return out, nn.unbox(mutated['cache'])
+
+    def _decode_multi_impl(self, params, cache, tokens, positions, temps,
+                           rngs):
+        """K all-slots decode steps in one dispatch (K = rngs' leading
+        dim): returns ((num_slots, K) tokens, cache). tokens/positions:
+        (num_slots,)."""
+
+        def body(carry, rng):
+            cache, toks, pos = carry
+            out, cache = self._decode_impl(params, cache, toks[:, None],
+                                           pos[:, None], temps, rng)
+            return (cache, out, pos + 1), out
+
+        (cache, _, _), toks = jax.lax.scan(
+            body, (cache, tokens, positions), rngs)
+        return toks.swapaxes(0, 1), cache
 
     # ---------------- scheduler ----------------
 
@@ -485,7 +508,20 @@ class ContinuousBatchingEngine:
             self._wake.wait(timeout=0.05)
             self._wake.clear()
             return
-        # One all-slots decode tick.
+        # All-slots decode: K scanned steps per dispatch when nothing is
+        # waiting to be admitted (admission latency stays bounded by one
+        # chunk), a single step otherwise.
+        k = 1
+        if self.decode_chunk > 1 and self._queue.empty():
+            # Full chunks only: k ∈ {1, decode_chunk} so serving never
+            # JIT-compiles a new scan length mid-stream. Slots whose
+            # cache window can't absorb a full chunk finish on single
+            # steps.
+            window_ok = all(
+                self.cfg.max_seq_len - self._slots[i].next_pos
+                >= self.decode_chunk for i in active)
+            if window_ok:
+                k = self.decode_chunk
         tokens = [(self._slots[i].tokens[-1]
                    if self._slots[i] is not None else 0)
                   for i in range(self.num_slots)]
@@ -496,25 +532,41 @@ class ContinuousBatchingEngine:
                   if self._slots[i] is not None else 0.0)
                  for i in range(self.num_slots)]
         self._rng, rng = jax.random.split(self._rng)
-        out_tokens, self._cache = self._decode(
-            self.params, self._cache,
-            jnp.asarray(tokens, jnp.int32)[:, None],
-            jnp.asarray(positions, jnp.int32)[:, None],
-            jnp.asarray(temps, jnp.float32), rng)
         import numpy as np
-        out_tokens = np.asarray(out_tokens)  # the tick's ONE host sync
-        self._decode_steps += 1
+        if k == 1:
+            out_tokens, self._cache = self._decode(
+                self.params, self._cache,
+                jnp.asarray(tokens, jnp.int32)[:, None],
+                jnp.asarray(positions, jnp.int32)[:, None],
+                jnp.asarray(temps, jnp.float32), rng)
+            out_cols = np.asarray(out_tokens)[:, None]
+        else:
+            rngs = jax.random.split(rng, k)
+            out_tokens, self._cache = self._decode_multi(
+                self.params, self._cache,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(temps, jnp.float32), rngs)
+            out_cols = np.asarray(out_tokens)     # (num_slots, k)
+        self._decode_steps += k
         self.step_log.append((self._decode_steps, frozenset(active)))
         for slot in active:
             req = self._slots[slot]
-            req.next_pos += 1
-            token = int(out_tokens[slot])
-            req.tokens.append(token)
-            done = (len(req.tokens) >= req.max_new_tokens or
-                    (req.eos_id is not None and token == req.eos_id) or
-                    req.next_pos + 1 >= self.cfg.max_seq_len)
-            if done:
-                self._finish(slot)
+            for c in range(out_cols.shape[1]):
+                req.next_pos += 1
+                token = int(out_cols[slot, c])
+                req.tokens.append(token)
+                done = (len(req.tokens) >= req.max_new_tokens or
+                        (req.eos_id is not None
+                         and token == req.eos_id) or
+                        req.next_pos + 1 >= self.cfg.max_seq_len)
+                if done:
+                    # Overshoot columns for this slot are discarded; the
+                    # stale cache entries sit beyond every future query
+                    # position (causal-masked) or get overwritten by the
+                    # next admitted request's _insert.
+                    self._finish(slot)
+                    break
 
     # ---------------- public api ----------------
 

@@ -51,7 +51,8 @@ class InferenceServer:
                  checkpoint_dir: Optional[str] = None,
                  hf_model_path: Optional[str] = None,
                  num_slots: int = 4,
-                 quantize: Optional[str] = None) -> None:
+                 quantize: Optional[str] = None,
+                 decode_chunk: int = 1) -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
@@ -79,7 +80,8 @@ class InferenceServer:
         self.engine = ContinuousBatchingEngine(model, params=params,
                                                num_slots=num_slots,
                                                max_seq_len=max_seq_len,
-                                               quantize=quantize)
+                                               quantize=quantize,
+                                               decode_chunk=decode_chunk)
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
         if tokenizer.startswith('hf:'):
@@ -181,6 +183,11 @@ def main(argv=None) -> int:
     parser.add_argument('--quantize', default=None, choices=['int8'],
                         help='weight-only int8 serving: halves the HBM '
                              'weight traffic that bounds decode')
+    parser.add_argument('--decode-chunk', type=int, default=1,
+                        help='decode steps per device dispatch when no '
+                             'request awaits admission (>1 cuts host '
+                             'round trips; admission latency bounded by '
+                             'one chunk)')
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -191,7 +198,8 @@ def main(argv=None) -> int:
                              checkpoint_dir=args.checkpoint_dir,
                              hf_model_path=args.hf_model_path,
                              num_slots=args.num_slots,
-                             quantize=args.quantize)
+                             quantize=args.quantize,
+                             decode_chunk=args.decode_chunk)
     server.warmup()
     web.run_app(server.make_app(), host='0.0.0.0', port=args.port,
                 handle_signals=False)

@@ -184,12 +184,19 @@ def main(argv=None) -> int:
             manager.save(args.steps, state, force=True)
         manager.close()
     if args.export_hf:
+        if topology.num_hosts > 1:
+            # Params span non-addressable devices on a multi-host run:
+            # device_get would raise on every host, and concurrent
+            # writes to one out dir would corrupt it anyway.
+            raise SystemExit(
+                '--export-hf is single-host only; on multi-host runs, '
+                'restore the Orbax checkpoint on one host and export '
+                'from there')
         from skypilot_tpu.models.convert import export_hf_checkpoint
         # to_hf casts to float32 itself — device_get only here, or a
         # multi-GB bf16 tree would make two full fp32 host copies.
         host_params = jax.tree.map(jax.device_get, state.params)
         export_hf_checkpoint(host_params, cfg, args.export_hf)
-        logger.info('exported HF checkpoint to %s', args.export_hf)
     logger.info('done: %d steps, final loss %.4f', args.steps, loss)
     return 0
 

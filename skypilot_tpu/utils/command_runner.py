@@ -62,12 +62,18 @@ class CommandRunner:
 
     def popen(self, cmd: Union[str, List[str]],
               env: Optional[Dict[str, str]] = None,
+              separate_stderr: bool = False,
               **popen_kwargs) -> subprocess.Popen:
-        """Start the command with piped, line-buffered combined output —
-        the gang driver's streaming primitive."""
+        """Start the command with piped, line-buffered output — the gang
+        driver's streaming primitive. separate_stderr=True gives stderr
+        its own pipe so a process's unbuffered C-library stderr can't
+        interleave mid-line with its buffered stdout (the consumer muxes
+        the two pipes line-wise)."""
         argv = self._argv(cmd, env)
         popen_kwargs.setdefault('stdout', subprocess.PIPE)
-        popen_kwargs.setdefault('stderr', subprocess.STDOUT)
+        popen_kwargs.setdefault(
+            'stderr',
+            subprocess.PIPE if separate_stderr else subprocess.STDOUT)
         popen_kwargs.setdefault('text', True)
         popen_kwargs.setdefault('bufsize', 1)
         popen_kwargs.setdefault('start_new_session', True)

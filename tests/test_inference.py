@@ -139,6 +139,40 @@ class TestChunkedDecode:
         assert (got[:, -1] == eos).all() or got.shape[1] == 12
 
 
+class TestContinuousBatchingChunked:
+    """decode_chunk>1 on the continuous-batching engine: scanned ticks
+    must preserve greedy output, EOS/max_new budgets, and interleaving."""
+
+    def test_chunked_matches_sequential_engine(self):
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        ref = InferenceEngine(_cfg(), batch_size=1)
+        prompt = [5, 7, 11]
+        ref_out, _ = ref.generate(jnp.asarray([prompt], jnp.int32),
+                                  max_new_tokens=9)
+        engine = ContinuousBatchingEngine(_cfg(), num_slots=2,
+                                          decode_chunk=4)
+        try:
+            toks, stats = engine.generate(prompt, max_new_tokens=9)
+        finally:
+            engine.stop()
+        assert toks == [int(t) for t in ref_out[0]]
+        assert stats['new_tokens'] == 9
+
+    def test_chunked_concurrent_all_finish(self):
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        engine = ContinuousBatchingEngine(_cfg(), num_slots=2,
+                                          decode_chunk=4)
+        try:
+            futures = [engine.submit([3 + i, 9, 27], max_new_tokens=7)
+                       for i in range(5)]
+            results = [f.result(timeout=120) for f in futures]
+        finally:
+            engine.stop()
+        for toks, stats in results:
+            assert len(toks) == 7
+            assert stats['new_tokens'] == 7
+
+
 class TestContinuousBatching:
 
     @pytest.fixture(scope='class')

@@ -83,6 +83,36 @@ class TestLogMux:
         for line in combined.read_text().strip().split('\n'):
             assert line in ('[0] AAABBB', '[1] AAABBB'), line
 
+    def test_two_streams_one_rank_file_line_atomic(self, tmp_path):
+        """One process's stdout and stderr (separate pipes, same rank
+        log) must never interleave mid-line — the Gloo-vs-print failure
+        mode: unbuffered C-library stderr splitting a buffered stdout
+        line."""
+        code = ('import sys,time\n'
+                'for i in range(30):\n'
+                '    sys.stdout.write("OUT"); sys.stdout.flush()\n'
+                '    sys.stderr.write("ERRLINE\\n"); sys.stderr.flush()\n'
+                '    time.sleep(0.001)\n'
+                '    sys.stdout.write("LINE\\n"); sys.stdout.flush()\n')
+        proc = subprocess.Popen(['python3', '-c', code],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE)
+        combined = tmp_path / 'run.log'
+        rank = tmp_path / 'rank-0.log'
+        with logmux_lib.LogMux(str(combined)) as mux:
+            mux.add_stream(proc.stdout.fileno(), str(rank), '(rank 0) ')
+            mux.add_stream(proc.stderr.fileno(), str(rank), '(rank 0) ')
+            mux.start()
+            proc.wait()
+            proc.stdout.close()
+            proc.stderr.close()
+            mux.wait()
+        lines = rank.read_text().strip().split('\n')
+        assert len(lines) == 60
+        for line in lines:
+            assert line in ('OUTLINE', 'ERRLINE'), line
+        assert sum(1 for l in lines if l == 'OUTLINE') == 30
+
     def test_unterminated_final_line_flushed(self, tmp_path):
         proc = subprocess.Popen(
             ['python3', '-c', 'import sys; sys.stdout.write("no-newline")'],

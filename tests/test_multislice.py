@@ -58,17 +58,21 @@ import os
 os.environ['JAX_PLATFORMS'] = 'cpu'
 os.environ.pop('PALLAS_AXON_POOL_IPS', None)
 from skypilot_tpu.parallel import distributed
-topo = distributed.initialize(timeout_seconds=150)
+# Generous: under full-suite load two cold jax imports can stagger the
+# ranks by minutes before the coordinator handshake even starts.
+topo = distributed.initialize(timeout_seconds=280)
 import jax
 import jax.numpy as jnp
 from jax.experimental import multihost_utils
 assert jax.process_count() == topo.num_hosts, (
     jax.process_count(), topo.num_hosts)
 ranks = multihost_utils.process_allgather(jnp.asarray([topo.host_rank]))
+# flush=True: jax.distributed's atexit teardown can hard-exit before
+# python's buffered-stdout flush, silently losing the final line.
 print('WORLD', jax.process_count(),
       'RANKSUM', int(ranks.sum()),
       'SLICE', os.environ.get('MEGASCALE_SLICE_ID'),
-      'NSLICES', os.environ.get('MEGASCALE_NUM_SLICES'))
+      'NSLICES', os.environ.get('MEGASCALE_NUM_SLICES'), flush=True)
 PYEOF
 '''
 
@@ -87,7 +91,7 @@ def test_two_process_multislice_jax_world(tmp_path):
     assert handle.num_slices == 2 and handle.num_hosts == 2
     # Generous budget: two cold jax imports + distributed handshake can
     # be slow when the whole suite is loading the machine.
-    assert _wait_terminal('ms2', job_id, timeout=240) == 'SUCCEEDED'
+    assert _wait_terminal('ms2', job_id, timeout=320) == 'SUCCEEDED'
     logs = _rank_logs('ms2', str(tmp_path))
     assert set(logs) == {'rank-0.log', 'rank-1.log'}, sorted(logs)
     # Both ranks reached the barrier: each witnessed the full 2-process
