@@ -172,6 +172,16 @@ class GangRun:
                     self._failed.set()
                     return
 
+    @staticmethod
+    def _close_streams(proc) -> None:
+        for stream in (getattr(proc, 'stdout', None),
+                       getattr(proc, 'stderr', None)):
+            if stream is not None:
+                try:
+                    stream.close()
+                except OSError:
+                    pass
+
     def _pump(self, rank: int, proc, prefix: str) -> None:
         """Pure-Python fallback pump: one thread per stream, whole lines
         under one lock, so stdout/stderr of the same rank (separate
@@ -299,13 +309,7 @@ class GangRun:
             # kill found no python); force-close to unblock pump readline —
             # the job must reach a terminal status no matter what.
             for proc in self._procs:
-                for stream in (getattr(proc, 'stdout', None),
-                               getattr(proc, 'stderr', None)):
-                    if stream is not None:
-                        try:
-                            stream.close()
-                        except OSError:
-                            pass
+                self._close_streams(proc)
             for t in threads:
                 t.join(timeout=5.0)
         if self._mux is not None:
@@ -322,13 +326,7 @@ class GangRun:
             self._mux.close()
             self._mux = None
             for proc in self._procs:
-                for stream in (getattr(proc, 'stdout', None),
-                               getattr(proc, 'stderr', None)):
-                    if stream is not None:
-                        try:
-                            stream.close()
-                        except OSError:
-                            pass
+                self._close_streams(proc)
         self._done.set()
         self._combined.flush()
         return [rc if rc is not None else 137 for rc in self._rcs]

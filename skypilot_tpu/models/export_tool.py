@@ -1,0 +1,41 @@
+"""Checkpoint → HuggingFace export CLI.
+
+The one-host exit ramp for multi-host training runs (train/run.py's
+--export-hf is single-host by design): restore the Orbax checkpoint the
+run wrote to its bucket, convert (models/convert.py: to_hf) and write a
+loadable HF dir.
+
+    python3 -m skypilot_tpu.models.export_tool \
+        --model llama3-8b --checkpoint-dir gs-mounted/ckpts --out hf-out
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--model', required=True)
+    parser.add_argument('--checkpoint-dir', required=True,
+                        help='Orbax dir written by train/run.py')
+    parser.add_argument('--out', required=True,
+                        help='output HF checkpoint dir')
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from skypilot_tpu.models import get_config
+    from skypilot_tpu.models.convert import export_hf_checkpoint
+    from skypilot_tpu.models.inference import load_params_from_checkpoint
+
+    cfg = get_config(args.model, param_dtype='bfloat16')
+    params = load_params_from_checkpoint(cfg, args.checkpoint_dir)
+    host_params = jax.tree.map(jax.device_get, params)
+    export_hf_checkpoint(host_params, cfg, args.out)
+    print(f'exported {args.model} -> {args.out}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

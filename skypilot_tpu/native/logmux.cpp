@@ -41,11 +41,6 @@ struct Stream {
   int rank_fd = -1;
   std::string prefix;
   std::string carry;  // partial line accumulated across reads
-  // Bytes at the front of `carry` already written to rank_fd by an
-  // idle flush (rank files regain real-time partial-line visibility —
-  // tqdm '\r' updates, a wedged rank's last diagnostic — while the
-  // line-atomic path still rules during active output).
-  size_t rank_written = 0;
   bool eof = false;
 };
 
@@ -71,24 +66,23 @@ void write_all(int fd, const char* buf, size_t n) {
 }
 
 // Emit [data, data+n): BOTH the rank file and the combined fd receive
-// only COMPLETE lines, so streams sharing a file never interleave
-// mid-line. That matters even within one rank: stdout and stderr ride
-// separate pipes (a process's unbuffered C++ stderr must not split its
-// buffered-python stdout lines), both landing in the same rank log via
-// O_APPEND fds whose line-sized writes are atomic.
+// only COMPLETE lines ('\n' or '\r' terminated), so streams sharing a
+// file never interleave mid-line. That matters even within one rank:
+// stdout and stderr ride separate pipes (a process's unbuffered C++
+// stderr must not split its buffered-python stdout lines), both landing
+// in the same rank log via O_APPEND fds whose line-sized writes are
+// atomic. A truly unterminated tail stays buffered until EOF/teardown
+// (flush_carry) or the 1 MiB cap — the price of the atomicity contract.
 void emit(Mux* m, Stream* s, const char* data, size_t n) {
   s->carry.append(data, n);
   size_t start = 0;
   while (true) {
-    size_t nl = s->carry.find('\n', start);
+    // '\r' is a boundary too: progress-bar streams (tqdm) emit only
+    // carriage returns, and must stay visible line-by-line without
+    // giving up write atomicity.
+    size_t nl = s->carry.find_first_of("\r\n", start);
     if (nl == std::string::npos) break;
-    // Rank file: only the part an idle flush hasn't already written.
-    size_t rank_from = start < s->rank_written ? s->rank_written : start;
-    if (rank_from <= nl) {
-      write_all(s->rank_fd, s->carry.data() + rank_from,
-                nl - rank_from + 1);
-    }
-    if (s->rank_written < nl + 1) s->rank_written = nl + 1;
+    write_all(s->rank_fd, s->carry.data() + start, nl - start + 1);
     if (!s->prefix.empty()) {
       write_all(m->combined_fd, s->prefix.data(), s->prefix.size());
     }
@@ -97,12 +91,10 @@ void emit(Mux* m, Stream* s, const char* data, size_t n) {
     start = nl + 1;
   }
   s->carry.erase(0, start);
-  s->rank_written = s->rank_written > start ? s->rank_written - start : 0;
   if (s->carry.size() > kMaxCarry) {
-    // Pathological no-newline stream: force-flush with a synthesized
+    // Pathological no-terminator stream: force-flush with a synthesized
     // newline so memory stays bounded.
-    write_all(s->rank_fd, s->carry.data() + s->rank_written,
-              s->carry.size() - s->rank_written);
+    write_all(s->rank_fd, s->carry.data(), s->carry.size());
     if (!s->prefix.empty()) {
       write_all(m->combined_fd, s->prefix.data(), s->prefix.size());
     }
@@ -110,25 +102,13 @@ void emit(Mux* m, Stream* s, const char* data, size_t n) {
     write_all(m->combined_fd, "\n", 1);
     m->lines++;
     s->carry.clear();
-    s->rank_written = 0;
-  }
-}
-
-// Idle tick: make partial (unterminated) output visible in the rank
-// file NOW — a wedged rank's last diagnostic line matters most.
-void idle_flush(Stream* s) {
-  if (s->carry.size() > s->rank_written) {
-    write_all(s->rank_fd, s->carry.data() + s->rank_written,
-              s->carry.size() - s->rank_written);
-    s->rank_written = s->carry.size();
   }
 }
 
 void flush_carry(Mux* m, Stream* s) {
   if (s->carry.empty()) return;
   // Rank file keeps byte fidelity: the unterminated tail goes out as-is.
-  write_all(s->rank_fd, s->carry.data() + s->rank_written,
-            s->carry.size() - s->rank_written);
+  write_all(s->rank_fd, s->carry.data(), s->carry.size());
   if (!s->prefix.empty()) {
     write_all(m->combined_fd, s->prefix.data(), s->prefix.size());
   }
@@ -136,7 +116,6 @@ void flush_carry(Mux* m, Stream* s) {
   write_all(m->combined_fd, "\n", 1);
   m->lines++;
   s->carry.clear();
-  s->rank_written = 0;
 }
 
 void* pump_loop(void* arg) {
@@ -156,13 +135,6 @@ void* pump_loop(void* arg) {
     if (rv < 0) {
       if (errno == EINTR) continue;
       break;
-    }
-    if (rv == 0) {
-      // Quiet tick: surface partial lines in the rank files.
-      for (auto& s : m->streams) {
-        if (!s.eof) idle_flush(&s);
-      }
-      continue;
     }
     for (size_t j = 0; j < fds.size(); j++) {
       Stream* s = &m->streams[idx[j]];

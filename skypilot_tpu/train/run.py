@@ -70,6 +70,13 @@ def main(argv=None) -> int:
                 topology.host_rank, topology.num_hosts,
                 jax.local_device_count(), jax.device_count())
 
+    # Fail fast, not after hours of training: export is single-host.
+    if args.export_hf and topology.num_hosts > 1:
+        raise SystemExit(
+            '--export-hf is single-host only; on multi-host runs, use '
+            '`python -m skypilot_tpu.models.export_tool` against the '
+            'Orbax checkpoint afterwards')
+
     # 2. Mesh over every chip in the job.
     mesh_cfg = infer_mesh_config(jax.device_count(), tp=args.tp,
                                  sp=args.sp, dp=args.dp, ep=args.ep)
@@ -184,14 +191,6 @@ def main(argv=None) -> int:
             manager.save(args.steps, state, force=True)
         manager.close()
     if args.export_hf:
-        if topology.num_hosts > 1:
-            # Params span non-addressable devices on a multi-host run:
-            # device_get would raise on every host, and concurrent
-            # writes to one out dir would corrupt it anyway.
-            raise SystemExit(
-                '--export-hf is single-host only; on multi-host runs, '
-                'restore the Orbax checkpoint on one host and export '
-                'from there')
         from skypilot_tpu.models.convert import export_hf_checkpoint
         # to_hf casts to float32 itself — device_get only here, or a
         # multi-GB bf16 tree would make two full fp32 host copies.

@@ -366,6 +366,34 @@ class TestExportHfCheckpoint:
         np.testing.assert_allclose(got, want, atol=ATOL, rtol=ATOL)
 
 
+class TestExportTool:
+
+    def test_checkpoint_to_hf_roundtrip(self, tmp_path):
+        """Multi-host story: train with --checkpoint-dir, export the
+        checkpoint via the standalone tool, reload in transformers."""
+        from skypilot_tpu.train import run as train_run
+        ckpt = str(tmp_path / 'ckpt')
+        rc = train_run.main([
+            '--model', 'test-tiny', '--batch', '8', '--seq', '32',
+            '--steps', '2', '--checkpoint-dir', ckpt,
+            '--checkpoint-every', '1', '--log-every', '1'])
+        assert rc == 0
+        from skypilot_tpu.models import export_tool
+        out = str(tmp_path / 'hf')
+        rc = export_tool.main(['--model', 'test-tiny',
+                               '--checkpoint-dir', ckpt, '--out', out])
+        assert rc == 0
+        hf = transformers.AutoModelForCausalLM.from_pretrained(out)
+        assert hf.config.vocab_size == 512
+
+    def test_missing_checkpoint_fails(self, tmp_path):
+        from skypilot_tpu.models import export_tool
+        with pytest.raises(FileNotFoundError):
+            export_tool.main(['--model', 'test-tiny', '--checkpoint-dir',
+                              str(tmp_path / 'nope'), '--out',
+                              str(tmp_path / 'o')])
+
+
 class TestQuantizeAfterConvert:
 
     def test_converted_params_quantize_and_run(self):

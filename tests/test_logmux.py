@@ -113,6 +113,26 @@ class TestLogMux:
             assert line in ('OUTLINE', 'ERRLINE'), line
         assert sum(1 for l in lines if l == 'OUTLINE') == 30
 
+    def test_carriage_return_is_a_boundary(self, tmp_path):
+        """tqdm-style '\\r'-only progress streams must stay visible
+        update-by-update (CR is a line boundary, same atomicity)."""
+        code = ('import sys,time\n'
+                'for i in range(5):\n'
+                '    sys.stdout.write("progress %d\\r" % i)\n'
+                '    sys.stdout.flush(); time.sleep(0.01)\n')
+        proc = subprocess.Popen(['python3', '-c', code],
+                                stdout=subprocess.PIPE)
+        combined = tmp_path / 'run.log'
+        rank = tmp_path / 'rank-0.log'
+        with logmux_lib.LogMux(str(combined)) as mux:
+            mux.add_stream(proc.stdout.fileno(), str(rank), '')
+            mux.start()
+            proc.wait()
+            proc.stdout.close()
+            mux.wait()
+        assert rank.read_bytes() == b''.join(
+            b'progress %d\r' % i for i in range(5))
+
     def test_unterminated_final_line_flushed(self, tmp_path):
         proc = subprocess.Popen(
             ['python3', '-c', 'import sys; sys.stdout.write("no-newline")'],
