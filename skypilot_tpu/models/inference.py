@@ -615,21 +615,11 @@ class ContinuousBatchingEngine:
 def load_params_from_checkpoint(cfg: ModelConfig,
                                 checkpoint_dir: str) -> Any:
     """Restore trained params from an Orbax checkpoint written by
-    train/run.py (the TrainState tree; params live under 'params')."""
-    from skypilot_tpu.train.checkpoints import CheckpointManager
-    from skypilot_tpu.train.trainer import (TrainConfig,
-                                            create_sharded_state)
-    from skypilot_tpu.parallel import build_mesh, infer_mesh_config
-    mesh = build_mesh(infer_mesh_config(jax.device_count()))
-    state, _ = create_sharded_state(cfg, mesh, jax.random.PRNGKey(0),
-                                    TrainConfig())
-    manager = CheckpointManager(checkpoint_dir)
-    restored, step = manager.maybe_restore(state)
-    if step == 0:
-        raise FileNotFoundError(
-            f'No checkpoint found in {checkpoint_dir!r}.')
-    logger.info('Loaded checkpoint step %d from %s', step, checkpoint_dir)
-    return restored.params
+    train/run.py. Params-only partial restore: the fp32 AdamW moments
+    (~5x the bf16 param bytes) never materialize — the difference
+    between a serving replica that fits and one that OOMs for 8B+."""
+    from skypilot_tpu.train.checkpoints import restore_params_only
+    return restore_params_only(cfg, checkpoint_dir)
 
 
 @functools.lru_cache(maxsize=2)

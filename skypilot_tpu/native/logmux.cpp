@@ -41,6 +41,10 @@ struct Stream {
   int rank_fd = -1;
   std::string prefix;
   std::string carry;  // partial line accumulated across reads
+  // Last flushed byte was '\r': a lone '\n' arriving next is the second
+  // half of a split CRLF — write it through but do not count/prefix a
+  // new line.
+  bool pending_cr = false;
   bool eof = false;
 };
 
@@ -76,19 +80,40 @@ void write_all(int fd, const char* buf, size_t n) {
 void emit(Mux* m, Stream* s, const char* data, size_t n) {
   s->carry.append(data, n);
   size_t start = 0;
+  // Second half of a CRLF split across reads: pass the '\n' through
+  // (byte fidelity) but as part of the line already flushed — no new
+  // prefix, no extra line count.
+  if (s->pending_cr && start < s->carry.size()) {
+    if (s->carry[start] == '\n') {
+      write_all(s->rank_fd, "\n", 1);
+      write_all(m->combined_fd, "\n", 1);
+      start++;
+    }
+    s->pending_cr = false;
+  }
   while (true) {
     // '\r' is a boundary too: progress-bar streams (tqdm) emit only
-    // carriage returns, and must stay visible line-by-line without
-    // giving up write atomicity.
+    // carriage returns, and must stay visible update-by-update without
+    // giving up write atomicity. "\r\n" counts as ONE boundary; a '\r'
+    // ending the buffer flushes NOW (no staleness) and a following
+    // lone '\n' is absorbed via pending_cr above.
     size_t nl = s->carry.find_first_of("\r\n", start);
     if (nl == std::string::npos) break;
-    write_all(s->rank_fd, s->carry.data() + start, nl - start + 1);
+    size_t end = nl;
+    if (s->carry[nl] == '\r') {
+      if (nl + 1 < s->carry.size() && s->carry[nl + 1] == '\n') {
+        end = nl + 1;
+      } else if (nl + 1 == s->carry.size()) {
+        s->pending_cr = true;
+      }
+    }
+    write_all(s->rank_fd, s->carry.data() + start, end - start + 1);
     if (!s->prefix.empty()) {
       write_all(m->combined_fd, s->prefix.data(), s->prefix.size());
     }
-    write_all(m->combined_fd, s->carry.data() + start, nl - start + 1);
+    write_all(m->combined_fd, s->carry.data() + start, end - start + 1);
     m->lines++;
-    start = nl + 1;
+    start = end + 1;
   }
   s->carry.erase(0, start);
   if (s->carry.size() > kMaxCarry) {

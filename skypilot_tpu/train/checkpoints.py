@@ -70,3 +70,55 @@ class CheckpointManager:
     def close(self) -> None:
         self._manager.wait_until_finished()
         self._manager.close()
+
+
+def restore_params_only(cfg, checkpoint_dir: str):
+    """Restore ONLY the params subtree of a train checkpoint (orbax
+    partial restore) — skips the fp32 AdamW moments, cutting peak memory
+    ~5x vs materializing the whole TrainState. The right loader for
+    serving replicas and HF export, where the optimizer state is dead
+    weight.
+
+    Restores onto THIS process's device mesh (logical axis rules over
+    all local devices), not the sharding saved at train time — a
+    checkpoint trained on a 32-chip mesh must load on an 8-chip serving
+    replica.
+    """
+    import os as os_lib
+
+    import jax
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+    from flax import linen as nn
+
+    from skypilot_tpu.models.transformer import Transformer
+    from skypilot_tpu.parallel import build_mesh, infer_mesh_config
+    from skypilot_tpu.parallel import sharding as sharding_lib
+
+    mesh = build_mesh(infer_mesh_config(jax.device_count()))
+    abstract = jax.eval_shape(
+        lambda: Transformer(cfg).init(jax.random.PRNGKey(0),
+                                      jnp.ones((1, 8), jnp.int32))
+    )['params']
+    specs = nn.get_partition_spec(abstract)
+    shardings = nn.logical_to_mesh_sharding(
+        specs, mesh, sharding_lib.logical_axis_rules())
+    abstract = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        nn.unbox(abstract), shardings,
+        is_leaf=lambda x: hasattr(x, 'shape'))
+    manager = ocp.CheckpointManager(
+        os_lib.path.abspath(os_lib.path.expanduser(checkpoint_dir)))
+    try:
+        step = manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f'No checkpoint found in {checkpoint_dir!r}.')
+        logger.info('Restoring params-only checkpoint step %d from %s',
+                    step, checkpoint_dir)
+        restored = manager.restore(
+            step, args=ocp.args.PyTreeRestore(item={'params': abstract},
+                                              partial_restore=True))
+    finally:
+        manager.close()
+    return restored['params']

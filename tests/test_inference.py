@@ -139,6 +139,37 @@ class TestChunkedDecode:
         assert (got[:, -1] == eos).all() or got.shape[1] == 12
 
 
+class TestCheckpointServing:
+
+    def test_params_only_restore_serves(self, tmp_path):
+        """Serving loads train checkpoints via params-only partial
+        restore (no fp32 Adam moments materialized) and decodes."""
+        from skypilot_tpu.train import run as train_run
+        ck = str(tmp_path / 'ck')
+        rc = train_run.main([
+            '--model', 'test-tiny', '--batch', '8', '--seq', '32',
+            '--steps', '2', '--checkpoint-dir', ck,
+            '--checkpoint-every', '1', '--log-every', '5'])
+        assert rc == 0
+        from skypilot_tpu.models import get_config
+        from skypilot_tpu.models.inference import (
+            load_params_from_checkpoint)
+        cfg = get_config('test-tiny', param_dtype='bfloat16')
+        params = load_params_from_checkpoint(cfg, ck)
+        eng = InferenceEngine(cfg, params=params, batch_size=1)
+        out, _ = eng.generate(jnp.asarray([[5, 7, 11]], jnp.int32),
+                              max_new_tokens=4)
+        assert out.shape == (1, 4)
+
+    def test_missing_checkpoint_raises(self, tmp_path):
+        from skypilot_tpu.models import get_config
+        from skypilot_tpu.models.inference import (
+            load_params_from_checkpoint)
+        with pytest.raises(FileNotFoundError):
+            load_params_from_checkpoint(get_config('test-tiny'),
+                                        str(tmp_path / 'none'))
+
+
 class TestContinuousBatchingChunked:
     """decode_chunk>1 on the continuous-batching engine: scanned ticks
     must preserve greedy output, EOS/max_new budgets, and interleaving."""

@@ -133,6 +133,51 @@ class TestLogMux:
         assert rank.read_bytes() == b''.join(
             b'progress %d\r' % i for i in range(5))
 
+    def test_crlf_is_one_boundary(self, tmp_path):
+        """Windows-style CRLF must count as ONE line ending — no phantom
+        empty lines in the combined log, no double line counts."""
+        proc = subprocess.Popen(
+            ['python3', '-c',
+             'import sys; sys.stdout.write("a\\r\\nb\\r\\n")'],
+            stdout=subprocess.PIPE)
+        combined = tmp_path / 'run.log'
+        rank = tmp_path / 'rank-0.log'
+        with logmux_lib.LogMux(str(combined)) as mux:
+            mux.add_stream(proc.stdout.fileno(), str(rank), '[0] ')
+            mux.start()
+            proc.wait()
+            proc.stdout.close()
+            mux.wait()
+            assert mux.lines == 2
+        assert rank.read_bytes() == b'a\r\nb\r\n'
+        assert combined.read_bytes() == b'[0] a\r\n[0] b\r\n'
+
+    def test_crlf_split_across_writes(self, tmp_path):
+        """CR flushed in one write, LF in the next: still one line, and
+        the CR-terminated update is visible immediately (no staleness)."""
+        code = ('import sys,time\n'
+                'sys.stdout.write("x\\r"); sys.stdout.flush()\n'
+                'time.sleep(0.3)\n'
+                'sys.stdout.write("\\ny\\n"); sys.stdout.flush()\n')
+        proc = subprocess.Popen(['python3', '-c', code],
+                                stdout=subprocess.PIPE)
+        combined = tmp_path / 'run.log'
+        rank = tmp_path / 'rank-0.log'
+        with logmux_lib.LogMux(str(combined)) as mux:
+            mux.add_stream(proc.stdout.fileno(), str(rank), '[0] ')
+            mux.start()
+            # The 'x\r' update must land before the second write.
+            deadline = time.time() + 2
+            while time.time() < deadline and rank.read_bytes() != b'x\r':
+                time.sleep(0.02)
+            assert rank.read_bytes() == b'x\r'
+            proc.wait()
+            proc.stdout.close()
+            mux.wait()
+            assert mux.lines == 2
+        assert rank.read_bytes() == b'x\r\ny\n'
+        assert combined.read_bytes() == b'[0] x\r\n[0] y\n'
+
     def test_unterminated_final_line_flushed(self, tmp_path):
         proc = subprocess.Popen(
             ['python3', '-c', 'import sys; sys.stdout.write("no-newline")'],
