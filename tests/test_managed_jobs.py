@@ -34,7 +34,9 @@ def _task(run='echo managed', name='mj', acc='tpu-v5e-1', **kwargs):
     return task
 
 
-def _wait_status(job_id, wanted, timeout=60.0):
+def _wait_status(job_id, wanted, timeout=150.0):
+    # Generous: controller processes crawl when the whole suite loads
+    # the machine (observed 60s+ launch→terminal under full-suite load).
     deadline = time.time() + timeout
     status = None
     while time.time() < deadline:
