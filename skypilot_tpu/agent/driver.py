@@ -43,6 +43,40 @@ from skypilot_tpu.agent import job_lib
 from skypilot_tpu.utils import command_runner
 
 
+_MAX_LINE_CARRY = 1 << 20  # cap a pathological never-terminated line
+
+
+def split_log_lines(buf: bytes):
+    """Split `buf` into (complete_segments, carry).
+
+    Line boundaries are '\\n', '\\r\\n' (one boundary) and bare '\\r'
+    (progress-bar streams must stay visible update-by-update) — the same
+    semantics as the native mux (native/logmux.cpp emit). A trailing
+    '\\r' stays in the carry: it may be the first half of a CRLF split
+    across reads, and emitting it now would turn one boundary into two.
+    Each returned segment INCLUDES its terminator (byte fidelity).
+    """
+    segs = []
+    start = 0
+    i = 0
+    n = len(buf)
+    while i < n:
+        c = buf[i]
+        if c == 0x0A:  # \n
+            i += 1
+            segs.append(buf[start:i])
+            start = i
+        elif c == 0x0D:  # \r
+            if i + 1 >= n:
+                break  # trailing \r: hold — may be half of a CRLF
+            i += 2 if buf[i + 1] == 0x0A else 1
+            segs.append(buf[start:i])
+            start = i
+        else:
+            i += 1
+    return segs, buf[start:]
+
+
 def make_runner(host: Dict[str, Any]) -> command_runner.CommandRunner:
     host_env = {}
     if host.get('home'):
@@ -122,6 +156,7 @@ class GangRun:
         self._lock = threading.Lock()
         self._failed = threading.Event()
         self._done = threading.Event()
+        self._stop_pumps = threading.Event()
         self._mux = None
         self._combined = open(os.path.join(log_dir, 'run.log'), 'a',
                               buffering=1, encoding='utf-8')
@@ -183,19 +218,64 @@ class GangRun:
                     pass
 
     def _pump(self, rank: int, proc, prefix: str) -> None:
-        """Pure-Python fallback pump: one thread per stream, whole lines
-        under one lock, so stdout/stderr of the same rank (separate
-        pipes) never interleave mid-line in the rank log."""
+        """Pure-Python fallback pump: one thread per stream, with a
+        per-stream partial-line carry so ONLY complete lines reach the
+        shared sinks — stdout/stderr of the same rank (separate pipes)
+        can never interleave mid-line in the rank log. A stream hitting
+        EOF mid-line (writer hard-exited) gets a synthesized '\\n': line
+        atomicity of the shared file over byte fidelity of a stream
+        that already lost its terminator (same contract as the native
+        mux, native/logmux.cpp flush_carry)."""
+        import select
         rank_log = os.path.join(self.log_dir, f'rank-{rank}.log')
         lock = threading.Lock()
-        with open(rank_log, 'a', buffering=1, encoding='utf-8') as rf:
+        with open(rank_log, 'ab') as rf:
+
+            def emit(seg: bytes) -> None:
+                with lock:
+                    rf.write(seg)
+                    rf.flush()
+                text = seg.decode('utf-8', errors='replace')
+                with self._lock:
+                    self._combined.write(prefix + text)
+                    # Explicit: bare-'\r' progress segments never trigger
+                    # the combined file's line buffering on their own.
+                    self._combined.flush()
 
             def drain(stream):
-                for line in stream:
-                    with lock:
-                        rf.write(line)
-                    with self._lock:
-                        self._combined.write(prefix + line)
+                try:
+                    fd = stream.fileno()
+                except (OSError, ValueError):
+                    return
+                carry = b''
+                while True:
+                    # select-with-timeout instead of a blocking read: an
+                    # orphan holding the write end open must not wedge
+                    # this thread forever, and the stop event (cancel
+                    # path) must be honored WITHOUT closing fds out from
+                    # under a blocked os.read (fd-recycle hazard).
+                    try:
+                        ready, _, _ = select.select([fd], [], [], 0.25)
+                    except (OSError, ValueError):
+                        break
+                    if not ready:
+                        if self._stop_pumps.is_set():
+                            break
+                        continue
+                    try:
+                        chunk = os.read(fd, 1 << 16)
+                    except (OSError, ValueError):
+                        chunk = b''
+                    if not chunk:
+                        break
+                    segs, carry = split_log_lines(carry + chunk)
+                    for seg in segs:
+                        emit(seg)
+                    if len(carry) > _MAX_LINE_CARRY:
+                        emit(carry + b'\n')
+                        carry = b''
+                if carry:
+                    emit(carry + b'\n')
 
             err_thread = None
             if proc.stderr is not None:
@@ -263,6 +343,7 @@ class GangRun:
     def run(self, cmd: str, base_env: Dict[str, str]) -> List[int]:
         hosts = self.spec['hosts']
         many = len(hosts) > 1
+        self._stop_pumps.clear()  # fresh per phase (setup vs run)
         mux = self._make_mux()
         threads = []
         for rank, host in enumerate(hosts):
@@ -306,12 +387,16 @@ class GangRun:
             t.join(timeout=15.0 if cancelled else None)
         if cancelled and any(t.is_alive() for t in threads):
             # Orphans still hold the stdout pipe (e.g. the remote marker
-            # kill found no python); force-close to unblock pump readline —
-            # the job must reach a terminal status no matter what.
-            for proc in self._procs:
-                self._close_streams(proc)
+            # kill found no python): tell the pump threads to exit at
+            # their next select tick, and only close the fds AFTER they
+            # are gone — closing first would race a recycled fd number
+            # into another component's os.read. The job must reach a
+            # terminal status no matter what.
+            self._stop_pumps.set()
             for t in threads:
                 t.join(timeout=5.0)
+            for proc in self._procs:
+                self._close_streams(proc)
         if self._mux is not None:
             if cancelled:
                 # Orphans may hold pipe write-ends open forever; tell the

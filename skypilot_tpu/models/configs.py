@@ -202,19 +202,25 @@ MIXTRAL_8X7B = _register(ModelConfig(
 
 # --- Gemma family (reference recipe: llm/gemma). (1+w)-RMSNorm, GeGLU,
 # sqrt(d)-scaled embeddings, tied unembed, 256-wide heads, rope 10k.
+# vocab_size is MXU-padded 256000 → 256128; unpadded_vocab_size both (a)
+# masks the 128 pad rows out of the logits (they score ~0 via the tied
+# attend — above real logits — so sampling could emit invalid ids) and
+# (b) makes HF export emit the real 256000-row tokenizer size. Note (a)
+# deliberately changes the softmax normalizer vs a config without the
+# guard: the pad rows were never real tokens.
 GEMMA_2B = _register(ModelConfig(
     name='gemma-2b', vocab_size=256128, d_model=2048, num_layers=18,
     num_heads=8, num_kv_heads=1, d_mlp=16384, max_seq_len=8192,
     rope_theta=10000.0, norm_eps=1e-6, head_dim_override=256,
     mlp_activation='gelu', norm_style='rms_plus1', tie_embeddings=True,
-    scale_embed_by_dim=True))
+    scale_embed_by_dim=True, unpadded_vocab_size=256000))
 
 GEMMA_7B = _register(ModelConfig(
     name='gemma-7b', vocab_size=256128, d_model=3072, num_layers=28,
     num_heads=16, num_kv_heads=16, d_mlp=24576, max_seq_len=8192,
     rope_theta=10000.0, norm_eps=1e-6, head_dim_override=256,
     mlp_activation='gelu', norm_style='rms_plus1', tie_embeddings=True,
-    scale_embed_by_dim=True))
+    scale_embed_by_dim=True, unpadded_vocab_size=256000))
 
 # Gemma-2 adds attention/final logit softcaps (tanh-capped on the XLA
 # attention path). Approximations vs the released architecture: the
@@ -228,7 +234,8 @@ GEMMA2_9B = _register(ModelConfig(
     rope_theta=10000.0, norm_eps=1e-6, head_dim_override=256,
     mlp_activation='gelu', norm_style='rms_plus1', tie_embeddings=True,
     scale_embed_by_dim=True, attn_logit_softcap=50.0,
-    final_logit_softcap=30.0, attention_impl='xla'))
+    final_logit_softcap=30.0, attention_impl='xla',
+    unpadded_vocab_size=256000))
 
 # --- Mistral (reference recipes: llm/vicuna-llama-2 era serving stacks):
 # Llama shape + uniform 4096-key sliding window on every layer — the

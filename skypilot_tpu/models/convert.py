@@ -146,10 +146,17 @@ def to_hf(params: Mapping[str, Any],
 
     GPT-2's packed-Conv1D layout is reconstructed; tied models emit the
     embedding under both the embed and lm_head keys the way HF ties
-    them. Vocab padding rows (if any) are NOT stripped — pass the padded
-    vocab_size in the HF config or slice the two vocab tensors yourself.
+    them. MXU vocab-padding rows (cfg.unpadded_vocab_size <
+    cfg.vocab_size, e.g. Gemma 256000→256128, GPT-2 50257→50304) ARE
+    stripped so the export matches the real tokenizer — hf_config_for
+    emits the unpadded size to match; from_hf re-pads on the way back.
     """
     p = {k: _cast_tree(v, np.float32) for k, v in params.items()}
+    if 0 < cfg.unpadded_vocab_size < cfg.vocab_size:
+        n = cfg.unpadded_vocab_size
+        p['embed'] = {'embedding': p['embed']['embedding'][:n]}
+        if not cfg.tie_embeddings and 'lm_head' in p:
+            p['lm_head'] = {'kernel': p['lm_head']['kernel'][:, :n]}
     layers = p['layers']['layer']
     gpt2 = cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain'
     sd: Dict[str, np.ndarray] = {}
@@ -237,8 +244,13 @@ def jax_tree_index(tree, i: int):
 
 def hf_config_for(cfg: ModelConfig):
     """Build the matching transformers config (family chosen from the
-    same flags the forward pass branches on)."""
+    same flags the forward pass branches on). Emits the UNPADDED vocab
+    size when the config pads for MXU tiling (Gemma 256000, GPT-2
+    50257), matching what to_hf exports and the real tokenizer."""
     import transformers
+    hf_vocab = (cfg.unpadded_vocab_size
+                if 0 < cfg.unpadded_vocab_size < cfg.vocab_size
+                else cfg.vocab_size)
     if cfg.attn_logit_softcap or cfg.final_logit_softcap:
         raise NotImplementedError(
             'softcapped (Gemma-2-style) configs have no faithful HF '
@@ -246,12 +258,12 @@ def hf_config_for(cfg: ModelConfig):
             'neither GemmaConfig nor Gemma2Config reproduces it')
     if cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain':
         return transformers.GPT2Config(
-            vocab_size=cfg.vocab_size, n_embd=cfg.d_model,
+            vocab_size=hf_vocab, n_embd=cfg.d_model,
             n_layer=cfg.num_layers, n_head=cfg.num_heads,
             n_inner=cfg.d_mlp, n_positions=cfg.max_seq_len,
             layer_norm_epsilon=cfg.norm_eps)
     common = dict(
-        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        vocab_size=hf_vocab, hidden_size=cfg.d_model,
         intermediate_size=cfg.d_mlp, num_hidden_layers=cfg.num_layers,
         num_attention_heads=cfg.num_heads,
         num_key_value_heads=cfg.num_kv_heads,

@@ -21,6 +21,13 @@ def main(argv=None) -> int:
                         help='Orbax dir written by train/run.py')
     parser.add_argument('--out', required=True,
                         help='output HF checkpoint dir')
+    parser.add_argument('--dtype', default='float32',
+                        choices=['float32', 'bfloat16'],
+                        help='param dtype to restore/export with. '
+                             'Training keeps fp32 master weights, so '
+                             'float32 (default) is lossless; bfloat16 '
+                             'halves the export at the cost of '
+                             'truncating the fp32 masters.')
     args = parser.parse_args(argv)
 
     import jax
@@ -29,7 +36,7 @@ def main(argv=None) -> int:
     from skypilot_tpu.models.convert import export_hf_checkpoint
     from skypilot_tpu.models.inference import load_params_from_checkpoint
 
-    cfg = get_config(args.model, param_dtype='bfloat16')
+    cfg = get_config(args.model, param_dtype=args.dtype)
     params = load_params_from_checkpoint(cfg, args.checkpoint_dir)
     host_params = jax.tree.map(jax.device_get, params)
     export_hf_checkpoint(host_params, cfg, args.out)

@@ -118,13 +118,14 @@ void emit(Mux* m, Stream* s, const char* data, size_t n) {
   s->carry.erase(0, start);
   if (s->carry.size() > kMaxCarry) {
     // Pathological no-terminator stream: force-flush with a synthesized
-    // newline so memory stays bounded.
+    // newline (in BOTH sinks — the rank file is shared with the rank's
+    // other stream and must stay line-atomic) so memory stays bounded.
+    s->carry.push_back('\n');
     write_all(s->rank_fd, s->carry.data(), s->carry.size());
     if (!s->prefix.empty()) {
       write_all(m->combined_fd, s->prefix.data(), s->prefix.size());
     }
     write_all(m->combined_fd, s->carry.data(), s->carry.size());
-    write_all(m->combined_fd, "\n", 1);
     m->lines++;
     s->carry.clear();
   }
@@ -132,15 +133,39 @@ void emit(Mux* m, Stream* s, const char* data, size_t n) {
 
 void flush_carry(Mux* m, Stream* s) {
   if (s->carry.empty()) return;
-  // Rank file keeps byte fidelity: the unterminated tail goes out as-is.
+  // An unterminated tail (writer died mid-line, or teardown) gets a
+  // synthesized '\n' in BOTH sinks. The rank file used to keep byte
+  // fidelity here (tail as-is, no newline) — but the rank log is shared
+  // by the rank's stdout AND stderr streams, so an unterminated tail
+  // let the OTHER stream's next line concatenate onto it
+  // ("WORLD[Gloo] Rank 0 ..."). Line atomicity of the shared file wins
+  // over byte fidelity of a stream that already lost its terminator.
+  s->carry.push_back('\n');
   write_all(s->rank_fd, s->carry.data(), s->carry.size());
   if (!s->prefix.empty()) {
     write_all(m->combined_fd, s->prefix.data(), s->prefix.size());
   }
   write_all(m->combined_fd, s->carry.data(), s->carry.size());
-  write_all(m->combined_fd, "\n", 1);
   m->lines++;
   s->carry.clear();
+}
+
+// Final non-blocking drain: data can still sit in the pipe when stop()
+// is called (cancellation) — dropping it loses completed lines the
+// writer successfully emitted. Pull until EAGAIN/EOF (bounded) so the
+// log contains everything that reached the kernel before teardown.
+void drain_remaining(Mux* m, Stream* s) {
+  constexpr size_t kDrainCap = 4 << 20;  // bound a writer that won't stop
+  int flags = fcntl(s->fd, F_GETFL, 0);
+  if (flags >= 0) fcntl(s->fd, F_SETFL, flags | O_NONBLOCK);
+  char buf[kReadChunk];
+  size_t total = 0;
+  while (total < kDrainCap) {
+    ssize_t r = read(s->fd, buf, sizeof(buf));
+    if (r <= 0) break;  // EOF, EAGAIN, or error: stop draining
+    emit(m, s, buf, static_cast<size_t>(r));
+    total += static_cast<size_t>(r);
+  }
 }
 
 void* pump_loop(void* arg) {
@@ -182,9 +207,13 @@ void* pump_loop(void* arg) {
       }
     }
   }
-  // Stopped early (cancellation): flush partials so nothing is lost.
+  // Stopped early (cancellation): drain what already reached the pipe,
+  // then flush partials, so nothing the writers completed is lost.
   for (auto& s : m->streams) {
-    if (!s.eof) flush_carry(m, &s);
+    if (!s.eof) {
+      drain_remaining(m, &s);
+      flush_carry(m, &s);
+    }
   }
   return nullptr;
 }

@@ -1,0 +1,142 @@
+"""The gang driver's pure-Python pump fallback must give the same
+line-atomicity contract as the native mux (native/logmux.cpp): only
+complete lines reach the shared rank log, EOF-partials get a synthesized
+terminator, CR/CRLF are boundaries. These run without a C++ toolchain —
+they ARE the no-toolchain path.
+"""
+import os
+import threading
+import time
+
+from skypilot_tpu.agent import driver
+
+
+class TestSplitLogLines:
+
+    def test_plain_newlines(self):
+        segs, carry = driver.split_log_lines(b'a\nb\nc')
+        assert segs == [b'a\n', b'b\n']
+        assert carry == b'c'
+
+    def test_crlf_is_one_boundary(self):
+        segs, carry = driver.split_log_lines(b'a\r\nb\r\n')
+        assert segs == [b'a\r\n', b'b\r\n']
+        assert carry == b''
+
+    def test_bare_cr_is_a_boundary(self):
+        segs, carry = driver.split_log_lines(b'progress 1\rprogress 2\r' +
+                                             b'tail')
+        assert segs == [b'progress 1\r', b'progress 2\r']
+        assert carry == b'tail'
+
+    def test_trailing_cr_held_for_possible_crlf(self):
+        segs, carry = driver.split_log_lines(b'x\r')
+        assert segs == []
+        assert carry == b'x\r'
+        # ...and joins with the next chunk's \n as ONE boundary.
+        segs, carry = driver.split_log_lines(carry + b'\ny\n')
+        assert segs == [b'x\r\n', b'y\n']
+        assert carry == b''
+
+    def test_empty(self):
+        assert driver.split_log_lines(b'') == ([], b'')
+
+
+class _FakeStream:
+    def __init__(self, fd):
+        self._fd = fd
+
+    def fileno(self):
+        return self._fd
+
+
+class _FakeProc:
+    """Just enough of Popen for GangRun._pump: two pipe-backed streams
+    and a wait() that returns once both write ends are closed."""
+
+    def __init__(self, rc=0):
+        self._rc = rc
+        out_r, self.out_w = os.pipe()
+        err_r, self.err_w = os.pipe()
+        self.stdout = _FakeStream(out_r)
+        self.stderr = _FakeStream(err_r)
+        self._done = threading.Event()
+
+    def wait(self):
+        self._done.wait(10)
+        return self._rc
+
+    def poll(self):
+        return self._rc if self._done.is_set() else None
+
+    def finish(self):
+        for fd in (self.out_w, self.err_w):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._done.set()
+
+
+def _make_gang(tmp_path):
+    spec = {'job_id': 1, 'hosts': [{'slice': 0, 'host': 0,
+                                    'ip': '127.0.0.1'}]}
+    return driver.GangRun(spec, str(tmp_path), 'marker')
+
+
+class TestPumpFallback:
+
+    def test_stdout_partial_never_torn_by_stderr(self, tmp_path):
+        """stdout emits 'WORLD' then stalls; stderr emits a full line;
+        stdout completes later. The rank log must contain both WHOLE
+        lines — never 'WORLD[Gloo]...'."""
+        gang = _make_gang(tmp_path)
+        proc = _FakeProc()
+        t = threading.Thread(target=gang._pump, args=(0, proc, ''),
+                             daemon=True)
+        t.start()
+        os.write(proc.out_w, b'WORLD')
+        time.sleep(0.15)
+        os.write(proc.err_w, b'[Gloo] Rank 0 is connected\n')
+        time.sleep(0.15)
+        os.write(proc.out_w, b' 2 RANKSUM 1\n')
+        proc.finish()
+        t.join(5)
+        assert not t.is_alive()
+        gang.close()
+        lines = (tmp_path / 'rank-0.log').read_text().splitlines()
+        assert 'WORLD 2 RANKSUM 1' in lines, lines
+        assert '[Gloo] Rank 0 is connected' in lines, lines
+
+    def test_eof_partial_gets_synthesized_terminator(self, tmp_path):
+        """Writer dies mid-line: the tail is flushed WITH a terminator so
+        the other stream's next line cannot concatenate onto it."""
+        gang = _make_gang(tmp_path)
+        proc = _FakeProc()
+        t = threading.Thread(target=gang._pump, args=(0, proc, ''),
+                             daemon=True)
+        t.start()
+        os.write(proc.out_w, b'WORLD')
+        os.close(proc.out_w)  # stdout writer dies mid-line
+        time.sleep(0.2)
+        os.write(proc.err_w, b'[Gloo] Rank 0 is connected\n')
+        proc.finish()
+        t.join(5)
+        assert not t.is_alive()
+        gang.close()
+        lines = (tmp_path / 'rank-0.log').read_text().split('\n')
+        assert 'WORLD' in lines, lines
+        assert '[Gloo] Rank 0 is connected' in lines, lines
+
+    def test_cr_progress_stream_passes_through(self, tmp_path):
+        gang = _make_gang(tmp_path)
+        proc = _FakeProc()
+        t = threading.Thread(target=gang._pump, args=(0, proc, ''),
+                             daemon=True)
+        t.start()
+        os.write(proc.out_w, b'step 1\rstep 2\rstep 2 done\n')
+        proc.finish()
+        t.join(5)
+        gang.close()
+        data = (tmp_path / 'rank-0.log').read_bytes()
+        assert data == b'step 1\rstep 2\rstep 2 done\n'

@@ -191,7 +191,10 @@ class TestLogMux:
             proc.stdout.close()
             mux.wait()
         assert combined.read_text() == 'no-newline\n'
-        assert (tmp_path / 'rank-0.log').read_text() == 'no-newline'
+        # The rank file gets a synthesized terminator too: it is shared
+        # with the rank's other stream, and an unterminated tail would
+        # let that stream's next line concatenate onto it.
+        assert (tmp_path / 'rank-0.log').read_text() == 'no-newline\n'
 
     def test_stop_unblocks_wait_with_open_pipe(self, tmp_path):
         # Regression (cancel path): an orphan holding the pipe write-end
@@ -212,6 +215,53 @@ class TestLogMux:
         os_mod.close(write_fd)
         assert '(0) partial-no-newline\n' in \
             (tmp_path / 'run.log').read_text()
+
+    def test_writer_death_mid_line_keeps_shared_rank_log_atomic(
+            self, tmp_path):
+        """The r3 flake, reproduced deterministically: a rank's stdout
+        hits EOF mid-line (writer hard-exited) while its stderr — same
+        rank log — keeps emitting lines. The unterminated stdout tail
+        must NOT let a stderr line concatenate onto it
+        ('WORLD[Gloo] Rank 0 is connected...')."""
+        import os as os_mod
+        out_r, out_w = os_mod.pipe()
+        err_r, err_w = os_mod.pipe()
+        rank = tmp_path / 'rank-0.log'
+        with logmux_lib.LogMux(str(tmp_path / 'run.log')) as mux:
+            mux.add_stream(out_r, str(rank), '(rank 0) ')
+            mux.add_stream(err_r, str(rank), '(rank 0) ')
+            mux.start()
+            os_mod.write(out_w, b'WORLD')   # partial: no terminator
+            os_mod.close(out_w)             # writer dies mid-line
+            time.sleep(0.4)                 # let the mux see the EOF
+            os_mod.write(err_w, b'[Gloo] Rank 0 is connected\n')
+            os_mod.close(err_w)
+            mux.wait()
+        os_mod.close(out_r)
+        os_mod.close(err_r)
+        lines = rank.read_text().split('\n')
+        assert 'WORLD' in lines, lines
+        assert '[Gloo] Rank 0 is connected' in lines, lines
+
+    def test_stop_drains_data_still_in_the_pipe(self, tmp_path):
+        """Lines the writer completed before cancellation must reach the
+        log even if the mux thread had not polled them yet when stop()
+        was called."""
+        import os as os_mod
+        read_fd, write_fd = os_mod.pipe()
+        rank = tmp_path / 'rank-0.log'
+        with logmux_lib.LogMux(str(tmp_path / 'run.log')) as mux:
+            mux.add_stream(read_fd, str(rank), '(0) ')
+            mux.start()
+            os_mod.write(write_fd, b'completed-line\npartial')
+            # Stop immediately: the data above may not have been polled.
+            mux.stop()
+            mux.wait()
+        os_mod.close(read_fd)
+        os_mod.close(write_fd)
+        text = rank.read_text()
+        assert 'completed-line\n' in text
+        assert 'partial\n' in text  # synthesized terminator
 
     def test_throughput_vs_python(self, tmp_path):
         """The point of going native: mux N chatty streams faster than
