@@ -39,6 +39,15 @@ def _param_dtype(cfg: ModelConfig) -> Dtype:
     return jnp.dtype(cfg.param_dtype)
 
 
+def checkpoint_policy_for(cfg: ModelConfig):
+    """The remat_policy → jax.checkpoint policy mapping, shared by the
+    sequential scan path (below) and the pipeline executor
+    (train/trainer.py) so the two execution strategies remat alike."""
+    if cfg.remat_policy == 'dots':
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return jax.checkpoint_policies.nothing_saveable
+
+
 class QuantDenseGeneral(nn.Module):
     """Weight-only int8 dense: `kernel_q` (int8) + per-output-channel
     `kernel_scale` (fp32), produced from a float checkpoint by
@@ -344,12 +353,15 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
-                 positions: Optional[jax.Array] = None) -> jax.Array:
+                 positions: Optional[jax.Array] = None,
+                 mode: str = 'full') -> jax.Array:
+        """mode: 'full' (tokens → logits, the normal path), or the two
+        halves the pipeline executor (parallel/pipeline.py) sandwiches
+        around its microbatched layer schedule — 'embed' (tokens →
+        (hidden, positions), stops before the layer stack) and 'head'
+        (`tokens` IS the hidden state [B,T,D]; final norm + unembed).
+        All modes share one param tree; init uses 'full'."""
         cfg = self.cfg
-        if positions is None:
-            positions = jnp.broadcast_to(
-                jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :],
-                tokens.shape)
         # Tied models reuse this table as the unembed projection: init at
         # d^-1/2 so step-0 logits land at O(1) (and the Gemma sqrt(d)
         # input scaling restores O(1) activations). Untied keeps the
@@ -362,6 +374,12 @@ class Transformer(nn.Module):
                 nn.initializers.normal(stddev=embed_std),
                 ('vocab', 'embed')),
             name='embed')
+        if mode == 'head':
+            return self._head(embed, tokens)
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :],
+                tokens.shape)
         x = embed(tokens)
         if cfg.scale_embed_by_dim:
             x = x * jnp.asarray(cfg.d_model**0.5, dtype=x.dtype)
@@ -374,16 +392,14 @@ class Transformer(nn.Module):
                     (None, 'embed')),
                 name='pos_embed')(positions)
         x = sharding.constrain(x, 'batch', 'seq', 'act_embed')
+        if mode == 'embed':
+            return x, positions
 
         if cfg.scan_layers:
             layer_cls = _ScannedLayer
             if cfg.remat:
-                policy = (
-                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                    if cfg.remat_policy == 'dots' else
-                    jax.checkpoint_policies.nothing_saveable)
                 layer_cls = nn.remat(layer_cls, prevent_cse=False,
-                                     policy=policy)
+                                     policy=checkpoint_policy_for(cfg))
             scanned = nn.scan(
                 layer_cls,
                 variable_axes={'params': 0, 'cache': 0},
@@ -400,6 +416,13 @@ class Transformer(nn.Module):
             for i in range(cfg.num_layers):
                 x = layer_ctor(cfg, name=f'layer_{i}')(x, positions)
 
+        return self._head(embed, x)
+
+    def _head(self, embed: nn.Embed, x: jax.Array) -> jax.Array:
+        """Final norm + unembed (+ softcap + pad-row mask). Plain helper
+        inside the compact scope — `embed` is the single shared instance
+        (tied unembed)."""
+        cfg = self.cfg
         x = RMSNorm(cfg, name='final_norm')(x)
         if cfg.tie_embeddings:
             logits = embed.attend(x)

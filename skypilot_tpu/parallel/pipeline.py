@@ -1,0 +1,171 @@
+"""Microbatched SPMD pipeline parallelism over the `pp` mesh axis.
+
+The reference delegates pipeline parallelism to the engines it launches
+(torchrun/DeepSpeed recipes — e.g. /root/reference/llm/axolotl and the
+multi-node examples around /root/reference/tests/test_smoke.py:1839 wire
+ranks and leave the schedule to the engine). Here the schedule is
+in-tree and TPU-native: instead of point-to-point sends between stage
+*processes* (the GPU idiom), the pipeline is a single SPMD program —
+every device holds one stage's contiguous block of layers, all stages
+run concurrently on *different* microbatches, and activations move one
+stage to the right through a `jnp.roll` on the stage-sharded buffer,
+which GSPMD lowers to a `collective-permute` riding ICI neighbor links.
+
+Schedule
+--------
+GPipe-style fill-and-drain, expressed as one `lax.scan` over
+`num_microbatches + num_stages - 1` ticks:
+
+    tick t:  stage 0 ingests microbatch t (while t < M)
+             every stage s applies its L/S layers to its current
+             microbatch            (vmap over the stage dim)
+             outputs shift s → s+1 (roll ⇒ collective-permute)
+             stage S-1 retires microbatch t-(S-1) (while t ≥ S-1)
+
+Bubble fraction is (S-1)/(M+S-1) — amortized away by raising M. The
+backward schedule is the exact transpose: `jax.grad` differentiates the
+scan, and the transpose of the shift-right collective-permute is a
+shift-left, so cooldown gradients counter-rotate through the stages
+(1F1B's memory profile is approximated by rematerializing each tick:
+`remat='tick'` checkpoints the per-tick stage compute, so only the
+pipeline buffer and per-tick boundaries live across the scan).
+
+Design properties:
+- **Zero param-layout change.** The executor consumes the SAME stacked
+  layer tree the `nn.scan` path trains ([L, ...] leaves, 'layers'→pp
+  sharded): it reshapes [L, ...] → [S, L/S, ...] *inside* jit, which is
+  layout-local because GSPMD blocks dim-0 contiguously over pp.
+  Checkpoints are interchangeable between pp=1 and pp>1 — pipelining is
+  an execution strategy, not a model format.
+- Composes with tp/sp/fsdp/ep: the vmapped stage body carries all the
+  layer's own logical-axis constraints; the stage dim adds one leading
+  'stage'→pp axis (parallel/sharding.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from skypilot_tpu.parallel import sharding
+
+LayerApply = Callable[[Any, jax.Array, jax.Array], jax.Array]
+
+
+def stages_from_stack(layer_params: Any, num_stages: int) -> Any:
+    """[L, ...] stacked layer tree → [S, L/S, ...] staged tree.
+
+    Pure reshape: GSPMD shards dim 0 in contiguous blocks, so the staged
+    view keeps every layer's weights on the device that runs its stage.
+    """
+    def reshape(leaf):
+        n_layers = leaf.shape[0]
+        if n_layers % num_stages:
+            raise ValueError(
+                f'{n_layers} layers not divisible by {num_stages} stages')
+        return leaf.reshape((num_stages, n_layers // num_stages)
+                            + leaf.shape[1:])
+    return jax.tree.map(reshape, layer_params)
+
+
+def pipeline_apply(
+    layer_apply: LayerApply,
+    layer_params: Any,
+    x: jax.Array,
+    positions: jax.Array,
+    *,
+    num_stages: int,
+    num_microbatches: int,
+    remat: bool = True,
+    checkpoint_policy: Optional[Any] = None,
+) -> jax.Array:
+    """Run the stacked layer tree as a microbatched SPMD pipeline.
+
+    Args:
+      layer_apply: pure fn (one_layer_params, x[mb,T,D], pos[mb,T]) → x.
+      layer_params: stacked tree, every leaf [num_layers, ...],
+        dim 0 sharded 'layers'→pp (the nn.scan layout).
+      x: embedded activations [B, T, D] (batch sharded dp/fsdp).
+      positions: [B, T] int32.
+      num_stages: pp-axis size. num_layers % num_stages == 0.
+      num_microbatches: M. B % M == 0. M >= num_stages keeps the bubble
+        fraction at (S-1)/(M+S-1); M=1..S-1 still runs correctly.
+      remat: checkpoint each tick's stage compute (the pipeline
+        equivalent of per-layer remat).
+
+    Returns: activations [B, T, D] after all layers, microbatch order
+      restored (bitwise same math as the sequential scan).
+    """
+    S, M = num_stages, num_microbatches
+    batch, seq_len, d_model = x.shape
+    if batch % M:
+        raise ValueError(f'batch {batch} not divisible by '
+                         f'{M} microbatches')
+    mb = batch // M
+    stage_params = stages_from_stack(layer_params, S)
+    mb_x = x.reshape(M, mb, seq_len, d_model)
+    mb_pos = positions.reshape(M, mb, seq_len)
+
+    def stage_fn(p_stage, x_s, pos_s):
+        """Apply one stage's L/S layers sequentially (per-stage scan)."""
+        def body(carry, p_layer):
+            return layer_apply(p_layer, carry, pos_s), None
+        out, _ = lax.scan(body, x_s, p_stage)
+        return out
+
+    vstages = jax.vmap(stage_fn)
+    if remat:
+        policy = checkpoint_policy
+        vstages = jax.checkpoint(vstages, prevent_cse=False,
+                                 policy=policy)
+
+    def constrain_state(s):
+        return sharding.constrain(s, 'stage', 'batch', 'seq', 'act_embed')
+
+    state_x = constrain_state(jnp.zeros((S, mb, seq_len, d_model),
+                                        x.dtype))
+    state_pos = jnp.zeros((S, mb, seq_len), positions.dtype)
+    out_buf = jnp.zeros((M, mb, seq_len, d_model), x.dtype)
+
+    def tick(carry, t):
+        state_x, state_pos, out_buf = carry
+        # Ingest: microbatch t enters stage 0 (clamped re-reads during
+        # the drain phase are overwritten by nothing — stage 0's output
+        # there never reaches out_buf).
+        t_in = jnp.minimum(t, M - 1)
+        state_x = state_x.at[0].set(
+            lax.dynamic_index_in_dim(mb_x, t_in, 0, keepdims=False))
+        state_pos = state_pos.at[0].set(
+            lax.dynamic_index_in_dim(mb_pos, t_in, 0, keepdims=False))
+        state_x = constrain_state(state_x)
+        # Compute: all stages in parallel (SPMD over 'stage'→pp).
+        y = vstages(stage_params, state_x, state_pos)
+        y = constrain_state(y)
+        # Retire: the last stage just finished microbatch t-(S-1). The
+        # clamped index writes warm-up garbage at slot 0 until t=S-1
+        # overwrites it with the real first microbatch.
+        t_out = jnp.maximum(t - (S - 1), 0)
+        out_buf = lax.dynamic_update_index_in_dim(
+            out_buf, y[S - 1], t_out, 0)
+        # Shift: stage s's output becomes stage s+1's input — roll on
+        # the pp-sharded dim ⇒ collective-permute (neighbor ICI hop).
+        state_x = constrain_state(jnp.roll(y, 1, axis=0))
+        state_pos = jnp.roll(state_pos, 1, axis=0)
+        return (state_x, state_pos, out_buf), None
+
+    (_, _, out_buf), _ = lax.scan(
+        tick, (state_x, state_pos, out_buf), jnp.arange(M + S - 1))
+    return out_buf.reshape(batch, seq_len, d_model)
+
+
+def pipeline_num_ticks(num_stages: int, num_microbatches: int) -> int:
+    """Scan length of the schedule: M + S - 1 (fill + steady + drain)."""
+    return num_microbatches + num_stages - 1
+
+
+def bubble_fraction(num_stages: int, num_microbatches: int) -> float:
+    """Idle fraction of the GPipe schedule: (S-1)/(M+S-1)."""
+    return (num_stages - 1) / pipeline_num_ticks(num_stages,
+                                                 num_microbatches)

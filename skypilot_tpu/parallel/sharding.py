@@ -31,6 +31,7 @@ LOGICAL_AXIS_RULES: List[Tuple[str, object]] = [
     ('vocab', 'tp'),                # embedding/unembedding vocab dim
     ('expert', 'ep'),               # MoE experts under expert parallelism
     ('layers', 'pp'),               # stacked layer dim under pipeline
+    ('stage', 'pp'),                # pipeline executor's stage buffers
     (None, None),
 ]
 

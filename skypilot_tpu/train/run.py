@@ -47,6 +47,11 @@ def main(argv=None) -> int:
     parser.add_argument('--dp', type=int, default=None)
     parser.add_argument('--ep', type=int, default=None,
                         help='expert-parallel axis size (MoE models)')
+    parser.add_argument('--pp', type=int, default=None,
+                        help='pipeline-parallel stage count')
+    parser.add_argument('--microbatches', type=int, default=None,
+                        help='microbatches for the pipelined schedule '
+                        '(requires --pp > 1; defaults to 4x stages)')
     parser.add_argument('--log-every', type=int, default=10)
     parser.add_argument('--profile-dir', default=None,
                         help='capture an XLA/jax.profiler trace of steps '
@@ -79,7 +84,8 @@ def main(argv=None) -> int:
 
     # 2. Mesh over every chip in the job.
     mesh_cfg = infer_mesh_config(jax.device_count(), tp=args.tp,
-                                 sp=args.sp, dp=args.dp, ep=args.ep)
+                                 sp=args.sp, dp=args.dp, ep=args.ep,
+                                 pp=args.pp)
     mesh = build_mesh(mesh_cfg)
     logger.info('mesh: %s', mesh_cfg)
 
@@ -114,7 +120,31 @@ def main(argv=None) -> int:
                     args.init_from_hf)
 
     # 4. The step loop.
-    step_fn = make_train_step(cfg, mesh, shardings)
+    microbatches = args.microbatches
+    if microbatches and mesh_cfg.pp <= 1:
+        raise SystemExit('--microbatches requires a pp>1 mesh '
+                         '(pass --pp); with pp=1 the sequential step '
+                         'would silently ignore it')
+    if microbatches and args.batch % microbatches:
+        raise SystemExit(f'--batch {args.batch} must be divisible by '
+                         f'--microbatches {microbatches}')
+    if mesh_cfg.pp > 1 and microbatches is None:
+        # Target 4 per stage ((S-1)/(M+S-1) bubble ≈ 1/5), clamped to
+        # the largest divisor of the batch ≥ pp — fail fast here, not
+        # after state init, if even pp microbatches can't divide it.
+        want = 4 * mesh_cfg.pp
+        microbatches = next((m for m in range(min(want, args.batch),
+                                              mesh_cfg.pp - 1, -1)
+                             if args.batch % m == 0), None)
+        if microbatches is None:
+            raise SystemExit(
+                f'--batch {args.batch} has no divisor >= pp='
+                f'{mesh_cfg.pp} to use as a microbatch count; raise '
+                f'--batch or pass --microbatches explicitly')
+        logger.info('pipeline: pp=%d, defaulting to %d microbatches',
+                    mesh_cfg.pp, microbatches)
+    step_fn = make_train_step(cfg, mesh, shardings,
+                              microbatches=microbatches)
     callbacks.init(total_steps=args.steps)
     dataset = None
     if args.data_dir and args.sft_data:

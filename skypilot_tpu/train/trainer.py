@@ -107,16 +107,49 @@ def make_train_step(
     cfg: ModelConfig,
     mesh: Mesh,
     state_shardings: Any,
+    microbatches: Optional[int] = None,
 ) -> Callable[[TrainState, Dict[str, jax.Array]],
               Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build the jitted train step: loss → grad → clip → adamw update.
 
     Donates the state so params/moments update in place (HBM win).
+
+    `microbatches` (with a pp>1 mesh) switches the forward to the
+    microbatched SPMD pipeline schedule (parallel/pipeline.py): embed →
+    pipelined layer stack (vmap over stages + collective-permute
+    shifts) → head, over the SAME param tree as the sequential path —
+    checkpoints stay interchangeable across pp settings.
     """
     model = Transformer(cfg)
+    num_stages = mesh.shape.get('pp', 1) if hasattr(mesh, 'shape') else 1
+    pipelined = bool(microbatches) and num_stages > 1
+    if pipelined and not cfg.scan_layers:
+        raise ValueError('pipeline parallelism requires scan_layers=True '
+                         '(stacked layer params)')
+    if pipelined and cfg.num_layers % num_stages:
+        raise ValueError(f'{cfg.num_layers} layers not divisible by '
+                         f'pp={num_stages}')
 
     def loss_fn(params, batch):
-        logits = model.apply({'params': params}, batch['inputs'])
+        if pipelined:
+            from skypilot_tpu.models.transformer import (
+                DecoderLayer, checkpoint_policy_for)
+            from skypilot_tpu.parallel import pipeline
+            x, positions = model.apply({'params': params},
+                                       batch['inputs'], mode='embed')
+            layer_module = DecoderLayer(cfg)
+
+            def layer_apply(p_layer, h, pos):
+                return layer_module.apply({'params': p_layer}, h, pos)
+
+            x = pipeline.pipeline_apply(
+                layer_apply, params['layers']['layer'], x, positions,
+                num_stages=num_stages, num_microbatches=microbatches,
+                remat=cfg.remat,
+                checkpoint_policy=checkpoint_policy_for(cfg))
+            logits = model.apply({'params': params}, x, mode='head')
+        else:
+            logits = model.apply({'params': params}, batch['inputs'])
         return cross_entropy_loss(logits, batch['targets'],
                                   batch.get('mask'))
 

@@ -1,0 +1,152 @@
+"""Microbatched pipeline parallelism (parallel/pipeline.py).
+
+The VERDICT r3 bar: pp must be a real microbatched schedule, not weight
+sharding — pp>1 loss must equal pp=1 loss, the schedule must actually
+pipeline (collective-permute between stages), and the microbatch
+structure must be testable.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import get_config
+from skypilot_tpu.parallel import (MeshConfig, build_mesh)
+from skypilot_tpu.parallel import pipeline
+from skypilot_tpu.train import (TrainConfig, create_sharded_state,
+                                make_train_step, synthetic_batch)
+
+
+def _need_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f'needs {n} devices')
+
+
+class TestScheduleStructure:
+
+    def test_tick_count_is_fill_plus_drain(self):
+        assert pipeline.pipeline_num_ticks(4, 8) == 11
+        assert pipeline.pipeline_num_ticks(1, 1) == 1
+
+    def test_bubble_fraction(self):
+        assert pipeline.bubble_fraction(4, 8) == pytest.approx(3 / 11)
+        assert pipeline.bubble_fraction(1, 8) == 0.0
+
+    def test_stages_from_stack_is_contiguous_blocks(self):
+        stack = {'w': jnp.arange(8)}
+        staged = pipeline.stages_from_stack(stack, 4)
+        np.testing.assert_array_equal(
+            np.asarray(staged['w']), np.arange(8).reshape(4, 2))
+
+    def test_indivisible_layers_rejected(self):
+        with pytest.raises(ValueError, match='not divisible'):
+            pipeline.stages_from_stack({'w': jnp.arange(6)}, 4)
+
+    def test_toy_pipeline_matches_sequential(self):
+        """S=4 stages of 2 'layers' each (scale by p): the pipeline must
+        reproduce the sequential product exactly, microbatch order
+        preserved — this pins the ingest/retire/shift bookkeeping."""
+        _need_devices(4)
+        L, S, M = 8, 4, 8
+        mesh = build_mesh(MeshConfig(pp=4), jax.devices()[:4])
+        scales = jnp.arange(1.0, L + 1)          # [L]
+        # x: [B=16, T=4, D=8]; each row tagged by batch index.
+        x = jnp.broadcast_to(
+            jnp.arange(16.0)[:, None, None], (16, 4, 8))
+        pos = jnp.zeros((16, 4), jnp.int32)
+
+        def layer_apply(p, h, _pos):
+            return h * p['w']
+
+        with mesh:
+            out = jax.jit(lambda xx: pipeline.pipeline_apply(
+                layer_apply, {'w': scales}, xx, pos,
+                num_stages=S, num_microbatches=M, remat=False))(x)
+        want = x * np.prod(np.arange(1.0, L + 1))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-6)
+
+    def test_toy_pipeline_fewer_microbatches_than_stages(self):
+        """M < S (pure fill/drain, no steady state) must still be
+        correct — the clamped ingest re-reads must not corrupt output."""
+        _need_devices(4)
+        mesh = build_mesh(MeshConfig(pp=4), jax.devices()[:4])
+        scales = jnp.full((4,), 2.0)
+        x = jnp.broadcast_to(jnp.arange(4.0)[:, None, None], (4, 2, 4))
+        pos = jnp.zeros((4, 2), jnp.int32)
+        with mesh:
+            out = jax.jit(lambda xx: pipeline.pipeline_apply(
+                lambda p, h, _: h * p['w'], {'w': scales}, xx, pos,
+                num_stages=4, num_microbatches=2, remat=False))(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 16.0,
+                                   rtol=1e-6)
+
+
+class TestPipelinedTrainStep:
+
+    def _loss_and_grads(self, mesh_cfg, microbatches, batch, seed=0):
+        cfg = get_config('test-tiny', attention_impl='xla')
+        mesh = build_mesh(mesh_cfg,
+                          jax.devices()[:mesh_cfg.num_devices])
+        state, shardings = create_sharded_state(
+            cfg, mesh, jax.random.PRNGKey(seed),
+            TrainConfig(warmup_steps=1, total_steps=4))
+        step = make_train_step(cfg, mesh, shardings,
+                               microbatches=microbatches)
+        with mesh:
+            new_state, metrics = step(state, batch)
+        return (float(metrics['loss']), float(metrics['grad_norm']))
+
+    def test_pp2_loss_equals_pp1_loss(self):
+        """The headline guarantee: pipelining is an execution strategy —
+        identical math, identical loss and grad norm vs the sequential
+        scan, from the same param tree (same init seed)."""
+        _need_devices(8)
+        batch = synthetic_batch(jax.random.PRNGKey(7), 8, 32, 512)
+        loss_seq, gn_seq = self._loss_and_grads(
+            MeshConfig(fsdp=8), None, batch)
+        loss_pp, gn_pp = self._loss_and_grads(
+            MeshConfig(pp=2, fsdp=4), 4, batch)
+        assert loss_seq == pytest.approx(loss_pp, rel=2e-4), (
+            loss_seq, loss_pp)
+        assert gn_seq == pytest.approx(gn_pp, rel=2e-3), (gn_seq, gn_pp)
+
+    def test_pipelined_step_hlo_pipelines(self):
+        """The compiled step must contain collective-permutes (the
+        stage-to-stage shift) — weight sharding alone would not."""
+        _need_devices(8)
+        cfg = get_config('test-tiny', attention_impl='xla')
+        mesh_cfg = MeshConfig(pp=2, fsdp=4)
+        mesh = build_mesh(mesh_cfg, jax.devices()[:8])
+        state, shardings = create_sharded_state(
+            cfg, mesh, jax.random.PRNGKey(0),
+            TrainConfig(warmup_steps=1, total_steps=4))
+        step = make_train_step(cfg, mesh, shardings, microbatches=4)
+        batch = synthetic_batch(jax.random.PRNGKey(1), 8, 32, 512)
+        with mesh:
+            txt = step.lower(state, batch).compile().as_text()
+        assert 'collective-permute' in txt
+
+    def test_batch_not_divisible_raises(self):
+        _need_devices(8)
+        cfg = get_config('test-tiny', attention_impl='xla')
+        mesh = build_mesh(MeshConfig(pp=2, fsdp=4), jax.devices()[:8])
+        state, shardings = create_sharded_state(
+            cfg, mesh, jax.random.PRNGKey(0),
+            TrainConfig(warmup_steps=1, total_steps=4))
+        step = make_train_step(cfg, mesh, shardings, microbatches=3)
+        batch = synthetic_batch(jax.random.PRNGKey(1), 8, 32, 512)
+        with mesh:
+            with pytest.raises(ValueError, match='not divisible'):
+                step(state, batch)
+
+    def test_odd_layer_count_rejected(self):
+        """The check fires before shardings are even consulted (such a
+        config cannot init-shard its [3, ...] leaves over pp=2 at all)."""
+        _need_devices(8)
+        cfg = get_config('test-tiny', num_layers=3,
+                         attention_impl='xla')
+        mesh = build_mesh(MeshConfig(pp=2, fsdp=4), jax.devices()[:8])
+        with pytest.raises(ValueError, match='not divisible'):
+            make_train_step(cfg, mesh, None, microbatches=4)
