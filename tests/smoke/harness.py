@@ -1,0 +1,101 @@
+"""Smoke-test harness: serial CLI command lists against real infra.
+
+The reference's ground truth that the whole stack works is a NamedTuple
+of shell commands run in order with a teardown that always runs
+(/root/reference/tests/test_smoke.py:109 `Test`, `run_one_test`). Same
+idea here, adapted to this framework:
+
+- Commands run serially; the first failure fails the test (remaining
+  commands are skipped) but the teardown STILL runs — a failed smoke
+  test must not leak a billed TPU slice.
+- Output streams to stderr live (visible under `pytest -s`) and is
+  captured for `grep`-style assertions via shell pipelines in the
+  commands themselves, the reference's validation idiom
+  (test_smoke.py:282 _VALIDATE_LAUNCH_OUTPUT).
+- Each SmokeTest gets ONE isolated SKYTPU_* state dir shared by all its
+  commands (launch and down see the same cluster table), so parallel
+  smoke runs can't corrupt each other's client state. Real cloud
+  credentials flow through gcloud's own config, untouched.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Dict, List, Optional
+
+DEFAULT_CMD_TIMEOUT = 15 * 60
+
+# Resolve the CLI through this interpreter so the smoke run tests the
+# checked-out tree, not whatever `skytpu` is on PATH.
+CLI = f'{sys.executable} -m skypilot_tpu.cli'
+
+
+def cluster_name(prefix: str) -> str:
+    """Unique, prunable resource name (reference: _get_cluster_name)."""
+    return f'smoke-{prefix}-{uuid.uuid4().hex[:6]}'
+
+
+@dataclasses.dataclass
+class SmokeTest:
+    name: str
+    commands: List[str]
+    teardown: Optional[str] = None
+    timeout: int = DEFAULT_CMD_TIMEOUT
+    env: Optional[Dict[str, str]] = None
+
+    def echo(self, message: str) -> None:
+        for line in message.splitlines() or ['']:
+            print(f'[{self.name}] {line}', file=sys.stderr, flush=True)
+
+
+def run_one_test(test: SmokeTest) -> None:
+    state_dir = tempfile.mkdtemp(prefix=f'skytpu-smoke-{test.name}-')
+    env = dict(os.environ)
+    env.update({
+        'SKYTPU_STATE_DB': os.path.join(state_dir, 'state.db'),
+        'SKYTPU_CONFIG': os.path.join(state_dir, 'config.yaml'),
+        'SKYTPU_HOME': os.path.join(state_dir, 'home'),
+    })
+    env.update(test.env or {})
+    failed: Optional[str] = None
+    try:
+        for cmd in test.commands:
+            test.echo(f'$ {cmd}')
+            start = time.time()
+            try:
+                proc = subprocess.run(
+                    cmd, shell=True, env=env, timeout=test.timeout,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True, check=False, executable='/bin/bash')
+                test.echo(proc.stdout)
+                if proc.returncode != 0:
+                    failed = (f'command failed (rc={proc.returncode}, '
+                              f'{time.time() - start:.0f}s): {cmd}')
+                    break
+            except subprocess.TimeoutExpired as e:
+                test.echo(str(e.stdout or ''))
+                failed = f'command timed out ({test.timeout}s): {cmd}'
+                break
+    finally:
+        if test.teardown:
+            test.echo(f'teardown $ {test.teardown}')
+            try:
+                proc = subprocess.run(
+                    test.teardown, shell=True, env=env,
+                    timeout=test.timeout, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True, check=False,
+                    executable='/bin/bash')
+                test.echo(proc.stdout)
+                if proc.returncode != 0:
+                    test.echo(f'WARNING: teardown rc={proc.returncode} — '
+                              f'check for leaked resources!')
+            except subprocess.TimeoutExpired:
+                test.echo('WARNING: teardown timed out — check for '
+                          'leaked resources!')
+    if failed:
+        raise AssertionError(f'[{test.name}] {failed}')
