@@ -439,16 +439,24 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
         mounts = dict(all_file_mounts or {})
         recs = handle.host_records()
         from skypilot_tpu.data import data_utils
+        # Pre-pass: one-way S3→GCS import (reference mechanism:
+        # sky/data/data_transfer.py:39). STS mirrors the bucket
+        # server-side ONCE here on the client; the rewritten gs:// URI
+        # then flows through the normal per-host fetch below.
+        for dst, src in list(mounts.items()):
+            if src.startswith(data_utils.S3_PREFIX):
+                from skypilot_tpu.data import data_transfer
+                mounts[dst] = data_transfer.import_s3_source(src)
         for dst, src in mounts.items():
             if src.startswith(data_utils.UNSUPPORTED_CLOUD_SCHEMES):
                 # GCS-first scope (SURVEY §2.10): fail loudly instead of
-                # handing an s3 URI to gcloud and producing a confusing
-                # on-host error mid-provision.
+                # handing an unknown URI to gcloud and producing a
+                # confusing on-host error mid-provision.
                 raise exceptions.NotSupportedError(
-                    f'File mount source {src!r}: only gs:// (and local '
-                    f'paths) are supported in this build. Mirror the '
-                    f'bucket to GCS, e.g. `gcloud storage cp -r {src} '
-                    f'gs://<bucket>`.')
+                    f'File mount source {src!r}: only gs://, s3:// '
+                    f'(imported to GCS) and local paths are supported '
+                    f'in this build. Mirror the bucket to GCS, e.g. '
+                    f'`gcloud storage cp -r {src} gs://<bucket>`.')
             if src.startswith('gs://'):
                 # Download on each host via gcloud storage/gsutil.
                 def _fetch(rec, dst=dst, src=src):

@@ -1,0 +1,248 @@
+"""One-way S3 → GCS import via GCP Storage Transfer Service.
+
+The migration on-ramp for users coming to TPUs with data in S3
+(reference mechanism: /root/reference/sky/data/data_transfer.py:39-76
+s3_to_gcs — STS job + sink-bucket IAM grant + poll). This build is
+GCS-first (SURVEY §2.10): data LIVES in GCS; S3 is an import *source*,
+never a sink — so exactly one direction exists, and a task can say
+`file_mounts: {~/data: s3://my-bucket/path}` and get the data served
+from a GCS mirror.
+
+TPU-native implementation notes (vs the reference):
+- Direct REST against storagetransfer.googleapis.com/v1 with an
+  injectable transport (the provision/gcp/tpu_api.py idiom) — no
+  discovery client, no boto; AWS credentials come from the environment
+  or ~/.aws/credentials (parsed directly).
+- The transfer runs ONCE per (s3 bucket, gcs mirror) pair per
+  invocation; re-imports reuse the same mirror bucket name
+  (skytpu-import-<s3-bucket>), so repeated launches are incremental
+  (STS only copies changed objects).
+"""
+from __future__ import annotations
+
+import configparser
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+logger = logging.getLogger(__name__)
+
+STS_ROOT = 'https://storagetransfer.googleapis.com/v1'
+STORAGE_ROOT = 'https://storage.googleapis.com/storage/v1'
+
+# transport(method, url, body_or_None) -> (status_code, body_dict)
+Transport = Callable[[str, str, Optional[Dict[str, Any]]],
+                     Tuple[int, Dict[str, Any]]]
+_transport_override: Optional[Transport] = None
+
+_POLL_INTERVAL_S = float(os.environ.get('SKYTPU_STS_POLL_SECONDS', '5'))
+_POLL_TIMEOUT_S = float(os.environ.get('SKYTPU_STS_TIMEOUT', '86400'))
+
+
+def set_transport_override(transport: Optional[Transport]) -> None:
+    """Test hook: route all STS/storage API calls through a fake."""
+    global _transport_override
+    _transport_override = transport
+
+
+def _transport() -> Transport:
+    if _transport_override is not None:
+        return _transport_override
+    from skypilot_tpu.provision.gcp import tpu_api
+    return tpu_api._default_transport  # pylint: disable=protected-access
+
+
+def _call(method: str, url: str,
+          body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    status, payload = _transport()(method, url, body)
+    if status >= 300:
+        msg = payload.get('error', {}).get('message', str(payload))
+        raise exceptions.StorageError(
+            f'{method} {url} failed ({status}): {msg}')
+    return payload
+
+
+def aws_credentials() -> Tuple[str, str]:
+    """Access key pair from the environment or ~/.aws/credentials
+    (default profile) — no boto dependency."""
+    key = os.environ.get('AWS_ACCESS_KEY_ID')
+    secret = os.environ.get('AWS_SECRET_ACCESS_KEY')
+    if key and secret:
+        return key, secret
+    path = os.path.expanduser(
+        os.environ.get('AWS_SHARED_CREDENTIALS_FILE', '~/.aws/credentials'))
+    if os.path.exists(path):
+        parser = configparser.ConfigParser()
+        parser.read(path)
+        profile = os.environ.get('AWS_PROFILE', 'default')
+        if parser.has_section(profile):
+            section = parser[profile]
+            key = section.get('aws_access_key_id')
+            secret = section.get('aws_secret_access_key')
+            if key and secret:
+                return key, secret
+    raise exceptions.StorageError(
+        'S3 import needs AWS credentials: set AWS_ACCESS_KEY_ID / '
+        'AWS_SECRET_ACCESS_KEY or populate ~/.aws/credentials. (They are '
+        'handed to GCP Storage Transfer Service, which does the copy '
+        'server-side — no local data path.)')
+
+
+def _grant_sink_iam(gs_bucket: str, service_account: str) -> None:
+    """Let the STS service account write the sink bucket
+    (reference: _add_bucket_iam_member, data_transfer.py:173)."""
+    url = f'{STORAGE_ROOT}/b/{gs_bucket}/iam'
+    policy = _call('GET', url)
+    member = f'serviceAccount:{service_account}'
+    role = 'roles/storage.admin'
+    bindings = policy.setdefault('bindings', [])
+    for binding in bindings:
+        if binding.get('role') == role:
+            if member in binding.get('members', []):
+                return  # already granted (idempotent re-imports)
+            binding.setdefault('members', []).append(member)
+            break
+    else:
+        bindings.append({'role': role, 'members': [member]})
+    _call('PUT', url, policy)
+    logger.info('granted %s on gs://%s to %s', role, gs_bucket,
+                service_account)
+
+
+def s3_to_gcs(s3_bucket: str, gs_bucket: str, *,
+              project_id: Optional[str] = None,
+              wait: bool = True) -> str:
+    """Create (and by default wait for) a one-time S3→GCS transfer job.
+
+    Server-side copy: STS pulls from S3 into GCS inside Google's
+    network — nothing flows through this machine. Returns the transfer
+    job name. Visible at console.cloud.google.com/transfer/cloud.
+    """
+    if project_id is None:
+        from skypilot_tpu.clouds.gcp import GCP
+        project_id = GCP.get_project_id()
+    access_key, secret_key = aws_credentials()
+
+    sts_account = _call(
+        'GET', f'{STS_ROOT}/googleServiceAccounts/{project_id}')
+    _grant_sink_iam(gs_bucket, sts_account['accountEmail'])
+
+    # Reuse the existing job for this (source, sink) pair if one exists:
+    # re-launches must not accrue duplicate ENABLED jobs (each embedding
+    # the AWS key pair) in the project's transfer console.
+    job_name = _find_existing_job(project_id, s3_bucket, gs_bucket)
+    if job_name is None:
+        job = _call('POST', f'{STS_ROOT}/transferJobs', {
+            'description': f'skytpu import s3://{s3_bucket} -> '
+                           f'gs://{gs_bucket}',
+            'status': 'ENABLED',
+            'projectId': project_id,
+            'transferSpec': {
+                'awsS3DataSource': {
+                    'bucketName': s3_bucket,
+                    'awsAccessKey': {
+                        'accessKeyId': access_key,
+                        'secretAccessKey': secret_key,
+                    },
+                },
+                'gcsDataSink': {'bucketName': gs_bucket},
+            },
+        })
+        job_name = job['name']
+    else:
+        logger.info('reusing existing transfer job %s', job_name)
+    op = _call('POST', f'{STS_ROOT}/{job_name}:run',
+               {'projectId': project_id})
+    logger.info('transfer scheduled: s3://%s -> gs://%s (%s)', s3_bucket,
+                gs_bucket, job_name)
+    if wait:
+        _wait_operation(op['name'])
+    return job_name
+
+
+def _find_existing_job(project_id: str, s3_bucket: str,
+                       gs_bucket: str) -> Optional[str]:
+    """Name of an ENABLED transfer job already wired source→sink."""
+    import urllib.parse
+    filt = urllib.parse.quote(json.dumps(
+        {'projectId': project_id, 'jobStatuses': ['ENABLED']}))
+    listing = _call('GET', f'{STS_ROOT}/transferJobs?filter={filt}')
+    for job in listing.get('transferJobs', []):
+        spec = job.get('transferSpec', {})
+        if (spec.get('awsS3DataSource', {}).get('bucketName') == s3_bucket
+                and spec.get('gcsDataSink', {}).get('bucketName') ==
+                gs_bucket):
+            return job['name']
+    return None
+
+
+def _wait_operation(op_name: str) -> None:
+    deadline = time.time() + _POLL_TIMEOUT_S
+    while time.time() < deadline:
+        op = _call('GET', f'{STS_ROOT}/{op_name}')
+        if op.get('done'):
+            if 'error' in op:
+                raise exceptions.StorageError(
+                    f'S3→GCS transfer failed: '
+                    f'{json.dumps(op["error"])[:500]}')
+            counters = op.get('metadata', {}).get('counters', {})
+            logger.info('transfer done: %s objects, %s bytes',
+                        counters.get('objectsCopiedToSink', '?'),
+                        counters.get('bytesCopiedToSink', '?'))
+            return
+        time.sleep(_POLL_INTERVAL_S)
+    raise exceptions.StorageError(
+        f'S3→GCS transfer {op_name} did not finish within '
+        f'{_POLL_TIMEOUT_S:.0f}s (SKYTPU_STS_TIMEOUT to raise)')
+
+
+def mirror_bucket_name(s3_bucket: str) -> str:
+    """Deterministic GCS mirror name so re-imports are incremental.
+
+    Names that exceed GCS's 63-char limit get a content hash in place of
+    plain truncation — two long S3 names sharing a prefix must NOT map
+    to the same mirror (that would silently mix their data)."""
+    name = f'skytpu-import-{s3_bucket}'.lower()
+    if len(name) <= 63:
+        return name
+    import hashlib
+    digest = hashlib.sha256(s3_bucket.encode()).hexdigest()[:8]
+    return f'{name[:54].rstrip("-._")}-{digest}'
+
+
+# (s3_bucket, mirror) pairs already imported by THIS process: a launch
+# with several mounts from one bucket must run the transfer once, not
+# once per mount (each wait can be hours).
+_imported_pairs: set = set()
+
+
+def import_s3_source(source: str, *,
+                     project_id: Optional[str] = None) -> str:
+    """s3://bucket[/key...] → gs://mirror[/key...], importing the bucket
+    via STS into a deterministic mirror bucket (created if missing).
+
+    The whole BUCKET is mirrored (STS operates on buckets; repeated
+    imports only copy changed objects); the returned URI preserves the
+    key prefix so file_mounts fetch exactly what they named.
+    """
+    from skypilot_tpu.data import data_utils
+    from skypilot_tpu.data import storage as storage_lib
+    assert source.startswith(data_utils.S3_PREFIX), source
+    rest = source[len(data_utils.S3_PREFIX):]
+    s3_bucket, _, key = rest.partition('/')
+    if not s3_bucket:
+        raise exceptions.StorageSpecError(
+            f'Bad S3 URI {source!r}: need s3://bucket[/prefix]')
+    mirror = mirror_bucket_name(s3_bucket)
+    if (s3_bucket, mirror) not in _imported_pairs:
+        # Ensure the sink bucket exists (idempotent; same machinery
+        # named storage uses).
+        storage_lib.GcsStore(mirror, None).initialize()
+        s3_to_gcs(s3_bucket, mirror, project_id=project_id)
+        _imported_pairs.add((s3_bucket, mirror))
+    suffix = f'/{key}' if key else ''
+    return f'{data_utils.GCS_PREFIX}{mirror}{suffix}'

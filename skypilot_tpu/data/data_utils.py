@@ -14,11 +14,16 @@ from skypilot_tpu import exceptions
 
 GCS_PREFIX = 'gs://'
 LOCAL_PREFIX = 'local://'   # fake bucket scheme for hermetic tests
+S3_PREFIX = 's3://'         # import-only: mirrored to GCS via STS
+                            # (data_transfer.import_s3_source)
 
 # Cloud schemes this GCS-first build deliberately does NOT support
 # (SURVEY §2.10). ONE list: task-spec validation and the backend's
 # defense-in-depth check both import it, so they cannot drift.
-UNSUPPORTED_CLOUD_SCHEMES = ('s3://', 'r2://', 'cos://', 'azblob://')
+# s3:// is NOT here: it is supported as an import SOURCE (one-way
+# S3→GCS via Storage Transfer Service; data is then served from the
+# GCS mirror).
+UNSUPPORTED_CLOUD_SCHEMES = ('r2://', 'cos://', 'azblob://')
 
 _BUCKET_NAME_RE = re.compile(r'^[a-z0-9][a-z0-9._-]{1,61}[a-z0-9]$')
 

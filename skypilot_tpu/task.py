@@ -200,9 +200,10 @@ class Task:
             if isinstance(src, str) and src.startswith(
                     data_utils.UNSUPPORTED_CLOUD_SCHEMES):
                 raise ValueError(
-                    f'file_mounts[{dst!r}]: source {src!r} — only gs:// '
-                    f'and local paths are supported in this build. '
-                    f'Mirror the bucket to GCS first, e.g. '
+                    f'file_mounts[{dst!r}]: source {src!r} — only gs://, '
+                    f's3:// (imported to a GCS mirror via Storage '
+                    f'Transfer Service) and local paths are supported in '
+                    f'this build. Mirror the bucket to GCS first, e.g. '
                     f'`gcloud storage cp -r {src} gs://<bucket>`.')
 
     def set_file_mounts(self, file_mounts: Optional[Dict[str, str]]) -> 'Task':

@@ -1,0 +1,250 @@
+"""S3→GCS import via Storage Transfer Service (data/data_transfer.py):
+fake-transport unit tests + the file_mounts integration seam.
+"""
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import data_transfer, data_utils
+
+
+class FakeStsTransport:
+    """Answers the exact REST sequence s3_to_gcs makes; records calls."""
+
+    def __init__(self, fail_op: bool = False):
+        self.calls = []
+        self.fail_op = fail_op
+        self.iam_policy = {'bindings': []}
+        self.existing_jobs = []   # answered to the list-jobs call
+
+    def __call__(self, method, url, body):
+        self.calls.append((method, url, body))
+        if url.endswith('/googleServiceAccounts/proj-1'):
+            return 200, {'accountEmail': 'sts@gcp-sa.iam.gserviceaccount'
+                                         '.com'}
+        if '/transferJobs?filter=' in url and method == 'GET':
+            return 200, {'transferJobs': list(self.existing_jobs)}
+        if url.endswith('/iam') and method == 'GET':
+            return 200, dict(self.iam_policy)
+        if url.endswith('/iam') and method == 'PUT':
+            self.iam_policy = body
+            return 200, body
+        if url.endswith('/transferJobs') and method == 'POST':
+            return 200, {'name': 'transferJobs/123'}
+        if url.endswith(':run'):
+            return 200, {'name': 'transferOperations/op-1'}
+        if 'transferOperations/op-1' in url:
+            if self.fail_op:
+                return 200, {'done': True,
+                             'error': {'code': 7, 'message': 'denied'}}
+            return 200, {'done': True, 'metadata': {'counters': {
+                'objectsCopiedToSink': '10',
+                'bytesCopiedToSink': '1024'}}}
+        return 404, {'error': {'message': f'unexpected {url}'}}
+
+
+@pytest.fixture
+def fake_sts(monkeypatch):
+    transport = FakeStsTransport()
+    data_transfer.set_transport_override(transport)
+    data_transfer._imported_pairs.clear()
+    monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AKIATEST')
+    monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 'secret123')
+    monkeypatch.setenv('SKYTPU_STS_POLL_SECONDS', '0')
+    yield transport
+    data_transfer.set_transport_override(None)
+    data_transfer._imported_pairs.clear()
+
+
+class TestS3ToGcs:
+
+    def test_full_flow(self, fake_sts):
+        job = data_transfer.s3_to_gcs('src-bucket', 'dst-bucket',
+                                      project_id='proj-1')
+        assert job == 'transferJobs/123'
+        # IAM grant happened on the sink bucket for the STS account.
+        put_iam = [c for c in fake_sts.calls
+                   if c[0] == 'PUT' and c[1].endswith('/iam')]
+        assert len(put_iam) == 1
+        assert 'dst-bucket' in put_iam[0][1]
+        members = put_iam[0][2]['bindings'][0]['members']
+        assert 'serviceAccount:sts@gcp-sa.iam.gserviceaccount.com' in \
+            members
+        # The job carried both buckets and the AWS key pair.
+        create = [c for c in fake_sts.calls
+                  if c[0] == 'POST' and c[1].endswith('/transferJobs')][0]
+        spec = create[2]['transferSpec']
+        assert spec['awsS3DataSource']['bucketName'] == 'src-bucket'
+        assert spec['awsS3DataSource']['awsAccessKey']['accessKeyId'] == \
+            'AKIATEST'
+        assert spec['gcsDataSink']['bucketName'] == 'dst-bucket'
+        # It ran and polled to completion.
+        assert any(c[1].endswith(':run') for c in fake_sts.calls)
+
+    def test_iam_grant_idempotent(self, fake_sts):
+        fake_sts.iam_policy = {'bindings': [{
+            'role': 'roles/storage.admin',
+            'members': ['serviceAccount:sts@gcp-sa.iam.gserviceaccount'
+                        '.com'],
+        }]}
+        data_transfer.s3_to_gcs('src', 'dst', project_id='proj-1')
+        assert not any(c[0] == 'PUT' and c[1].endswith('/iam')
+                       for c in fake_sts.calls)
+
+    def test_transfer_failure_raises(self, monkeypatch):
+        transport = FakeStsTransport(fail_op=True)
+        data_transfer.set_transport_override(transport)
+        monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'k')
+        monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 's')
+        monkeypatch.setenv('SKYTPU_STS_POLL_SECONDS', '0')
+        try:
+            with pytest.raises(exceptions.StorageError,
+                               match='transfer failed'):
+                data_transfer.s3_to_gcs('src', 'dst', project_id='proj-1')
+        finally:
+            data_transfer.set_transport_override(None)
+
+    def test_existing_job_reused_not_duplicated(self, fake_sts):
+        fake_sts.existing_jobs = [{
+            'name': 'transferJobs/old-1',
+            'transferSpec': {
+                'awsS3DataSource': {'bucketName': 'src-bucket'},
+                'gcsDataSink': {'bucketName': 'dst-bucket'},
+            },
+        }]
+        job = data_transfer.s3_to_gcs('src-bucket', 'dst-bucket',
+                                      project_id='proj-1')
+        assert job == 'transferJobs/old-1'
+        # No new job was created; the old one was run.
+        assert not any(c[0] == 'POST' and c[1].endswith('/transferJobs')
+                       for c in fake_sts.calls)
+        assert any(c[1].endswith('transferJobs/old-1:run')
+                   for c in fake_sts.calls)
+
+    def test_missing_aws_creds_actionable(self, monkeypatch):
+        monkeypatch.delenv('AWS_ACCESS_KEY_ID', raising=False)
+        monkeypatch.delenv('AWS_SECRET_ACCESS_KEY', raising=False)
+        monkeypatch.setenv('AWS_SHARED_CREDENTIALS_FILE', '/nonexistent')
+        with pytest.raises(exceptions.StorageError,
+                           match='AWS_ACCESS_KEY_ID'):
+            data_transfer.aws_credentials()
+
+    def test_aws_creds_from_ini(self, monkeypatch, tmp_path):
+        monkeypatch.delenv('AWS_ACCESS_KEY_ID', raising=False)
+        monkeypatch.delenv('AWS_SECRET_ACCESS_KEY', raising=False)
+        ini = tmp_path / 'credentials'
+        ini.write_text('[default]\naws_access_key_id = AKIAINI\n'
+                       'aws_secret_access_key = inisecret\n')
+        monkeypatch.setenv('AWS_SHARED_CREDENTIALS_FILE', str(ini))
+        assert data_transfer.aws_credentials() == ('AKIAINI', 'inisecret')
+
+
+class TestImportSeam:
+
+    def test_mirror_name_deterministic(self):
+        assert data_transfer.mirror_bucket_name('My.Data') == \
+            'skytpu-import-my.data'
+
+    def test_long_mirror_names_do_not_collide(self):
+        base = 'corp-ml-datasets-tokenized-llama3-pretrain-shard'
+        a = data_transfer.mirror_bucket_name(base + '-a')
+        b = data_transfer.mirror_bucket_name(base + '-b')
+        assert a != b
+        assert len(a) <= 63 and len(b) <= 63
+
+    def test_repeat_import_same_bucket_runs_transfer_once(
+            self, fake_sts, monkeypatch):
+        monkeypatch.setattr(
+            'skypilot_tpu.data.storage.GcsStore.initialize',
+            lambda self: None)
+        data_transfer.import_s3_source('s3://corp-data/train',
+                                       project_id='proj-1')
+        n_runs = sum(1 for c in fake_sts.calls if c[1].endswith(':run'))
+        data_transfer.import_s3_source('s3://corp-data/val',
+                                       project_id='proj-1')
+        assert sum(1 for c in fake_sts.calls
+                   if c[1].endswith(':run')) == n_runs  # memoized
+
+    def test_import_preserves_key_prefix(self, fake_sts, monkeypatch):
+        created = []
+        monkeypatch.setattr(
+            'skypilot_tpu.data.storage.GcsStore.initialize',
+            lambda self: created.append(self.name))
+        uri = data_transfer.import_s3_source('s3://corp-data/tokens/v2',
+                                             project_id='proj-1')
+        assert uri == 'gs://skytpu-import-corp-data/tokens/v2'
+        assert created == ['skytpu-import-corp-data']
+
+    def test_s3_file_mount_accepted_at_spec_time(self):
+        task = sky.Task(name='t', run='true')
+        task.set_file_mounts({'~/data': 's3://corp-data/tokens'})
+        assert task.file_mounts['~/data'].startswith('s3://')
+
+    def test_other_schemes_still_rejected(self):
+        task = sky.Task(name='t', run='true')
+        with pytest.raises(ValueError, match='r2'):
+            task.set_file_mounts({'~/data': 'r2://bucket/x'})
+
+    def test_s3_not_in_unsupported_list(self):
+        assert 's3://' not in data_utils.UNSUPPORTED_CLOUD_SCHEMES
+        assert data_utils.S3_PREFIX == 's3://'
+
+
+@pytest.mark.slow
+class TestLaunchWithS3Mount:
+
+    def test_fake_cloud_launch_imports_then_fetches(self, monkeypatch):
+        """End-to-end seam: a fake-cloud launch with an s3:// file mount
+        calls import_s3_source once and hands the hosts the gs:// mirror
+        (the gs-fetch path is monkeypatched to a local copy)."""
+        import time
+        from skypilot_tpu import core, execution, global_user_state
+        global_user_state.set_enabled_clouds(['fake'])
+        imported = []
+
+        def fake_import(src, **kwargs):
+            imported.append(src)
+            return 'gs://skytpu-import-corp-data/tokens'
+
+        monkeypatch.setattr(
+            'skypilot_tpu.data.data_transfer.import_s3_source',
+            fake_import)
+        fetched = []
+
+        from skypilot_tpu.backends import cloud_tpu_backend as backend_mod
+        orig = backend_mod.CloudTpuBackend.sync_file_mounts
+
+        def spy_sync(self, handle, all_file_mounts, storage_mounts):
+            # Intercept the per-host gs fetch: record what WOULD be
+            # downloaded (no gcloud in the test env).
+            from skypilot_tpu.data import data_utils as du
+            mounts = dict(all_file_mounts or {})
+            for dst, src in list(mounts.items()):
+                if src.startswith(du.S3_PREFIX):
+                    from skypilot_tpu.data import data_transfer as dt
+                    mounts[dst] = dt.import_s3_source(src)
+            for dst, src in mounts.items():
+                if src.startswith('gs://'):
+                    fetched.append((dst, src))
+                    mounts = {k: v for k, v in mounts.items() if k != dst}
+            return orig(self, handle, mounts, storage_mounts)
+
+        monkeypatch.setattr(backend_mod.CloudTpuBackend,
+                            'sync_file_mounts', spy_sync)
+        task = sky.Task(name='s3m', run='echo ok')
+        task.set_resources(
+            {sky.Resources(cloud='fake', accelerators='tpu-v5e-1')})
+        task.set_file_mounts({'~/data': 's3://corp-data/tokens'})
+        job_id, _ = execution.launch(task, cluster_name='s3c',
+                                     quiet_optimizer=True,
+                                     detach_run=True)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            st = core.job_status('s3c', [job_id])[job_id]
+            if st in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP'):
+                break
+            time.sleep(0.2)
+        assert st == 'SUCCEEDED'
+        assert imported == ['s3://corp-data/tokens']
+        assert fetched == [('~/data',
+                            'gs://skytpu-import-corp-data/tokens')]
