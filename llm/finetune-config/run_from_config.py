@@ -1,0 +1,94 @@
+"""Config-driven fine-tuning: one declarative YAML → a training run.
+
+The reference ships this UX via axolotl (llm/axolotl: a config file
+names the model, data, optimizer and the engine assembles the run).
+TPU-native, the in-tree trainer already exposes everything as flags —
+this shim maps the declarative config onto `skypilot_tpu.train.run`
+argv, so the recipe YAML stays a pure description.
+
+Config schema (all keys optional except model):
+
+    model:
+      name: llama3-8b            # models/configs.py registry
+      init_from_hf: /path/hf     # warm-start checkpoint
+    data:
+      token_dir: /data/tokens    # SKYTOK shards, or...
+      sft_jsonl: /data/sft.jsonl # ...masked-loss SFT pairs
+      seed: 0
+    train:
+      batch: 32
+      seq: 4096
+      steps: 2000
+      learning_rate: 2.0e-5
+    parallelism:
+      tp: 4
+      pp: 2
+      microbatches: 8
+      sp: 1
+    checkpoint:
+      dir: /ckpts/run1
+      every: 200
+    export_hf: /ckpts/hf-out     # optional post-training export
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+import yaml
+
+
+def config_to_argv(cfg: dict) -> list:
+    model = cfg.get('model') or {}
+    if not model.get('name'):
+        raise SystemExit('config needs model.name')
+    data = cfg.get('data') or {}
+    train = cfg.get('train') or {}
+    par = cfg.get('parallelism') or {}
+    ckpt = cfg.get('checkpoint') or {}
+    argv = ['--model', str(model['name'])]
+    if model.get('init_from_hf'):
+        argv += ['--init-from-hf', str(model['init_from_hf'])]
+    if data.get('token_dir'):
+        argv += ['--data-dir', str(data['token_dir'])]
+    if data.get('sft_jsonl'):
+        argv += ['--sft-data', str(data['sft_jsonl'])]
+    if 'seed' in data:
+        argv += ['--data-seed', str(data['seed'])]
+    for key, flag in (('batch', '--batch'), ('seq', '--seq'),
+                      ('steps', '--steps'),
+                      ('learning_rate', '--learning-rate')):
+        if key in train:
+            argv += [flag, str(train[key])]
+    for axis in ('tp', 'pp', 'sp', 'dp', 'ep'):
+        if axis in par:
+            argv += [f'--{axis}', str(par[axis])]
+    if 'microbatches' in par:
+        argv += ['--microbatches', str(par['microbatches'])]
+    if ckpt.get('dir'):
+        argv += ['--checkpoint-dir', str(ckpt['dir'])]
+    if ckpt.get('every'):
+        argv += ['--checkpoint-every', str(ckpt['every'])]
+    if cfg.get('export_hf'):
+        argv += ['--export-hf', str(cfg['export_hf'])]
+    return argv
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('config', help='declarative fine-tune YAML')
+    parser.add_argument('--dry-run', action='store_true',
+                        help='print the assembled train.run argv only')
+    args = parser.parse_args(argv)
+    with open(args.config, encoding='utf-8') as f:
+        cfg = yaml.safe_load(f) or {}
+    run_argv = config_to_argv(cfg)
+    print('train.run', ' '.join(run_argv), flush=True)
+    if args.dry_run:
+        return 0
+    from skypilot_tpu.train import run as train_run
+    return train_run.main(run_argv)
+
+
+if __name__ == '__main__':
+    sys.exit(main())
