@@ -59,6 +59,17 @@ def _repo_root() -> str:
         skypilot_tpu.__file__)))
 
 
+DEFAULT_SSH_USER = 'skytpu'
+
+
+def _ips_from_info(info) -> List:
+    """Cached (internal, external) IPs in rank order — the one shape the
+    handle persists (init, refresh, and the v0 pickle migration all go
+    through here)."""
+    return [(r.host.internal_ip, r.host.external_ip)
+            for r in info.all_hosts()]
+
+
 class CloudTpuResourceHandle(backend_lib.ResourceHandle):
     """Pickled per-cluster handle (reference: CloudVmRayResourceHandle,
     cloud_vm_ray_backend.py:2062; version bumps mirror its scheme :2085)."""
@@ -68,7 +79,7 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
     def __init__(self, cluster_name: str,
                  launched_resources: 'resources_lib.Resources',
                  cluster_info: provision_common.ClusterInfo,
-                 ssh_user: str = 'skytpu',
+                 ssh_user: str = DEFAULT_SSH_USER,
                  ssh_key_path: Optional[str] = None) -> None:
         self._version = self._VERSION
         self.cluster_name = cluster_name
@@ -83,10 +94,8 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
         self.ssh_key_path = ssh_key_path
         # Cached (internal, external) IPs in rank order, so `status` works
         # without a cloud query (reference: stable_internal_external_ips).
-        self.stable_internal_external_ips: Optional[List] = [
-            (r.host.internal_ip, r.host.external_ip)
-            for r in cluster_info.all_hosts()
-        ]
+        self.stable_internal_external_ips: Optional[List] = \
+            _ips_from_info(cluster_info)
 
     # --- identity ---
     def get_cluster_name(self) -> str:
@@ -122,10 +131,7 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
     def update_cluster_info(self,
                             info: provision_common.ClusterInfo) -> None:
         self.cluster_info = info
-        self.stable_internal_external_ips = [
-            (r.host.internal_ip, r.host.external_ip)
-            for r in info.all_hosts()
-        ]
+        self.stable_internal_external_ips = _ips_from_info(info)
 
     # --- host table / runners ---
     def _fake_host_home(self, slice_index: int, host_id: int) -> str:
@@ -217,7 +223,20 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
     # --- pickle versioning ---
     def __setstate__(self, state):
         version = state.get('_version', 0)
-        del version  # migrations go here as _VERSION bumps
+        if version < 1:
+            # v0 handles predate the cached IP table (and may predate
+            # explicit ssh identity fields): backfill so every v1 code
+            # path works on a restored old cluster.
+            state.setdefault('ssh_user', DEFAULT_SSH_USER)
+            if state.get('ssh_key_path') is None:
+                from skypilot_tpu import authentication
+                state['ssh_key_path'] = \
+                    authentication.get_private_key_path()
+            if 'stable_internal_external_ips' not in state:
+                info = state.get('cluster_info')
+                state['stable_internal_external_ips'] = (
+                    _ips_from_info(info) if info is not None else None)
+            state['_version'] = 1
         self.__dict__.update(state)
 
     def __repr__(self) -> str:

@@ -37,6 +37,19 @@ def _create_table(cursor, conn):
             owner TEXT DEFAULT null,
             metadata TEXT DEFAULT '{}',
             cluster_hash TEXT DEFAULT null)""")
+    # Upgrade path for state dbs written by older clients whose
+    # `clusters` predates these columns (reference scheme:
+    # add_column_to_table calls in sky/global_user_state.py's
+    # create_table). CREATE IF NOT EXISTS alone would leave an old db
+    # missing them and every SELECT naming them broken.
+    for column, decl, default in (
+            ('autostop', 'INTEGER DEFAULT -1', -1),
+            ('to_down', 'INTEGER DEFAULT 0', 0),
+            ('owner', 'TEXT DEFAULT null', None),
+            ('metadata', "TEXT DEFAULT '{}'", '{}'),
+            ('cluster_hash', 'TEXT DEFAULT null', None)):
+        db_utils.add_column_if_not_exists(cursor, 'clusters', column,
+                                          decl, default)
     cursor.execute("""\
         CREATE TABLE IF NOT EXISTS cluster_history (
             cluster_hash TEXT,
