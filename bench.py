@@ -70,6 +70,11 @@ def _parse_args(argv=None):
     parser.add_argument('--decode-chunk', type=int, default=8,
                         help='decode steps per dispatch for the serve '
                              'row (amortizes tunnel round-trips)')
+    parser.add_argument('--tune-attn', action='store_true',
+                        help='sweep flash-attention block sizes per '
+                             'sequence length (fwd+bwd wall time) and '
+                             'report the best; use to pick '
+                             'attn_block_q/attn_block_k defaults')
     parser.add_argument('--worker', action='store_true',
                         help='run the measurement directly (no supervisor)')
     args = parser.parse_args(argv)
@@ -297,6 +302,71 @@ def _measure_train(cfg, mesh, n, batch, seq, steps, warmup) -> dict:
             'step_ms': round(step_time * 1e3, 1)}
 
 
+def _tune_attn(args) -> dict:
+    """Per-seq (block_q, block_k) sweep of the flash fwd+bwd pair on
+    bench-like shapes. Prints a table; returns {seq: best_cfg}."""
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.ops.flash_attention import flash_attention
+
+    on_tpu = jax.devices()[0].platform == 'tpu'
+    impl = 'pallas' if on_tpu else 'pallas_interpret'
+    b, h, d = (4, 16, 128) if on_tpu else (1, 2, 64)
+    if on_tpu:
+        # Honor the user's sequence request: --seq + --sweep-seq.
+        seqs = [args.seq] + [int(s) for s in args.sweep_seq.split(',')
+                             if s]
+    else:
+        seqs = [256]
+    blocks = ([128, 256, 512, 1024] if on_tpu else [128, 256])
+    best = {}
+    for seq in seqs:
+        rng = jax.random.PRNGKey(0)
+        dt = jnp.bfloat16 if on_tpu else jnp.float32
+        q = jax.random.normal(rng, (b, seq, h, d), dt)
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, seq, h, d), dt)
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, seq, h, d), dt)
+        g = jax.random.normal(jax.random.PRNGKey(3), (b, seq, h, d), dt)
+        rows = []
+        for bq, bk in itertools.product(blocks, blocks):
+            if seq % bq or seq % bk:
+                continue
+
+            def f(q, k, v, bq=bq, bk=bk):
+                return flash_attention(q, k, v, impl=impl,
+                                       block_q=bq, block_k=bk)
+
+            try:
+                fwd_bwd = jax.jit(lambda q, k, v, g: jax.vjp(
+                    f, q, k, v)[1](g))
+                # Compile + smoke, SYNCED — async bleed into the timed
+                # window would inflate every measurement ~20%.
+                jax.block_until_ready(fwd_bwd(q, k, v, g))
+                t0 = time.time()
+                for _ in range(5):
+                    out = fwd_bwd(q, k, v, g)
+                jax.block_until_ready(out)
+                dt_ms = (time.time() - t0) / 5 * 1e3
+            except Exception as e:  # pylint: disable=broad-except
+                print(f'[tune] seq={seq} bq={bq} bk={bk}: '
+                      f'{type(e).__name__}', file=sys.stderr)
+                continue
+            rows.append((dt_ms, bq, bk))
+            print(f'[tune] seq={seq} bq={bq} bk={bk}: {dt_ms:.2f} ms',
+                  file=sys.stderr)
+        if rows:
+            rows.sort()
+            t, bq, bk = rows[0]
+            best[seq] = {'block_q': bq, 'block_k': bk,
+                         'ms': round(t, 2)}
+            print(f'[tune] BEST seq={seq}: bq={bq} bk={bk} '
+                  f'({t:.2f} ms fwd+bwd)', file=sys.stderr)
+    return best
+
+
 def _worker(args) -> int:
     import jax
 
@@ -326,6 +396,14 @@ def _worker(args) -> int:
                                          args.steps)
         sweep = [int(s) for s in args.sweep_seq.split(',') if s]
     mesh = build_mesh(infer_mesh_config(n))  # fsdp over all local chips
+
+    if args.tune_attn:
+        best = _tune_attn(args)
+        result = {'metric': 'flash-attn block tune',
+                  'value': float(len(best)), 'unit': 'seqs',
+                  'vs_baseline': 1.0, 'best': best}
+        print(json.dumps(result))
+        return 0
 
     if args.serve:
         serve_cfg = get_config(model_name, param_dtype='bfloat16')

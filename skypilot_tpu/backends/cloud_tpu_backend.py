@@ -74,7 +74,7 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
     """Pickled per-cluster handle (reference: CloudVmRayResourceHandle,
     cloud_vm_ray_backend.py:2062; version bumps mirror its scheme :2085)."""
 
-    _VERSION = 1
+    _VERSION = 2
 
     def __init__(self, cluster_name: str,
                  launched_resources: 'resources_lib.Resources',
@@ -96,6 +96,10 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
         # without a cloud query (reference: stable_internal_external_ips).
         self.stable_internal_external_ips: Optional[List] = \
             _ips_from_info(cluster_info)
+        # Provider-specific config (GCP project, k8s namespace, ...) —
+        # filled in after provisioning; v2 made it part of the pickled
+        # layout (v1 handles predate it, see __setstate__).
+        self.provider_extras: Dict[str, Any] = {}
 
     # --- identity ---
     def get_cluster_name(self) -> str:
@@ -122,9 +126,7 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
         return len(self.cluster_info.all_hosts())
 
     def provider_config(self) -> Dict[str, Any]:
-        # provider_extras (GCP project, k8s namespace, ...) was added
-        # after v1 handles; getattr keeps old pickles loadable.
-        return {**getattr(self, 'provider_extras', {}),
+        return {**self.provider_extras,
                 'zone': self.cluster_info.zone,
                 'region': self.cluster_info.region}
 
@@ -224,9 +226,11 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
     def __setstate__(self, state):
         version = state.get('_version', 0)
         if version < 1:
-            # v0 handles predate the cached IP table (and may predate
-            # explicit ssh identity fields): backfill so every v1 code
-            # path works on a restored old cluster.
+            # v0: pre-release pickles from OUTSIDE this repo's history
+            # (no version stamp, no cached IP table, no explicit ssh
+            # identity) — defensive backfill so such a handle restores
+            # into a fully functional one instead of AttributeErroring
+            # deep in a status refresh.
             state.setdefault('ssh_user', DEFAULT_SSH_USER)
             if state.get('ssh_key_path') is None:
                 from skypilot_tpu import authentication
@@ -236,7 +240,12 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
                 info = state.get('cluster_info')
                 state['stable_internal_external_ips'] = (
                     _ips_from_info(info) if info is not None else None)
-            state['_version'] = 1
+        if version < 2:
+            # v1 → v2: provider_extras joined the pickled layout (before
+            # v2 it only existed on handles that had been through
+            # _post_provision_setup in the same process).
+            state.setdefault('provider_extras', {})
+        state['_version'] = self._VERSION
         self.__dict__.update(state)
 
     def __repr__(self) -> str:

@@ -12,7 +12,12 @@ Design (per the pallas TPU playbook):
 - Causal blocks are *skipped*, not masked: the k-loop upper bound is
   derived from the q-block index, so the kernel does ~half the FLOPs of
   dense attention.
-- fp32 accumulation, bf16 inputs (MXU-native).
+- MXU dtype discipline: every dot's OPERANDS stay in the input dtype
+  (bf16 for model runs — the MXU's native mode; emulated fp32 matmul is
+  ~6x slower) with fp32 ACCUMULATION via preferred_element_type; softmax
+  statistics, lse/delta, and all gradient accumulators are fp32. fp32
+  inputs keep fp32 operands (tests stay exact). This matters most at
+  long sequence, where attention's FLOP share dominates the step.
 - Backward is the standard flash-attention backward pair of pallas
   kernels (dq kernel gridded over q-blocks; dk/dv kernel gridded over
   k-blocks), recomputing p from the saved logsumexp instead of an S×S
@@ -70,7 +75,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
                 causal: bool, window: int, block_q: int, block_k: int,
                 seq_len: int, head_dim: int):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
+    # MXU discipline: dot OPERANDS stay in the input dtype (bf16 for
+    # model runs — the MXU's native mode, ~6x the emulated-fp32 matmul
+    # rate) with fp32 ACCUMULATION via preferred_element_type. The
+    # softmax statistics and the output accumulator are fp32 throughout.
+    # This is the single biggest long-sequence MFU lever: attention's
+    # FLOP share grows with S, so fp32-operand dots here were what
+    # dragged step MFU down as sequences lengthened.
+    q = q_ref[0]                                          # (bq, d) raw
+    in_dtype = q.dtype
 
     num_kb = seq_len // block_k
     if causal:
@@ -84,13 +97,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
 
     def body(kb, carry):
         acc, m_prev, l_prev = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)                                  # (bk, d)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]  # (bk, d) raw
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk,
                                 (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * sm_scale                                   # fp32 scale
         if causal or window:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -103,10 +115,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
         m_cur = jnp.max(s, axis=-1)                       # (bq,)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])                   # (bq, bk)
+        p = jnp.exp(s - m_new[:, None])                   # (bq, bk) fp32
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(in_dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
@@ -163,8 +175,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     """dQ for one q-block: stream k-blocks (skipping fully-masked ones),
     rebuild p from lse, accumulate ds @ K."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)                      # (bq, d)
-    do = do_ref[0].astype(jnp.float32)                    # (bq, d)
+    q = q_ref[0]                                          # (bq, d) raw
+    do = do_ref[0]                                        # (bq, d) raw
+    in_dtype = q.dtype
     lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]     # (bq,)
     delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
 
@@ -177,10 +190,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     lo = _window_lo(qi, block_q, block_k, window) if window else 0
 
     def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)                                  # (bk, d)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]  # (bk, d) raw
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
@@ -193,12 +204,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             if window:
                 keep &= rows - cols < window
             s = jnp.where(keep, s, -1e30)
-        p = jnp.exp(s - lse[:, None])                     # (bq, bk)
+        p = jnp.exp(s - lse[:, None])                     # (bq, bk) fp32
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])                    # dlogits
+        ds = p * (dp - delta[:, None])                    # dlogits, fp32
         return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(in_dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(
@@ -214,8 +225,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     skipping q-blocks past the sliding window, rebuild p, accumulate
     pᵀ @ dO and dsᵀ @ Q."""
     kb = pl.program_id(1)
-    k_blk = k_ref[0].astype(jnp.float32)                  # (bk, d)
-    v_blk = v_ref[0].astype(jnp.float32)
+    k_blk = k_ref[0]                                      # (bk, d) raw
+    v_blk = v_ref[0]
+    in_dtype = k_blk.dtype
 
     num_qb = seq_len // block_q
     # First q-block whose LAST row can see this k-block's first key.
@@ -230,10 +242,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(qi, carry):
         dk, dv = carry
-        q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(
-            jnp.float32)                                  # (bq, d)
-        do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(
-            jnp.float32)
+        q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :]  # (bq, d) raw
+        do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]  # (bq,)
         delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
         s = jax.lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
@@ -248,15 +258,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             if window:
                 keep &= rows - cols < window
             s = jnp.where(keep, s, -1e30)
-        p = jnp.exp(s - lse[:, None])                     # (bq, bk)
+        p = jnp.exp(s - lse[:, None])                     # (bq, bk) fp32
+        p_c = p.astype(in_dtype)
         dv = dv + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
+            p_c, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # (bk, d)
         dp = jax.lax.dot_general(do_blk, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
         dk = dk + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
+            ds.astype(in_dtype), q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # (bk, d)
         return dk, dv
 

@@ -84,23 +84,39 @@ class TestHandlePickleUpgrade:
             'c1', resources_lib.Resources(accelerators='tpu-v5e-8'),
             info, ssh_user='skytpu', ssh_key_path='/tmp/key')
 
-    def test_v0_state_unpickles_to_v1(self):
+    def test_v0_state_unpickles_to_current(self):
         handle = self._fresh_handle()
         state = dict(handle.__dict__)
-        # What a v0 client pickled: no version stamp, no IP cache, no
-        # explicit ssh identity.
+        # What a v0 (pre-release) client pickled: no version stamp, no
+        # IP cache, no explicit ssh identity, no provider_extras.
         state.pop('_version')
         state.pop('stable_internal_external_ips')
         state.pop('ssh_user')
+        state.pop('provider_extras')
         state['ssh_key_path'] = None
         restored = type(handle).__new__(type(handle))
         restored.__setstate__(state)
-        assert restored._version == 1
+        assert restored._version == handle._VERSION
         assert restored.ssh_user == 'skytpu'
         assert restored.ssh_key_path  # backfilled from authentication
         assert restored.stable_internal_external_ips == \
             [('10.0.0.5', '34.1.2.3')]
+        assert restored.provider_extras == {}
         assert restored.get_cluster_name() == 'c1'
+
+    def test_v1_state_gains_provider_extras(self):
+        """The REAL in-history migration: v1 handles (every pickle this
+        repo wrote before v2) lacked provider_extras unless provisioning
+        had set it; provider_config() must work either way."""
+        handle = self._fresh_handle()
+        state = dict(handle.__dict__)
+        state['_version'] = 1
+        state.pop('provider_extras')
+        restored = type(handle).__new__(type(handle))
+        restored.__setstate__(state)
+        assert restored._version == handle._VERSION
+        cfg = restored.provider_config()
+        assert cfg['zone'] == 'us-west4-a'
 
     def test_current_pickle_round_trips(self):
         handle = self._fresh_handle()

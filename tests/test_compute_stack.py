@@ -95,6 +95,32 @@ def test_flash_backward_numerical_gradcheck():
     np.testing.assert_allclose(float(analytic), float(fd), rtol=2e-2)
 
 
+def test_flash_bf16_operands_stay_accurate():
+    """bf16 model runs feed the kernels bf16 dot operands (MXU-native
+    rate — the long-sequence MFU lever); the fp32-accumulated result
+    must stay within bf16-grade tolerance of the fp32 reference, fwd
+    AND grads."""
+    b, s, h, d = 1, 256, 2, 64
+    q32 = jax.random.normal(jax.random.PRNGKey(11), (b, s, h, d))
+    k32 = jax.random.normal(jax.random.PRNGKey(12), (b, s, h, d))
+    v32 = jax.random.normal(jax.random.PRNGKey(13), (b, s, h, d))
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q32, k32, v32))
+
+    def run(impl, q, k, v):
+        def f(q, k, v):
+            return flash_attention(q, k, v, impl=impl).astype(
+                jnp.float32).sum()
+        return jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    out_p, grads_p = run('pallas_interpret', q, k, v)
+    out_x, grads_x = run('xla', q32, k32, v32)
+    np.testing.assert_allclose(float(out_p), float(out_x), rtol=3e-2)
+    for name, a, b_ in zip(('dq', 'dk', 'dv'), grads_p, grads_x):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_, np.float32),
+            atol=0.15, rtol=0.15, err_msg=f'{name} drifted')
+
+
 def test_causality():
     """Changing a future token must not change past outputs."""
     rng = jax.random.PRNGKey(0)
