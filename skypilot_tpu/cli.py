@@ -257,9 +257,19 @@ def queue(cluster, skip_finished):
 @click.argument('cluster')
 @click.argument('job_id', type=int, required=False)
 @click.option('--no-follow', is_flag=True, default=False)
-def logs(cluster, job_id, no_follow):
+@click.option('--status', 'status_only', is_flag=True, default=False,
+              help="Print the job's status and exit 0 iff SUCCEEDED "
+                   '(the scripting idiom: `skytpu logs c 1 --status`).')
+def logs(cluster, job_id, no_follow, status_only):
     """Stream a job's combined (rank-prefixed) log."""
     try:
+        if status_only:
+            statuses = sky.job_status(cluster, [job_id] if job_id else None)
+            if not statuses:
+                _fail(f'No jobs on {cluster!r}.')
+            jid, st = sorted(statuses.items())[-1]
+            click.echo(f'Job {jid}: {st}')
+            sys.exit(0 if st == 'SUCCEEDED' else 1)
         sys.exit(sky.tail_logs(cluster, job_id, follow=not no_follow))
     except (exceptions.ClusterNotUpError, exceptions.JobNotFoundError) as e:
         _fail(str(e))

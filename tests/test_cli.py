@@ -109,6 +109,11 @@ class TestCliEndToEnd:
         _invoke(runner, ['logs', 'clitest', '1', '--no-follow'])
         assert 'cli-ran-here' in capfd.readouterr().out
 
+        # --status: the scripting idiom — exit 0 iff SUCCEEDED.
+        result = _invoke(runner, ['logs', 'clitest', '1', '--status'])
+        assert result.exit_code == 0
+        assert 'SUCCEEDED' in result.output
+
         result = _invoke(runner, ['exec', 'clitest', 'echo exec-path'])
         assert 'Job 2' in result.output
 
