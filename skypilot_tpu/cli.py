@@ -265,10 +265,11 @@ def logs(cluster, job_id, no_follow, status_only):
     try:
         if status_only:
             statuses = sky.job_status(cluster, [job_id] if job_id else None)
-            if not statuses:
-                _fail(f'No jobs on {cluster!r}.')
             jid, st = sorted(statuses.items())[-1]
-            click.echo(f'Job {jid}: {st}')
+            if st is None:
+                _fail(f'Job {jid} not found on {cluster!r}.')
+            label = f'Job {jid}' if jid >= 0 else 'Latest job'
+            click.echo(f'{label}: {st}')
             sys.exit(0 if st == 'SUCCEEDED' else 1)
         sys.exit(sky.tail_logs(cluster, job_id, follow=not no_follow))
     except (exceptions.ClusterNotUpError, exceptions.JobNotFoundError) as e:

@@ -54,20 +54,65 @@ from skypilot_tpu.parallel import sharding
 LayerApply = Callable[[Any, jax.Array, jax.Array], jax.Array]
 
 
-def stages_from_stack(layer_params: Any, num_stages: int) -> Any:
-    """[L, ...] stacked layer tree → [S, L/S, ...] staged tree.
+def stages_from_stack(layer_params: Any, num_stages: int,
+                      num_repeats: int = 1) -> Any:
+    """[L, ...] stacked layer tree → [S, (v,) L/(S·v), ...] staged tree.
 
     Pure reshape: GSPMD shards dim 0 in contiguous blocks, so the staged
-    view keeps every layer's weights on the device that runs its stage.
+    view keeps every layer's weights on the device that runs its stage
+    (stage-major also for the circular layout — each stage's v chunks
+    stay in its contiguous block).
     """
     def reshape(leaf):
         n_layers = leaf.shape[0]
-        if n_layers % num_stages:
+        per = num_stages * num_repeats
+        if n_layers % per:
             raise ValueError(
-                f'{n_layers} layers not divisible by {num_stages} stages')
-        return leaf.reshape((num_stages, n_layers // num_stages)
-                            + leaf.shape[1:])
+                f'{n_layers} layers not divisible by {num_stages} stages'
+                + (f' x {num_repeats} repeats' if num_repeats > 1 else ''))
+        if num_repeats == 1:
+            return leaf.reshape((num_stages, n_layers // num_stages)
+                                + leaf.shape[1:])
+        return leaf.reshape(
+            (num_stages, num_repeats, n_layers // per) + leaf.shape[1:])
     return jax.tree.map(reshape, layer_params)
+
+
+def circular_execution_order(num_layers: int, num_stages: int,
+                             num_repeats: int):
+    """Stack indices in the order the circular schedule executes them.
+
+    The circular schedule visits (repeat r, stage s, chunk position j)
+    in r-major order, while the STACK layout is stage-major (stage s
+    owns contiguous layers [s·v·c, (s+1)·v·c), its repeat-r chunk at
+    offset r·c). Execution step i therefore uses stack index
+    s·v·c + r·c + j with (r, s, j) = unravel(i, (v, S, c)).
+    """
+    chunk = num_layers // (num_stages * num_repeats)
+    order = []
+    for r in range(num_repeats):
+        for s in range(num_stages):
+            for j in range(chunk):
+                order.append(s * num_repeats * chunk + r * chunk + j)
+    return order
+
+
+def reorder_stack_for_circular(layer_params: Any, num_stages: int,
+                               num_repeats: int) -> Any:
+    """Rearrange a SEQUENTIAL stacked tree so the circular schedule
+    applies its layers in the original 0..L-1 order — the host-side
+    converter that keeps circular execution bit-compatible with the
+    sequential scan (and pp=1 checkpoints loadable under circular pp).
+    Involution direction: scatter seq layer i to the stack slot the
+    schedule reads at execution step i."""
+    import numpy as np
+    leaves = jax.tree.leaves(layer_params)
+    n_layers = leaves[0].shape[0]
+    order = np.asarray(
+        circular_execution_order(n_layers, num_stages, num_repeats))
+    inv = np.empty_like(order)
+    inv[order] = np.arange(n_layers)   # slot π(i) receives seq layer i
+    return jax.tree.map(lambda leaf: leaf[inv], layer_params)
 
 
 def pipeline_apply(
@@ -78,6 +123,7 @@ def pipeline_apply(
     *,
     num_stages: int,
     num_microbatches: int,
+    num_repeats: int = 1,
     remat: bool = True,
     checkpoint_policy: Optional[Any] = None,
 ) -> jax.Array:
@@ -92,18 +138,36 @@ def pipeline_apply(
       num_stages: pp-axis size. num_layers % num_stages == 0.
       num_microbatches: M. B % M == 0. M >= num_stages keeps the bubble
         fraction at (S-1)/(M+S-1); M=1..S-1 still runs correctly.
+      num_repeats: v > 1 selects the CIRCULAR (interleaved) schedule:
+        each stage holds v non-adjacent layer chunks and every
+        microbatch laps the stage ring v times, cutting the bubble to
+        (S-1)/(v·M+S-1) at the price of v× the stage-boundary traffic.
+        Requires M >= S and num_layers % (S·v) == 0. NOTE: circular
+        executes the stacked layers in `circular_execution_order` — a
+        from-scratch training run is equivalent up to layer relabeling;
+        to run a sequentially-trained checkpoint bit-compatibly, pass
+        the stack through `reorder_stack_for_circular` first.
       remat: checkpoint each tick's stage compute (the pipeline
         equivalent of per-layer remat).
 
     Returns: activations [B, T, D] after all layers, microbatch order
-      restored (bitwise same math as the sequential scan).
+      restored (bitwise same math as the sequential scan for v=1).
     """
-    S, M = num_stages, num_microbatches
+    S, M, v = num_stages, num_microbatches, num_repeats
     batch, seq_len, d_model = x.shape
     if batch % M:
         raise ValueError(f'batch {batch} not divisible by '
                          f'{M} microbatches')
     mb = batch // M
+    if v > 1:
+        if M < S:
+            raise ValueError(
+                f'circular pipeline needs microbatches >= stages '
+                f'(got M={M} < S={S}): a lap must drain before re-entry')
+        return _circular_pipeline(
+            layer_apply, layer_params, x, positions, num_stages=S,
+            num_microbatches=M, num_repeats=v, remat=remat,
+            checkpoint_policy=checkpoint_policy)
     stage_params = stages_from_stack(layer_params, S)
     mb_x = x.reshape(M, mb, seq_len, d_model)
     mb_pos = positions.reshape(M, mb, seq_len)
@@ -160,12 +224,92 @@ def pipeline_apply(
     return out_buf.reshape(batch, seq_len, d_model)
 
 
-def pipeline_num_ticks(num_stages: int, num_microbatches: int) -> int:
-    """Scan length of the schedule: M + S - 1 (fill + steady + drain)."""
-    return num_microbatches + num_stages - 1
+def _circular_pipeline(layer_apply, layer_params, x, positions, *,
+                       num_stages, num_microbatches, num_repeats,
+                       remat, checkpoint_policy):
+    """Circular/interleaved schedule: v laps around the stage ring.
+
+    Between laps a finished microbatch waits in a circular buffer until
+    its re-entry slot comes around (gap M-S+1 ticks — why M >= S). The
+    slot arithmetic is write-before-read by construction:
+      - repeat-r exit of microbatch m lands in circ slot m at tick
+        r·M+m+S-1; its repeat-(r+1) ingest reads the slot at (r+1)·M+m,
+        which is later iff S-1 < M;
+      - the FINAL repeat's exit is the last write to circ slot m, so
+        after the scan the circular buffer IS the output (no separate
+        out_buf; earlier repeats and warm-up garbage are overwritten,
+        and re-entry reads always precede the next write to a slot).
+    Stages run different repeats simultaneously: at tick t, stage s
+    applies its chunk for repeat clip((t-s)//M, 0, v-1).
+    """
+    S, M, v = num_stages, num_microbatches, num_repeats
+    batch, seq_len, d_model = x.shape
+    mb = batch // M
+    stage_params = stages_from_stack(layer_params, S, v)  # [S, v, c, ...]
+    mb_x = x.reshape(M, mb, seq_len, d_model)
+    mb_pos = positions.reshape(M, mb, seq_len)
+
+    def stage_fn(p_stage, x_s, pos_s, r_s):
+        p_r = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, r_s, 0, keepdims=False),
+            p_stage)
+
+        def body(carry, p_layer):
+            return layer_apply(p_layer, carry, pos_s), None
+        out, _ = lax.scan(body, x_s, p_r)
+        return out
+
+    vstages = jax.vmap(stage_fn)
+    if remat:
+        vstages = jax.checkpoint(vstages, prevent_cse=False,
+                                 policy=checkpoint_policy)
+
+    def constrain_state(s):
+        return sharding.constrain(s, 'stage', 'batch', 'seq', 'act_embed')
+
+    state_x = constrain_state(jnp.zeros((S, mb, seq_len, d_model),
+                                        x.dtype))
+    state_pos = jnp.zeros((S, mb, seq_len), positions.dtype)
+    circ_x = jnp.zeros((M, mb, seq_len, d_model), x.dtype)
+    stage_ids = jnp.arange(S)
+
+    def tick(carry, t):
+        state_x, state_pos, circ_x = carry
+        m_in = jnp.mod(t, M)
+        fresh = lax.dynamic_index_in_dim(mb_x, jnp.minimum(t, M - 1), 0,
+                                         keepdims=False)
+        lapped = lax.dynamic_index_in_dim(circ_x, m_in, 0, keepdims=False)
+        state_x = state_x.at[0].set(jnp.where(t < M, fresh, lapped))
+        state_pos = state_pos.at[0].set(
+            lax.dynamic_index_in_dim(mb_pos, m_in, 0, keepdims=False))
+        state_x = constrain_state(state_x)
+        repeats = jnp.clip((t - stage_ids) // M, 0, v - 1)   # [S]
+        y = vstages(stage_params, state_x, state_pos, repeats)
+        y = constrain_state(y)
+        m_exit = jnp.mod(jnp.maximum(t - (S - 1), 0), M)
+        circ_x = lax.dynamic_update_index_in_dim(circ_x, y[S - 1],
+                                                 m_exit, 0)
+        state_x = constrain_state(jnp.roll(y, 1, axis=0))
+        state_pos = jnp.roll(state_pos, 1, axis=0)
+        return (state_x, state_pos, circ_x), None
+
+    (_, _, circ_x), _ = lax.scan(
+        tick, (state_x, state_pos, circ_x),
+        jnp.arange(v * M + S - 1))
+    # The circular buffer's last write per slot is that microbatch's
+    # final-repeat exit — it IS the output.
+    return circ_x.reshape(batch, seq_len, d_model)
 
 
-def bubble_fraction(num_stages: int, num_microbatches: int) -> float:
-    """Idle fraction of the GPipe schedule: (S-1)/(M+S-1)."""
-    return (num_stages - 1) / pipeline_num_ticks(num_stages,
-                                                 num_microbatches)
+def pipeline_num_ticks(num_stages: int, num_microbatches: int,
+                       num_repeats: int = 1) -> int:
+    """Scan length of the schedule: v·M + S - 1 (fill + laps + drain)."""
+    return num_repeats * num_microbatches + num_stages - 1
+
+
+def bubble_fraction(num_stages: int, num_microbatches: int,
+                    num_repeats: int = 1) -> float:
+    """Idle fraction of the schedule: (S-1)/(v·M+S-1) — circular laps
+    (v>1) amortize the same fill/drain over v× the work."""
+    return (num_stages - 1) / pipeline_num_ticks(
+        num_stages, num_microbatches, num_repeats)

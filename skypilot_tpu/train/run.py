@@ -52,6 +52,10 @@ def main(argv=None) -> int:
     parser.add_argument('--microbatches', type=int, default=None,
                         help='microbatches for the pipelined schedule '
                         '(requires --pp > 1; defaults to 4x stages)')
+    parser.add_argument('--pipeline-repeats', type=int, default=1,
+                        help='circular pipeline laps (v>1 cuts the '
+                        'bubble to (S-1)/(vM+S-1); layers must tile '
+                        'pp*v and microbatches >= pp)')
     parser.add_argument('--log-every', type=int, default=10)
     parser.add_argument('--profile-dir', default=None,
                         help='capture an XLA/jax.profiler trace of steps '
@@ -125,6 +129,12 @@ def main(argv=None) -> int:
         raise SystemExit('--microbatches requires a pp>1 mesh '
                          '(pass --pp); with pp=1 the sequential step '
                          'would silently ignore it')
+    if args.pipeline_repeats < 1:
+        raise SystemExit('--pipeline-repeats must be >= 1')
+    if args.pipeline_repeats > 1 and mesh_cfg.pp <= 1:
+        raise SystemExit('--pipeline-repeats requires a pp>1 mesh '
+                         '(pass --pp); with pp=1 the sequential step '
+                         'would silently ignore it')
     if microbatches and args.batch % microbatches:
         raise SystemExit(f'--batch {args.batch} must be divisible by '
                          f'--microbatches {microbatches}')
@@ -144,7 +154,8 @@ def main(argv=None) -> int:
         logger.info('pipeline: pp=%d, defaulting to %d microbatches',
                     mesh_cfg.pp, microbatches)
     step_fn = make_train_step(cfg, mesh, shardings,
-                              microbatches=microbatches)
+                              microbatches=microbatches,
+                              pipeline_repeats=args.pipeline_repeats)
     callbacks.init(total_steps=args.steps)
     dataset = None
     if args.data_dir and args.sft_data:

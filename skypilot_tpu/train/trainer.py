@@ -108,6 +108,7 @@ def make_train_step(
     mesh: Mesh,
     state_shardings: Any,
     microbatches: Optional[int] = None,
+    pipeline_repeats: int = 1,
 ) -> Callable[[TrainState, Dict[str, jax.Array]],
               Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build the jitted train step: loss → grad → clip → adamw update.
@@ -119,6 +120,12 @@ def make_train_step(
     pipelined layer stack (vmap over stages + collective-permute
     shifts) → head, over the SAME param tree as the sequential path —
     checkpoints stay interchangeable across pp settings.
+
+    `pipeline_repeats` v>1 selects the circular/interleaved schedule
+    (bubble (S-1)/(vM+S-1)). NOTE: circular executes the stacked layers
+    in `pipeline.circular_execution_order` — fine from scratch; to
+    continue a sequentially-trained checkpoint, reorder its stack with
+    `pipeline.reorder_stack_for_circular` first.
     """
     model = Transformer(cfg)
     num_stages = mesh.shape.get('pp', 1) if hasattr(mesh, 'shape') else 1
@@ -126,9 +133,11 @@ def make_train_step(
     if pipelined and not cfg.scan_layers:
         raise ValueError('pipeline parallelism requires scan_layers=True '
                          '(stacked layer params)')
-    if pipelined and cfg.num_layers % num_stages:
-        raise ValueError(f'{cfg.num_layers} layers not divisible by '
-                         f'pp={num_stages}')
+    if pipelined and cfg.num_layers % (num_stages * pipeline_repeats):
+        raise ValueError(
+            f'{cfg.num_layers} layers not divisible by pp={num_stages}'
+            + (f' x repeats={pipeline_repeats}'
+               if pipeline_repeats > 1 else ''))
 
     def loss_fn(params, batch):
         if pipelined:
@@ -145,7 +154,7 @@ def make_train_step(
             x = pipeline.pipeline_apply(
                 layer_apply, params['layers']['layer'], x, positions,
                 num_stages=num_stages, num_microbatches=microbatches,
-                remat=cfg.remat,
+                num_repeats=pipeline_repeats, remat=cfg.remat,
                 checkpoint_policy=checkpoint_policy_for(cfg))
             logits = model.apply({'params': params}, x, mode='head')
         else:

@@ -83,6 +83,80 @@ class TestScheduleStructure:
                                    rtol=1e-6)
 
 
+class TestCircularSchedule:
+    """v>1 interleaved laps: bubble (S-1)/(vM+S-1). Affine (NON-
+    commutative) toy layers pin the execution order exactly."""
+
+    def _affine_params(self, L, seed=0):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        return {'a': jax.random.uniform(k1, (L,), minval=0.5, maxval=1.5),
+                'b': jax.random.normal(k2, (L,))}
+
+    @staticmethod
+    def _affine_apply(p, h, _pos):
+        return p['a'] * h + p['b']
+
+    def _sequential(self, params, x, order):
+        a, b = params['a'], params['b']
+        for i in order:
+            x = a[i] * x + b[i]
+        return x
+
+    def test_tick_count_and_bubble_with_repeats(self):
+        assert pipeline.pipeline_num_ticks(4, 8, 2) == 19
+        assert pipeline.bubble_fraction(4, 8, 2) == pytest.approx(3 / 19)
+
+    def test_execution_order_layout(self):
+        # L=8, S=2, v=2, chunk=2: stage-major stack, r-major execution.
+        order = pipeline.circular_execution_order(8, 2, 2)
+        assert order == [0, 1, 4, 5, 2, 3, 6, 7]
+
+    def test_circular_matches_declared_execution_order(self):
+        _need_devices(4)
+        L, S, v, M = 8, 4, 2, 4
+        mesh = build_mesh(MeshConfig(pp=4), jax.devices()[:4])
+        params = self._affine_params(L)
+        x = jnp.broadcast_to(jnp.arange(8.0)[:, None, None], (8, 2, 4))
+        pos = jnp.zeros((8, 2), jnp.int32)
+        with mesh:
+            out = jax.jit(lambda xx: pipeline.pipeline_apply(
+                self._affine_apply, params, xx, pos, num_stages=S,
+                num_microbatches=M, num_repeats=v, remat=False))(x)
+        order = pipeline.circular_execution_order(L, S, v)
+        want = self._sequential(params, x, order)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-5)
+
+    def test_reordered_stack_matches_sequential_model(self):
+        """The checkpoint-compat converter: circular over the reordered
+        stack == plain sequential 0..L-1 over the original stack."""
+        _need_devices(4)
+        L, S, v, M = 8, 4, 2, 4
+        mesh = build_mesh(MeshConfig(pp=4), jax.devices()[:4])
+        params = self._affine_params(L, seed=3)
+        circ_params = pipeline.reorder_stack_for_circular(params, S, v)
+        x = jnp.broadcast_to(jnp.arange(8.0)[:, None, None], (8, 2, 4))
+        pos = jnp.zeros((8, 2), jnp.int32)
+        with mesh:
+            out = jax.jit(lambda xx: pipeline.pipeline_apply(
+                self._affine_apply, circ_params, xx, pos, num_stages=S,
+                num_microbatches=M, num_repeats=v, remat=False))(x)
+        want = self._sequential(params, x, range(L))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-5)
+
+    def test_fewer_microbatches_than_stages_rejected(self):
+        with pytest.raises(ValueError, match='microbatches >= stages'):
+            pipeline.pipeline_apply(
+                self._affine_apply, self._affine_params(8),
+                jnp.zeros((2, 2, 4)), jnp.zeros((2, 2), jnp.int32),
+                num_stages=4, num_microbatches=2, num_repeats=2)
+
+    def test_layers_must_tile_stages_times_repeats(self):
+        with pytest.raises(ValueError, match='not divisible'):
+            pipeline.stages_from_stack({'w': jnp.arange(8)}, 2, 3)
+
+
 class TestPipelinedTrainStep:
 
     def _loss_and_grads(self, mesh_cfg, microbatches, batch, seed=0):
@@ -127,6 +201,25 @@ class TestPipelinedTrainStep:
         with mesh:
             txt = step.lower(state, batch).compile().as_text()
         assert 'collective-permute' in txt
+
+    def test_circular_train_step_runs(self):
+        """pp=2 x v=2 over a 4-layer model: the circular schedule
+        trains (finite loss, grads applied)."""
+        _need_devices(8)
+        cfg = get_config('test-tiny', num_layers=4,
+                         attention_impl='xla')
+        mesh = build_mesh(MeshConfig(pp=2, fsdp=4), jax.devices()[:8])
+        state, shardings = create_sharded_state(
+            cfg, mesh, jax.random.PRNGKey(0),
+            TrainConfig(warmup_steps=1, total_steps=4))
+        step = make_train_step(cfg, mesh, shardings, microbatches=4,
+                               pipeline_repeats=2)
+        batch = synthetic_batch(jax.random.PRNGKey(1), 8, 32, 512)
+        with mesh:
+            new_state, metrics = step(state, batch)
+        loss = float(metrics['loss'])
+        assert np.isfinite(loss) and loss > 0
+        assert float(metrics['grad_norm']) > 0
 
     def test_batch_not_divisible_raises(self):
         _need_devices(8)
