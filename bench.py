@@ -67,6 +67,8 @@ def _parse_args(argv=None):
                         help='skip the serve row in the default sweep')
     parser.add_argument('--quantize', default=None, choices=['int8'],
                         help='serving engine int8 weight-only variant')
+    parser.add_argument('--kv-quant', default=None, choices=['int8'],
+                        help='serving engine int8 KV cache variant')
     parser.add_argument('--decode-chunk', type=int, default=8,
                         help='decode steps per dispatch for the serve '
                              'row (amortizes tunnel round-trips)')
@@ -239,14 +241,15 @@ def _append_partial(row: dict) -> None:
         pass
 
 
-def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1) -> dict:
+def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
+                  kv_quant=None) -> dict:
     """p50/p99 time-to-first-token under concurrent requests on the
     local chip(s) via the continuous-batching engine
     (models/inference.py) — the BASELINE.md serving row."""
     from skypilot_tpu.models import inference as inference_lib
     engine = inference_lib.ContinuousBatchingEngine(
         cfg, num_slots=4, mesh=mesh, quantize=quantize,
-        decode_chunk=decode_chunk)
+        decode_chunk=decode_chunk, kv_quant=kv_quant)
     prompt = list(range(1, 33))
     # Warmup: compile prefill + decode.
     engine.generate(prompt, max_new_tokens=4)
@@ -408,15 +411,21 @@ def _worker(args) -> int:
     if args.serve:
         serve_cfg = get_config(model_name, param_dtype='bfloat16')
         ttft = _measure_ttft(serve_cfg, mesh, quantize=args.quantize,
-                             decode_chunk=args.decode_chunk)
+                             decode_chunk=args.decode_chunk,
+                             kv_quant=args.kv_quant)
         print(f'serve: {ttft}', file=sys.stderr)
+        tags = [t for t in (args.quantize,
+                            f'kv-{args.kv_quant}' if args.kv_quant
+                            else None) if t]
         result = {
             'metric': f'{serve_cfg.name} serve p50 TTFT'
-                      + (f' ({args.quantize})' if args.quantize else ''),
+                      + (f' ({"+".join(tags)})' if tags else ''),
             'value': ttft['p50_ttft_ms'],
             'unit': 'ms',
             'vs_baseline': 1.0,  # tracking metric: no reference number
             'decode_chunk': args.decode_chunk,
+            'quantize': args.quantize or 'none',
+            'kv_quant': args.kv_quant or 'none',
             **ttft,
         }
         print(json.dumps(result))
@@ -456,12 +465,14 @@ def _worker(args) -> int:
             serve_cfg = get_config(model_name, param_dtype='bfloat16')
             ttft = _measure_ttft(serve_cfg, mesh,
                                  quantize=args.quantize,
-                                 decode_chunk=args.decode_chunk)
+                                 decode_chunk=args.decode_chunk,
+                                 kv_quant=args.kv_quant)
             print(f'serve: {ttft}', file=sys.stderr)
             extra = {'serve_p50_ttft_ms': ttft['p50_ttft_ms'],
                      'serve_p99_ttft_ms': ttft['p99_ttft_ms'],
                      'serve_decode_chunk': args.decode_chunk,
-                     'serve_quantize': args.quantize or 'none'}
+                     'serve_quantize': args.quantize or 'none',
+                     'serve_kv_quant': args.kv_quant or 'none'}
             result.update(extra)
             _append_partial({'primary': False, 'extra': extra})
         except Exception as e:  # pylint: disable=broad-except

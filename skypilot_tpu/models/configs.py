@@ -98,6 +98,12 @@ class ModelConfig:
     # 'cache' variable collection) instead of full-sequence attention.
     # Same parameter tree as training — flip with dataclasses.replace.
     decode: bool = False
+    # '' | 'int8': store the decode KV cache as int8 with per-token-
+    # per-kv-head absmax scales. Decode cost is dominated by streaming
+    # the cache from HBM every tick — int8 halves that traffic; the
+    # matmuls read int8 directly (XLA fuses the convert) and the scales
+    # are applied outside the contracted dim (JetStream-style).
+    kv_cache_quant: str = ''
 
     @property
     def head_dim(self) -> int:

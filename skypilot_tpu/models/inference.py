@@ -44,7 +44,8 @@ def _resolve_cfg_and_params(cfg: 'ModelConfig | str',
                             params: Optional[Any],
                             max_seq_len: Optional[int],
                             rng_seed: int,
-                            quantize: Optional[str] = None):
+                            quantize: Optional[str] = None,
+                            kv_quant: Optional[str] = None):
     """Shared engine bring-up: normalize config to decode mode, init
     random weights when no checkpoint is given (bring-up / load-testing;
     real deployments restore via train/checkpoints.py), and optionally
@@ -52,11 +53,15 @@ def _resolve_cfg_and_params(cfg: 'ModelConfig | str',
     if quantize not in (None, 'int8'):
         raise ValueError(f'unknown quantize mode {quantize!r}; '
                          f"supported: 'int8'")
+    if kv_quant not in (None, '', 'int8'):
+        raise ValueError(f'unknown kv_quant mode {kv_quant!r}; '
+                         f"supported: 'int8'")
     if isinstance(cfg, str):
         cfg = get_config(cfg)
     if max_seq_len is not None:
         cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
-    cfg = dataclasses.replace(cfg, decode=True, remat=False)
+    cfg = dataclasses.replace(cfg, decode=True, remat=False,
+                              kv_cache_quant=kv_quant or '')
     if params is None:
         logger.info('Initializing random weights for %s', cfg.name)
         init_cfg = dataclasses.replace(cfg, decode=False,
@@ -90,9 +95,10 @@ class InferenceEngine:
                  max_seq_len: Optional[int] = None,
                  rng_seed: int = 0,
                  quantize: Optional[str] = None,
-                 decode_chunk: int = 1) -> None:
+                 decode_chunk: int = 1,
+                 kv_quant: Optional[str] = None) -> None:
         self.cfg, self.params = _resolve_cfg_and_params(
-            cfg, params, max_seq_len, rng_seed, quantize)
+            cfg, params, max_seq_len, rng_seed, quantize, kv_quant)
         self.batch_size = batch_size
         # >1 ⇒ generate() emits this many tokens per device dispatch
         # (lax.scan inside one jit): fewer host↔device round trips —
@@ -300,11 +306,12 @@ class ContinuousBatchingEngine:
                  rng_seed: int = 0,
                  mesh: Optional[Any] = None,
                  quantize: Optional[str] = None,
-                 decode_chunk: int = 1) -> None:
+                 decode_chunk: int = 1,
+                 kv_quant: Optional[str] = None) -> None:
         import queue as queue_lib
         import threading
         self.cfg, self.params = _resolve_cfg_and_params(
-            cfg, params, max_seq_len, rng_seed, quantize)
+            cfg, params, max_seq_len, rng_seed, quantize, kv_quant)
         self.num_slots = num_slots
         self.mesh = mesh
         # >1 ⇒ when no request is waiting to be admitted, a tick decodes
@@ -372,12 +379,19 @@ class ContinuousBatchingEngine:
 
     def _insert_impl(self, cache, cache1, slot):
         """Copy a batch-1 prefilled cache into slot `slot` of the big
-        cache. Cache leaves are (batch, S, KV, D) or, under scanned
-        layers, (layers, batch, S, KV, D): the batch axis is ndim-4."""
+        cache. Leaf ranks vary (KV payload (B,S,KV,D), int8-KV scales
+        (B,S,KV), each optionally with a leading scanned-layers axis),
+        so the batch axis is found structurally: the one axis where the
+        full cache (num_slots) and the batch-1 cache differ."""
 
         def ins(full, one):
+            axis = next((i for i in range(full.ndim)
+                         if full.shape[i] != one.shape[i]), None)
+            if axis is None:
+                # num_slots == 1: the single slot IS the whole cache.
+                return one
             start = [jnp.zeros((), jnp.int32)] * full.ndim
-            start[full.ndim - 4] = slot
+            start[axis] = slot
             return jax.lax.dynamic_update_slice(full, one, tuple(start))
 
         return jax.tree.map(ins, cache, cache1)

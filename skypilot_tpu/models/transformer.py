@@ -238,37 +238,77 @@ class Attention(nn.Module):
                 f'prompt chunk {cur_len} exceeds max_seq_len '
                 f'{cfg.max_seq_len}')
         kv_heads = k.shape[2]
+        kv_quant = cfg.kv_cache_quant == 'int8'
+        cache_dtype = jnp.int8 if kv_quant else k.dtype
         cache_shape = (batch, cfg.max_seq_len, kv_heads, cfg.head_dim)
         cached_key = self.variable(
             'cache', 'cached_key',
             lambda: nn.with_logical_partitioning(
                 jnp.zeros, ('batch', None, 'kv_heads', None))(
-                    cache_shape, k.dtype))
+                    cache_shape, cache_dtype))
         cached_value = self.variable(
             'cache', 'cached_value',
             lambda: nn.with_logical_partitioning(
                 jnp.zeros, ('batch', None, 'kv_heads', None))(
-                    cache_shape, v.dtype))
+                    cache_shape, cache_dtype))
+        if kv_quant:
+            # Per-token-per-kv-head absmax scales: the 4/head_dim byte
+            # overhead that lets the (B, S, H, D) payload live as int8.
+            scale_shape = (batch, cfg.max_seq_len, kv_heads)
+            key_scale = self.variable(
+                'cache', 'cached_key_scale',
+                lambda: nn.with_logical_partitioning(
+                    jnp.ones, ('batch', None, 'kv_heads'))(
+                        scale_shape, jnp.float32))
+            value_scale = self.variable(
+                'cache', 'cached_value_scale',
+                lambda: nn.with_logical_partitioning(
+                    jnp.ones, ('batch', None, 'kv_heads'))(
+                        scale_shape, jnp.float32))
 
-        key_box = cached_key.value
-        value_box = cached_value.value
-        key_arr = key_box.unbox() if hasattr(key_box, 'unbox') else key_box
-        value_arr = (value_box.unbox()
-                     if hasattr(value_box, 'unbox') else value_box)
+        def unbox(var):
+            box = var.value
+            return (box.unbox() if hasattr(box, 'unbox') else box), box
+
+        def rebox(var, box, arr):
+            if hasattr(box, 'replace_boxed'):
+                var.value = box.replace_boxed(arr)
+            else:
+                var.value = arr
+
+        key_arr, key_box = unbox(cached_key)
+        value_arr, value_box = unbox(cached_value)
+        start_pos = positions[:, 0].astype(jnp.int32)
         # Per-row contiguous write at positions[:, 0] (vmapped DUS lowers
         # to a scatter; rows at different depths write independently).
         write = jax.vmap(
             lambda cache, new, start: jax.lax.dynamic_update_slice(
                 cache, new, (start, 0, 0)))
-        start_pos = positions[:, 0].astype(jnp.int32)
-        key_arr = write(key_arr, k, start_pos)
-        value_arr = write(value_arr, v, start_pos)
-        if hasattr(key_box, 'replace_boxed'):
-            cached_key.value = key_box.replace_boxed(key_arr)
-            cached_value.value = value_box.replace_boxed(value_arr)
+        if kv_quant:
+            def quantize(x):
+                amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+                scale = jnp.maximum(amax, 1e-6) / 127.0   # (B, cur, KVH)
+                q8 = jnp.round(x.astype(jnp.float32)
+                               / scale[..., None]).astype(jnp.int8)
+                return q8, scale
+            k_q, k_s = quantize(k)
+            v_q, v_s = quantize(v)
+            key_arr = write(key_arr, k_q, start_pos)
+            value_arr = write(value_arr, v_q, start_pos)
+            write_s = jax.vmap(
+                lambda cache, new, start: jax.lax.dynamic_update_slice(
+                    cache, new, (start, 0)))
+            ks_arr, ks_box = unbox(key_scale)
+            vs_arr, vs_box = unbox(value_scale)
+            ks_arr = write_s(ks_arr, k_s, start_pos)
+            vs_arr = write_s(vs_arr, v_s, start_pos)
+            rebox(key_scale, ks_box, ks_arr)
+            rebox(value_scale, vs_box, vs_arr)
         else:
-            cached_key.value = key_arr
-            cached_value.value = value_arr
+            key_arr = write(key_arr, k, start_pos)
+            value_arr = write(value_arr, v, start_pos)
+        rebox(cached_key, key_box, key_arr)
+        rebox(cached_value, value_box, value_arr)
 
         # Grouped-query attention directly against the unrepeated KV
         # cache: repeating kv→num_heads over the whole window would 4x
@@ -277,8 +317,15 @@ class Attention(nn.Module):
         n_rep = cfg.num_heads // kv_heads
         q_grouped = q.reshape(batch, cur_len, kv_heads, n_rep,
                               cfg.head_dim)
-        scores = jnp.einsum('bqkrd,bskd->bkrqs', q_grouped, key_arr,
+        # int8 cache: the matmul reads int8 (the astype fuses into the
+        # HBM read); the per-token scale factors out of the contracted
+        # head_dim and is applied to the scores afterwards.
+        key_in = (key_arr.astype(q.dtype) if kv_quant else key_arr)
+        scores = jnp.einsum('bqkrd,bskd->bkrqs', q_grouped, key_in,
                             preferred_element_type=jnp.float32)
+        if kv_quant:
+            scores = scores * ks_arr.transpose(0, 2, 1)[:, :, None,
+                                                        None, :]
         scores = scores * (cfg.head_dim**-0.5)
         if cfg.attn_logit_softcap:
             cap = cfg.attn_logit_softcap
@@ -289,8 +336,19 @@ class Attention(nn.Module):
         if cfg.sliding_window:
             mask &= q_pos - k_pos < cfg.sliding_window
         scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(value_arr.dtype)
-        out = jnp.einsum('bkrqs,bskd->bqkrd', probs, value_arr)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if kv_quant:
+            # V's per-token scale cannot factor out of the summed s dim;
+            # fold it into the probabilities instead (elementwise, tiny
+            # next to the cache-streaming matmul it enables).
+            probs = probs * vs_arr.transpose(0, 2, 1)[:, :, None,
+                                                      None, :]
+            probs = probs.astype(_dtype(cfg))
+            out = jnp.einsum('bkrqs,bskd->bqkrd', probs,
+                             value_arr.astype(_dtype(cfg)))
+        else:
+            probs = probs.astype(value_arr.dtype)
+            out = jnp.einsum('bkrqs,bskd->bqkrd', probs, value_arr)
         return out.reshape(batch, cur_len, cfg.num_heads, cfg.head_dim)
 
 

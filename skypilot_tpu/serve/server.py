@@ -52,7 +52,8 @@ class InferenceServer:
                  hf_model_path: Optional[str] = None,
                  num_slots: int = 4,
                  quantize: Optional[str] = None,
-                 decode_chunk: int = 1) -> None:
+                 decode_chunk: int = 1,
+                 kv_quant: Optional[str] = None) -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
@@ -81,7 +82,8 @@ class InferenceServer:
                                                num_slots=num_slots,
                                                max_seq_len=max_seq_len,
                                                quantize=quantize,
-                                               decode_chunk=decode_chunk)
+                                               decode_chunk=decode_chunk,
+                                               kv_quant=kv_quant)
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
         if tokenizer.startswith('hf:'):
@@ -180,6 +182,10 @@ def main(argv=None) -> int:
     parser.add_argument('--num-slots', type=int, default=4,
                         help='concurrent decode slots (continuous '
                              'batching width)')
+    parser.add_argument('--kv-quant', default=None, choices=['int8'],
+                        help='int8 KV cache (per-token scales): halves '
+                             'the cache HBM streaming that dominates '
+                             'long-context decode')
     parser.add_argument('--quantize', default=None, choices=['int8'],
                         help='weight-only int8 serving: halves the HBM '
                              'weight traffic that bounds decode')
@@ -199,7 +205,8 @@ def main(argv=None) -> int:
                              hf_model_path=args.hf_model_path,
                              num_slots=args.num_slots,
                              quantize=args.quantize,
-                             decode_chunk=args.decode_chunk)
+                             decode_chunk=args.decode_chunk,
+                             kv_quant=args.kv_quant)
     server.warmup()
     web.run_app(server.make_app(), host='0.0.0.0', port=args.port,
                 handle_signals=False)

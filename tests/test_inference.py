@@ -65,6 +65,45 @@ class TestKVCacheCorrectness:
                                        np.asarray(full[:, pos, :]),
                                        atol=1e-4, rtol=1e-4)
 
+    def test_int8_kv_cache_tracks_full_forward(self):
+        """int8 KV cache (per-token absmax scales): decode logits must
+        stay close to the fp32 full forward — the quantization noise
+        bound, not exactness."""
+        engine = InferenceEngine(_cfg(), batch_size=1, kv_quant='int8')
+        assert engine.cfg.kv_cache_quant == 'int8'
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 10), 0,
+                                    engine.cfg.vocab_size, jnp.int32)
+        full_cfg = dataclasses.replace(engine.cfg, decode=False,
+                                       kv_cache_quant='')
+        full = Transformer(full_cfg).apply({'params': engine.params},
+                                           tokens)
+        cache = engine.init_cache()
+        # Cache payload really is int8.
+        kv_leaves = [l for l in jax.tree.leaves(cache)
+                     if l.dtype == jnp.int8]
+        assert kv_leaves, 'no int8 leaves in the quantized cache'
+        prefix = 4
+        logits, cache = engine._prefill(  # pylint: disable=protected-access
+            engine.params, cache, tokens[:, :prefix], prompt_len=prefix)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, prefix - 1, :]),
+                                   atol=0.05, rtol=0.05)
+        for pos in range(prefix, 10):
+            logits, cache = engine._decode_step(  # pylint: disable=protected-access
+                engine.params, cache, tokens[:, pos:pos + 1],
+                jnp.asarray(pos, jnp.int32))
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full[:, pos, :]),
+                                       atol=0.05, rtol=0.05,
+                                       err_msg=f'pos {pos}')
+
+    def test_int8_kv_generation_runs(self):
+        engine = InferenceEngine(_cfg(), batch_size=1, kv_quant='int8')
+        out, _ = engine.generate(jnp.asarray([[5, 7, 11]], jnp.int32),
+                                 max_new_tokens=6)
+        assert out.shape == (1, 6)
+        assert int(out.max()) < engine.cfg.vocab_size
+
     def test_greedy_generation_deterministic_and_consistent(self, engine):
         prompt = jnp.asarray([[5, 7, 11]], jnp.int32)
         out1, stats = engine.generate(prompt, max_new_tokens=8)
@@ -224,6 +263,30 @@ class TestContinuousBatching:
         assert toks == [int(t) for t in ref_out[0]]
         assert stats['new_tokens'] == 8
         assert stats['ttft_s'] > 0
+
+    def test_int8_kv_matches_sequential_int8_kv_all_slots(self):
+        """The --kv-quant serving path: CBE with int8 KV must equal the
+        sequential int8-KV engine token for token, INCLUDING requests
+        landing in slot > 0 (pins the slot-insert axis for the rank-3
+        scale leaves — the bug class where slot 1 decodes with zeroed
+        scales and emits garbage)."""
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        ref = InferenceEngine(_cfg(), batch_size=1, kv_quant='int8')
+        prompt = [5, 7, 11]
+        ref_out, _ = ref.generate(jnp.asarray([prompt], jnp.int32),
+                                  max_new_tokens=8)
+        want = [int(t) for t in ref_out[0]]
+        engine = ContinuousBatchingEngine(_cfg(), num_slots=2,
+                                          kv_quant='int8')
+        try:
+            # Two concurrent identical requests occupy BOTH slots.
+            futures = [engine.submit(prompt, max_new_tokens=8)
+                       for _ in range(2)]
+            results = [f.result(timeout=120) for f in futures]
+        finally:
+            engine.stop()
+        for toks, _ in results:
+            assert toks == want, (toks, want)
 
     def test_concurrent_requests_interleave(self, cb_engine):
         """More requests than slots: all finish, and the step log shows

@@ -73,12 +73,15 @@ from jax.experimental import multihost_utils
 assert jax.process_count() == topo.num_hosts, (
     jax.process_count(), topo.num_hosts)
 ranks = multihost_utils.process_allgather(jnp.asarray([topo.host_rank]))
-# flush=True: jax.distributed's atexit teardown can hard-exit before
-# python's buffered-stdout flush, silently losing the final line.
-print('WORLD', jax.process_count(),
-      'RANKSUM', int(ranks.sum()),
-      'SLICE', os.environ.get('MEGASCALE_SLICE_ID'),
-      'NSLICES', os.environ.get('MEGASCALE_NUM_SLICES'), flush=True)
+# ONE os.write, not print(): under PYTHONUNBUFFERED (this harness sets
+# it) python stdout is raw write-through, so print()'s per-fragment
+# writes can interleave with Gloo's OWN std::cout writes on the same
+# fd mid-line (the r3 'WORLD[Gloo]...' flake — a writer-side tear no
+# log mux can prevent). A single write <= PIPE_BUF is atomic.
+msg = (f'WORLD {jax.process_count()} RANKSUM {int(ranks.sum())} '
+       f'SLICE {os.environ.get("MEGASCALE_SLICE_ID")} '
+       f'NSLICES {os.environ.get("MEGASCALE_NUM_SLICES")}\n')
+os.write(1, msg.encode())
 PYEOF
 '''
 
@@ -143,7 +146,8 @@ total = jax.jit(jnp.sum,
 # environment-dependent, so compute the expectation here.
 want = local.size * sum(range(topo.num_slices))
 assert float(total) == want, (float(total), want)
-print('DPSUM OK', 'DPAXIS', cfg.dp, flush=True)
+# Atomic single write (see the WORLD probe above for why not print()).
+os.write(1, f'DPSUM OK DPAXIS {cfg.dp}\n'.encode())
 PYEOF
 '''
 
