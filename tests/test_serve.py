@@ -253,6 +253,37 @@ class TestServeEndToEnd:
         assert serve_core.status('svc') == []
         assert global_user_state.get_clusters() == []
 
+    def test_multihost_pod_replica_serves(self):
+        """A replica backed by a multi-host pod slice (num_nodes=2, the
+        JetStream-on-pods shape): the gang runs on every host, the head
+        host serves, and the LB proxies to it. The rank-gate (`rank 0
+        serves, others hold the slice`) is the documented pattern for
+        pod serving — on real pods the non-head hosts run the sharded
+        model halves; hermetically they just hold their rank."""
+        from skypilot_tpu.serve import core as serve_core
+        task = sky.Task(
+            name='svc',
+            num_nodes=2,
+            run=('if [ "$SKYTPU_NODE_RANK" = "0" ]; then '
+                 'exec python3 -m http.server $SKYTPU_REPLICA_PORT; '
+                 'else exec sleep 600; fi'))
+        task.set_resources({
+            sky.Resources(cloud='fake', accelerators=_TPU, ports=[8127])
+        })
+        task.set_service(
+            SkyServiceSpec(readiness_path='/', initial_delay_seconds=90,
+                           min_replicas=1, max_replicas=1))
+        serve_core.up(task, 'svcpod')
+        try:
+            endpoint = serve_core.wait_until_ready('svcpod', timeout=120)
+            resp = requests.get(endpoint + '/', timeout=10)
+            assert resp.status_code == 200
+            records = serve_core.status('svcpod')
+            assert records[0]['status'] == ServiceStatus.READY
+        finally:
+            serve_core.down('svcpod', purge=True)
+        assert global_user_state.get_clusters() == []
+
     def test_dead_controller_detection(self):
         """A serve controller killed out-of-band must surface as
         CONTROLLER_FAILED via the watchdog (reference: ServiceUpdateEvent,
