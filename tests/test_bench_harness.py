@@ -58,6 +58,27 @@ class TestPartialSalvage:
         assert result['value'] == 1
 
 
+class TestTuneAttn:
+
+    def test_tune_attn_worker_emits_best_blocks(self, tmp_path):
+        """--tune-attn: the sweep runs (interpret mode on CPU) and the
+        JSON line carries a best-config per sequence length."""
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        proc = subprocess.run(
+            [sys.executable, _BENCH, '--worker', '--tune-attn',
+             '--quick'],
+            capture_output=True, text=True, timeout=420, env=env,
+            check=False)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert result['metric'] == 'flash-attn block tune'
+        assert result['best'], result
+        for cfg in result['best'].values():
+            assert cfg['block_q'] >= 128 and cfg['block_k'] >= 128
+            assert cfg['ms'] > 0
+
+
 class TestWorkerPartialFile:
 
     def test_worker_writes_rows_as_they_land(self, tmp_path):
