@@ -10,6 +10,11 @@ Endpoints:
                               "max_new_tokens": N, "temperature": T}
                               ⇒ {"token_ids": [[...]], "text": [...],
                                  "stats": {...}}
+  POST /v1/completions      → OpenAI-compatible text completions
+  POST /v1/chat/completions → OpenAI-compatible chat (generic template)
+  GET  /v1/models           → the served model id
+(OpenAI scope: non-streaming, n=1, stop strings, usage accounting —
+existing OpenAI-client code points base_url here unchanged.)
 
 Tokenization: accepts raw token ids (any external tokenizer), or text via
 the built-in byte-level tokenizer (ids 0-255 = bytes — honest and
@@ -161,10 +166,128 @@ class InferenceServer:
         self.ready = True
         logger.info('engine warm in %.1fs', time.time() - t0)
 
+    # -- OpenAI-compatible surface --
+    #
+    # The reference's serving recipes expose the OpenAI API via vLLM;
+    # existing OpenAI-client code points its base_url here unchanged.
+    # Scope: non-streaming text + chat completions (`stream: true` is
+    # rejected with 400 — the engine returns whole completions),
+    # temperature, max_tokens, stop strings (post-hoc truncation), and
+    # usage accounting. One choice per request (`n` > 1 → 400).
+
+    def _truncate_at_stop(self, text: str, stop) -> tuple:
+        if not stop:
+            return text, 'length'
+        for s in ([stop] if isinstance(stop, str) else list(stop)):
+            idx = text.find(s)
+            if idx >= 0:
+                return text[:idx], 'stop'
+        return text, 'length'
+
+    @staticmethod
+    def _openai_error(message: str, status: int = 400) -> web.Response:
+        return web.json_response(
+            {'error': {'message': message, 'type': 'invalid_request_error'}},
+            status=status)
+
+    def _validate_openai(self, data: dict):
+        if data.get('stream'):
+            return self._openai_error(
+                'streaming is not supported by this server; set '
+                'stream=false')
+        if int(data.get('n', 1)) != 1:
+            return self._openai_error('only n=1 is supported')
+        return None
+
+    async def handle_v1_completions(self,
+                                    request: web.Request) -> web.Response:
+        data = await request.json()
+        err = self._validate_openai(data)
+        if err is not None:
+            return err
+        prompt = data.get('prompt')
+        if prompt is None:
+            return self._openai_error('prompt is required')
+        prompts = prompt if isinstance(prompt, list) else [prompt]
+        prompt_ids = [self.encode(p) if isinstance(p, str) else
+                      [int(t) for t in p] for p in prompts]
+        max_new = int(data.get('max_tokens', 16))
+        temperature = float(data.get('temperature', 0.0))
+        futures = [self._submit_one(ids, max_new, temperature)
+                   for ids in prompt_ids]
+        gathered = await asyncio.gather(
+            *[asyncio.wrap_future(f) for f in futures])
+        choices = []
+        completion_tokens = 0
+        for i, (out, _st) in enumerate(gathered):
+            text, finish = self._truncate_at_stop(self.decode(out),
+                                                  data.get('stop'))
+            completion_tokens += len(out)
+            choices.append({'index': i, 'text': text, 'logprobs': None,
+                            'finish_reason': finish})
+        prompt_tokens = sum(len(p) for p in prompt_ids)
+        return web.json_response({
+            'id': f'cmpl-{int(time.time() * 1e3):x}',
+            'object': 'text_completion',
+            'created': int(time.time()),
+            'model': data.get('model') or self.engine.cfg.name,
+            'choices': choices,
+            'usage': {'prompt_tokens': prompt_tokens,
+                      'completion_tokens': completion_tokens,
+                      'total_tokens': prompt_tokens + completion_tokens},
+        })
+
+    async def handle_v1_chat(self, request: web.Request) -> web.Response:
+        data = await request.json()
+        err = self._validate_openai(data)
+        if err is not None:
+            return err
+        messages = data.get('messages')
+        if not messages:
+            return self._openai_error('messages is required')
+        # Generic chat template: role-tagged lines + assistant cue. For
+        # model-specific templates, serve with --tokenizer hf:<path> and
+        # apply the template client-side (or send /v1/completions).
+        parts = [f'{m.get("role", "user")}: {m.get("content", "")}'
+                 for m in messages]
+        prompt = '\n'.join(parts) + '\nassistant:'
+        ids = self.encode(prompt)
+        max_new = int(data.get('max_tokens', 16))
+        temperature = float(data.get('temperature', 0.0))
+        out, _st = await asyncio.wrap_future(
+            self._submit_one(ids, max_new, temperature))
+        text, finish = self._truncate_at_stop(self.decode(out),
+                                              data.get('stop'))
+        prompt_tokens, completion_tokens = len(ids), len(out)
+        return web.json_response({
+            'id': f'chatcmpl-{int(time.time() * 1e3):x}',
+            'object': 'chat.completion',
+            'created': int(time.time()),
+            'model': data.get('model') or self.engine.cfg.name,
+            'choices': [{'index': 0,
+                         'message': {'role': 'assistant',
+                                     'content': text},
+                         'finish_reason': finish}],
+            'usage': {'prompt_tokens': prompt_tokens,
+                      'completion_tokens': completion_tokens,
+                      'total_tokens': prompt_tokens + completion_tokens},
+        })
+
+    async def handle_v1_models(self, request: web.Request) -> web.Response:
+        del request
+        return web.json_response({
+            'object': 'list',
+            'data': [{'id': self.engine.cfg.name, 'object': 'model',
+                      'owned_by': 'skypilot_tpu'}],
+        })
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get('/health', self.handle_health)
         app.router.add_post('/generate', self.handle_generate)
+        app.router.add_post('/v1/completions', self.handle_v1_completions)
+        app.router.add_post('/v1/chat/completions', self.handle_v1_chat)
+        app.router.add_get('/v1/models', self.handle_v1_models)
         return app
 
 

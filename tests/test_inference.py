@@ -398,3 +398,48 @@ class TestInferenceServer:
         resp = req.post(f'http://127.0.0.1:{port}/generate', json={},
                         timeout=5)
         assert resp.status_code == 400
+
+        # --- OpenAI-compatible surface ---
+        resp = req.get(f'http://127.0.0.1:{port}/v1/models', timeout=5)
+        assert resp.status_code == 200
+        assert resp.json()['data'][0]['id'] == server.engine.cfg.name
+
+        resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
+                        json={'model': 'x', 'prompt': 'hi',
+                              'max_tokens': 4}, timeout=60)
+        assert resp.status_code == 200
+        body = resp.json()
+        assert body['object'] == 'text_completion'
+        assert body['choices'][0]['finish_reason'] == 'length'
+        assert body['usage']['completion_tokens'] == 4
+        assert body['usage']['total_tokens'] == \
+            body['usage']['prompt_tokens'] + 4
+
+        # Batched prompts, one choice each.
+        resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
+                        json={'prompt': ['a', 'b'], 'max_tokens': 3},
+                        timeout=60)
+        assert [c['index'] for c in resp.json()['choices']] == [0, 1]
+
+        # Chat: role-tagged template, assistant reply.
+        resp = req.post(f'http://127.0.0.1:{port}/v1/chat/completions',
+                        json={'messages': [
+                            {'role': 'system', 'content': 'be brief'},
+                            {'role': 'user', 'content': 'hi'}],
+                            'max_tokens': 4}, timeout=60)
+        assert resp.status_code == 200
+        chat = resp.json()
+        assert chat['object'] == 'chat.completion'
+        assert chat['choices'][0]['message']['role'] == 'assistant'
+
+        # Unsupported shapes are rejected in OpenAI error format.
+        resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
+                        json={'prompt': 'hi', 'stream': True}, timeout=5)
+        assert resp.status_code == 400
+        assert resp.json()['error']['type'] == 'invalid_request_error'
+        resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
+                        json={'prompt': 'hi', 'n': 2}, timeout=5)
+        assert resp.status_code == 400
+        resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
+                        json={}, timeout=5)
+        assert resp.status_code == 400
