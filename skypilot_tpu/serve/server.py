@@ -176,12 +176,15 @@ class InferenceServer:
     # usage accounting. One choice per request (`n` > 1 → 400).
 
     def _truncate_at_stop(self, text: str, stop) -> tuple:
+        """Earliest occurrence of ANY stop sequence wins (OpenAI
+        semantics — list order is irrelevant)."""
         if not stop:
             return text, 'length'
-        for s in ([stop] if isinstance(stop, str) else list(stop)):
-            idx = text.find(s)
-            if idx >= 0:
-                return text[:idx], 'stop'
+        hits = [idx for s in
+                ([stop] if isinstance(stop, str) else list(stop))
+                if (idx := text.find(s)) >= 0]
+        if hits:
+            return text[:min(hits)], 'stop'
         return text, 'length'
 
     @staticmethod
@@ -195,26 +198,52 @@ class InferenceServer:
             return self._openai_error(
                 'streaming is not supported by this server; set '
                 'stream=false')
-        if int(data.get('n', 1)) != 1:
+        if int(data.get('n') or 1) != 1:
             return self._openai_error('only n=1 is supported')
+        max_new = int(data.get('max_tokens') or 16)
+        if not 0 < max_new < self.engine.cfg.max_seq_len:
+            return self._openai_error(
+                f'max_tokens must be in (0, '
+                f'{self.engine.cfg.max_seq_len}) for this model')
         return None
+
+    @staticmethod
+    def _prompts_to_lists(prompt):
+        """OpenAI's four prompt shapes: str, [str, ...], [int, ...]
+        (ONE tokenized prompt), [[int, ...], ...]."""
+        if isinstance(prompt, str):
+            return [prompt]
+        if isinstance(prompt, list):
+            if prompt and all(isinstance(t, int) for t in prompt):
+                return [prompt]
+            return prompt
+        raise ValueError('prompt must be a string, list of strings, or '
+                         'token array(s)')
 
     async def handle_v1_completions(self,
                                     request: web.Request) -> web.Response:
-        data = await request.json()
+        try:
+            data = await request.json()
+        except Exception:  # pylint: disable=broad-except
+            return self._openai_error('body must be JSON')
         err = self._validate_openai(data)
         if err is not None:
             return err
         prompt = data.get('prompt')
         if prompt is None:
             return self._openai_error('prompt is required')
-        prompts = prompt if isinstance(prompt, list) else [prompt]
-        prompt_ids = [self.encode(p) if isinstance(p, str) else
-                      [int(t) for t in p] for p in prompts]
-        max_new = int(data.get('max_tokens', 16))
-        temperature = float(data.get('temperature', 0.0))
-        futures = [self._submit_one(ids, max_new, temperature)
-                   for ids in prompt_ids]
+        try:
+            prompts = self._prompts_to_lists(prompt)
+            prompt_ids = [self.encode(p) if isinstance(p, str) else
+                          [int(t) for t in p] for p in prompts]
+            max_new = int(data.get('max_tokens') or 16)
+            temperature = float(data.get('temperature') or 0.0)
+            futures = [self._submit_one(ids, max_new, temperature)
+                       for ids in prompt_ids]
+        except (TypeError, ValueError) as e:
+            # Bad shapes/values (empty prompt, non-numeric fields, ...)
+            # surface as OpenAI-format 400s, not aiohttp 500s.
+            return self._openai_error(str(e))
         gathered = await asyncio.gather(
             *[asyncio.wrap_future(f) for f in futures])
         choices = []
@@ -238,7 +267,10 @@ class InferenceServer:
         })
 
     async def handle_v1_chat(self, request: web.Request) -> web.Response:
-        data = await request.json()
+        try:
+            data = await request.json()
+        except Exception:  # pylint: disable=broad-except
+            return self._openai_error('body must be JSON')
         err = self._validate_openai(data)
         if err is not None:
             return err
@@ -248,14 +280,17 @@ class InferenceServer:
         # Generic chat template: role-tagged lines + assistant cue. For
         # model-specific templates, serve with --tokenizer hf:<path> and
         # apply the template client-side (or send /v1/completions).
-        parts = [f'{m.get("role", "user")}: {m.get("content", "")}'
-                 for m in messages]
-        prompt = '\n'.join(parts) + '\nassistant:'
-        ids = self.encode(prompt)
-        max_new = int(data.get('max_tokens', 16))
-        temperature = float(data.get('temperature', 0.0))
-        out, _st = await asyncio.wrap_future(
-            self._submit_one(ids, max_new, temperature))
+        try:
+            parts = [f'{m.get("role", "user")}: {m.get("content", "")}'
+                     for m in messages]
+            prompt = '\n'.join(parts) + '\nassistant:'
+            ids = self.encode(prompt)
+            max_new = int(data.get('max_tokens') or 16)
+            temperature = float(data.get('temperature') or 0.0)
+            future = self._submit_one(ids, max_new, temperature)
+        except (TypeError, ValueError, AttributeError) as e:
+            return self._openai_error(str(e))
+        out, _st = await asyncio.wrap_future(future)
         text, finish = self._truncate_at_stop(self.decode(out),
                                               data.get('stop'))
         prompt_tokens, completion_tokens = len(ids), len(out)

@@ -432,6 +432,20 @@ class TestInferenceServer:
         assert chat['object'] == 'chat.completion'
         assert chat['choices'][0]['message']['role'] == 'assistant'
 
+        # OpenAI's tokenized-prompt form: [int, ...] is ONE prompt.
+        resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
+                        json={'prompt': [1, 2, 3], 'max_tokens': 3},
+                        timeout=60)
+        assert resp.status_code == 200
+        assert len(resp.json()['choices']) == 1
+
+        # Stop semantics: earliest occurrence of ANY stop wins,
+        # regardless of list order.
+        srv_trunc = server._truncate_at_stop  # pylint: disable=protected-access
+        assert srv_trunc('hello cruel world',
+                         ['world', 'hello']) == ('', 'stop')
+        assert srv_trunc('abc', ['zz']) == ('abc', 'length')
+
         # Unsupported shapes are rejected in OpenAI error format.
         resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
                         json={'prompt': 'hi', 'stream': True}, timeout=5)
@@ -442,4 +456,13 @@ class TestInferenceServer:
         assert resp.status_code == 400
         resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
                         json={}, timeout=5)
+        assert resp.status_code == 400
+        # Edge inputs surface as OpenAI-format 400s, never bare 500s.
+        resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
+                        json={'prompt': '', 'max_tokens': 4}, timeout=5)
+        assert resp.status_code == 400
+        assert 'error' in resp.json()
+        resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
+                        json={'prompt': 'hi', 'max_tokens': 10 ** 6},
+                        timeout=5)
         assert resp.status_code == 400
