@@ -1,0 +1,55 @@
+"""Query a served model through its OpenAI-compatible surface.
+
+Dependency-light (plain urllib — the `openai` package works the same
+way with base_url=f'http://{endpoint}/v1'):
+
+    skytpu serve up examples/serve/int8_service.yaml -n demo
+    EP=$(skytpu serve status demo | grep endpoint | sed 's/.*endpoint: //')
+    python3 examples/openai_client.py --endpoint $EP \
+        --prompt "hello" --max-tokens 32
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--endpoint', required=True,
+                        help='host:port of the service (LB) endpoint')
+    parser.add_argument('--prompt', default='hello')
+    parser.add_argument('--max-tokens', type=int, default=32)
+    parser.add_argument('--temperature', type=float, default=0.0)
+    parser.add_argument('--chat', action='store_true',
+                        help='use /v1/chat/completions')
+    args = parser.parse_args(argv)
+
+    base = f'http://{args.endpoint}/v1'
+    if args.chat:
+        url = f'{base}/chat/completions'
+        body = {'messages': [{'role': 'user', 'content': args.prompt}],
+                'max_tokens': args.max_tokens,
+                'temperature': args.temperature}
+    else:
+        url = f'{base}/completions'
+        body = {'prompt': args.prompt, 'max_tokens': args.max_tokens,
+                'temperature': args.temperature}
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method='POST',
+        headers={'Content-Type': 'application/json'})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        out = json.loads(resp.read().decode())
+    choice = out['choices'][0]
+    text = (choice['message']['content'] if args.chat
+            else choice['text'])
+    print(text)
+    print(f"[{out['usage']['completion_tokens']} tokens, "
+          f"finish={choice['finish_reason']}]", file=sys.stderr)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
