@@ -277,14 +277,21 @@ class InferenceServer:
         messages = data.get('messages')
         if not messages:
             return self._openai_error('messages is required')
-        # Generic chat template: role-tagged lines + assistant cue. For
-        # model-specific templates, serve with --tokenizer hf:<path> and
-        # apply the template client-side (or send /v1/completions).
+        # Model-fidelity first: when serving with --tokenizer hf:<path>
+        # and the tokenizer ships a chat template, use it. Otherwise a
+        # generic role-tagged template.
         try:
-            parts = [f'{m.get("role", "user")}: {m.get("content", "")}'
-                     for m in messages]
-            prompt = '\n'.join(parts) + '\nassistant:'
-            ids = self.encode(prompt)
+            ids = None
+            if (self._hf_tokenizer is not None and
+                    getattr(self._hf_tokenizer, 'chat_template', None)):
+                ids = self._hf_tokenizer.apply_chat_template(
+                    messages, add_generation_prompt=True)
+            if ids is None:
+                parts = [
+                    f'{m.get("role", "user")}: {m.get("content", "")}'
+                    for m in messages
+                ]
+                ids = self.encode('\n'.join(parts) + '\nassistant:')
             max_new = int(data.get('max_tokens') or 16)
             temperature = float(data.get('temperature') or 0.0)
             future = self._submit_one(ids, max_new, temperature)
