@@ -4,7 +4,7 @@ Dependency-light (plain urllib — the `openai` package works the same
 way with base_url=f'http://{endpoint}/v1'):
 
     skytpu serve up examples/serve/int8_service.yaml -n demo
-    EP=$(skytpu serve status demo | grep endpoint | sed 's/.*endpoint: //')
+    EP=$(skytpu serve status demo --endpoint)
     python3 examples/openai_client.py --endpoint $EP \
         --prompt "hello" --max-tokens 32
 """

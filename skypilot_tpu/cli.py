@@ -624,8 +624,17 @@ def serve_up(entrypoint, service_name, yes):
 
 @serve.command('status')
 @click.argument('service_name', required=False)
-def serve_status(service_name):
+@click.option('--endpoint', 'endpoint_only', is_flag=True, default=False,
+              help='Print only the endpoint (scripting: '
+                   '`curl http://$(skytpu serve status NAME '
+                   '--endpoint)/...`).')
+def serve_status(service_name, endpoint_only):
     records = sky.serve.status(service_name)
+    if endpoint_only:
+        if not records or not records[0]['endpoint']:
+            _fail(f'No endpoint for {service_name or "<any>"!r}.')
+        click.echo(records[0]['endpoint'])
+        return
     if not records:
         click.echo('No services.')
         return

@@ -218,15 +218,13 @@ def test_serve_up_curl_down():
         [
             f'{CLI} serve up -y -n {name} {yaml}',
             _poll(f'{CLI} serve status {name}', 'READY'),
-            f'ep=$({CLI} serve status {name} | grep endpoint | '
-            f"sed 's/.*endpoint: //' | awk '{{print $1}}'); "
+            f'ep=$({CLI} serve status {name} --endpoint); '
             f'curl -sf --max-time 30 "http://$ep/" | head -c 200',
             # The OpenAI-compatible surface answers through the LB too
             # (404 on this plain-http demo service is fine; a model
             # service returns the model list — just require the LB to
             # proxy the route).
-            f'ep=$({CLI} serve status {name} | grep endpoint | '
-            f"sed 's/.*endpoint: //' | awk '{{print $1}}'); "
+            f'ep=$({CLI} serve status {name} --endpoint); '
             f'curl -s --max-time 30 -o /dev/null -w "%{{http_code}}" '
             f'"http://$ep/v1/models" | grep -E "200|404"',
         ],
