@@ -147,3 +147,42 @@ class TestCliEndToEnd:
         result = _invoke(runner, ['jobs', 'queue'])
         assert 'mjob' in result.output
         assert 'SUCCEEDED' in result.output
+
+    def test_serve_cli(self, runner, tmp_path):
+        """The serve CLI surface end-to-end on the fake cloud:
+        up → status (incl. --endpoint) → curl → down."""
+        import requests
+        yaml_path = tmp_path / 'svc.yaml'
+        yaml_path.write_text(
+            'name: clisvc\n'
+            'resources:\n'
+            '  cloud: fake\n'
+            '  accelerators: tpu-v5e-1\n'
+            '  ports: [8131]\n'
+            'service:\n'
+            '  readiness_probe: /\n'
+            '  replicas: 1\n'
+            'run: |\n'
+            '  exec python3 -m http.server $SKYTPU_REPLICA_PORT\n')
+        result = _invoke(runner, ['serve', 'up', '-y', '-n', 'clisvc',
+                                  str(yaml_path)])
+        assert result.exit_code == 0, result.output
+        assert 'starting' in result.output
+        try:
+            from skypilot_tpu.serve import core as serve_core
+            endpoint = serve_core.wait_until_ready('clisvc', timeout=90)
+            result = _invoke(runner, ['serve', 'status', 'clisvc'])
+            assert 'READY' in result.output
+            result = _invoke(runner, ['serve', 'status', 'clisvc',
+                                      '--endpoint'])
+            assert result.exit_code == 0
+            assert result.output.strip() == endpoint
+            resp = requests.get(f'http://{endpoint}/'
+                                if '://' not in endpoint else endpoint,
+                                timeout=10)
+            assert resp.status_code == 200
+        finally:
+            result = _invoke(runner, ['serve', 'down', '-y', 'clisvc',
+                                      '--purge'])
+        result = _invoke(runner, ['serve', 'status'])
+        assert 'No services' in result.output
