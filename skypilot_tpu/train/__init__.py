@@ -1,10 +1,11 @@
 from skypilot_tpu.train.trainer import (TrainConfig, TrainState,
                                         create_sharded_state,
                                         cross_entropy_loss, make_optimizer,
-                                        make_train_step, synthetic_batch)
+                                        make_eval_step, make_train_step,
+                                        synthetic_batch)
 
 __all__ = [
     'TrainConfig', 'TrainState', 'create_sharded_state',
-    'cross_entropy_loss', 'make_optimizer', 'make_train_step',
-    'synthetic_batch',
+    'cross_entropy_loss', 'make_eval_step', 'make_optimizer',
+    'make_train_step', 'synthetic_batch',
 ]

@@ -33,6 +33,13 @@ def main(argv=None) -> int:
                         '"completion"} examples; loss is masked to '
                         'completion tokens (SFT)')
     parser.add_argument('--data-seed', type=int, default=0)
+    parser.add_argument('--val-dir', default=None,
+                        help='SKYTOK shards for validation loss (e.g. '
+                        'the tokenize_tool --val-fraction output dir)')
+    parser.add_argument('--eval-every', type=int, default=200,
+                        help='steps between validation passes')
+    parser.add_argument('--eval-batches', type=int, default=16,
+                        help='batches per validation pass')
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=100)
     parser.add_argument('--init-from-hf', default=None,
@@ -189,6 +196,26 @@ def main(argv=None) -> int:
             for i in range(8)
         ]
         batch_for = lambda step: batches[step % len(batches)]  # noqa: E731
+    # Validation: forward-only loss on held-out shards.
+    eval_fn = None
+    val_dataset = None
+    if args.val_dir:
+        from skypilot_tpu.train import make_eval_step
+        from skypilot_tpu.train.data import TokenDataset
+        eval_fn = make_eval_step(cfg, mesh, shardings)
+        val_dataset = TokenDataset(args.val_dir, args.batch, args.seq,
+                                   host_rank=topology.host_rank,
+                                   num_hosts=topology.num_hosts,
+                                   seed=args.data_seed + 1)
+
+    def run_eval(state, step):
+        total = 0.0
+        for _ in range(args.eval_batches):
+            total += float(eval_fn(state, val_dataset.next_batch()))
+        val_loss = total / max(args.eval_batches, 1)
+        logger.info('step %d val_loss=%.4f', step, val_loss)
+        return val_loss
+
     loss = float('nan')
     # Profile a small steady-state slice: step 2 (past compile+warmup)
     # through step 4 — falling back to the first steps when the run is
@@ -222,11 +249,17 @@ def main(argv=None) -> int:
                 logger.info('step %d/%d loss=%.4f grad_norm=%.3f', step,
                             args.steps, loss,
                             float(metrics['grad_norm']))
+            if eval_fn is not None and (
+                    (step + 1) % args.eval_every == 0 or
+                    step == args.steps - 1):
+                run_eval(state, step + 1)
     if profiling:  # --steps ended inside the profile window
         jax.profiler.stop_trace()
         logger.info('profile trace written to %s', args.profile_dir)
     if dataset is not None:
         dataset.close()
+    if val_dataset is not None:
+        val_dataset.close()
     if manager is not None:
         if manager.latest_step() != args.steps:
             manager.save(args.steps, state, force=True)

@@ -189,6 +189,35 @@ def make_train_step(
     )
 
 
+def make_eval_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    state_shardings: Any,
+) -> Callable[[TrainState, Dict[str, jax.Array]], jax.Array]:
+    """Jitted forward-only loss (no grads, no state mutation) for the
+    validation loop. Always the sequential path: eval batches are small
+    and pipelining buys nothing without a backward."""
+    model = Transformer(cfg)
+
+    def step(state: TrainState, batch):
+        batch = {
+            k: sharding_lib.constrain(v, 'batch', 'seq')
+            for k, v in batch.items()
+        }
+        logits = model.apply({'params': state.params}, batch['inputs'])
+        return cross_entropy_loss(logits, batch['targets'],
+                                  batch.get('mask'))
+
+    unboxed_shardings = nn.unbox(state_shardings)
+    replicated = NamedSharding(mesh, PartitionSpec())
+    return jax.jit(
+        step,
+        in_shardings=(unboxed_shardings,
+                      {k: v for k, v in batch_sharding(mesh).items()}),
+        out_shardings=replicated,
+    )
+
+
 def synthetic_batch(rng: jax.Array, batch_size: int, seq_len: int,
                     vocab_size: int) -> Dict[str, jax.Array]:
     """Deterministic synthetic LM batch (bench + hermetic tests)."""

@@ -71,6 +71,28 @@ class TestTokenizeTool:
         frac = len(val_shards) / (len(val_shards) + len(train_shards))
         assert 0.1 <= frac <= 0.4, (len(val_shards), len(train_shards))
 
+    def test_tokenize_then_train_with_validation(self, tmp_path):
+        """The full data loop: tokenize with a val split, train on the
+        shards, and the validation pass reports a loss."""
+        paths = _corpus(tmp_path, n_files=4, chars=20000)
+        out = tmp_path / 'shards'
+        tokenize_tool.main(['--input'] + paths +
+                           ['--out', str(out), '--shard-tokens', '8192',
+                            '--val-fraction', '0.34'])
+        assert os.path.isdir(out / 'val') and os.listdir(out / 'val')
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        proc = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.train.run',
+             '--model', 'test-tiny', '--batch', '8', '--seq', '32',
+             '--steps', '2', '--log-every', '1',
+             '--data-dir', str(out), '--val-dir', str(out / 'val'),
+             '--eval-every', '2', '--eval-batches', '2'],
+            capture_output=True, text=True, timeout=420, env=env,
+            check=False)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert 'val_loss=' in proc.stderr
+
     def test_cli_module_invocation(self, tmp_path):
         p = tmp_path / 'd.txt'
         p.write_text('hello world ' * 100)
