@@ -213,7 +213,9 @@ def main(argv=None) -> int:
         for _ in range(args.eval_batches):
             total += float(eval_fn(state, val_dataset.next_batch()))
         val_loss = total / max(args.eval_batches, 1)
-        logger.info('step %d val_loss=%.4f', step, val_loss)
+        import math
+        logger.info('step %d val_loss=%.4f val_ppl=%.2f', step, val_loss,
+                    math.exp(min(val_loss, 30.0)))
         return val_loss
 
     loss = float('nan')
