@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import math
 import sys
 
 logger = logging.getLogger(__name__)
@@ -196,24 +197,32 @@ def main(argv=None) -> int:
             for i in range(8)
         ]
         batch_for = lambda step: batches[step % len(batches)]  # noqa: E731
-    # Validation: forward-only loss on held-out shards.
+    # Validation: forward-only loss on a FIXED set of held-out batches
+    # (materialized once — successive evals must score the same data or
+    # the val curve jitters from sampling, not model change).
     eval_fn = None
-    val_dataset = None
+    eval_batches = []
     if args.val_dir:
         from skypilot_tpu.train import make_eval_step
         from skypilot_tpu.train.data import TokenDataset
-        eval_fn = make_eval_step(cfg, mesh, shardings)
+        eval_fn = make_eval_step(cfg, mesh, shardings,
+                                 pipeline_repeats=args.pipeline_repeats)
         val_dataset = TokenDataset(args.val_dir, args.batch, args.seq,
                                    host_rank=topology.host_rank,
                                    num_hosts=topology.num_hosts,
                                    seed=args.data_seed + 1)
+        eval_batches = [val_dataset.next_batch()
+                        for _ in range(args.eval_batches)]
+        val_dataset.close()
 
     def run_eval(state, step):
-        total = 0.0
-        for _ in range(args.eval_batches):
-            total += float(eval_fn(state, val_dataset.next_batch()))
-        val_loss = total / max(args.eval_batches, 1)
-        import math
+        # Device-side accumulation: one host sync for the whole pass,
+        # not one per batch.
+        total = None
+        for batch in eval_batches:
+            loss_i = eval_fn(state, batch)
+            total = loss_i if total is None else total + loss_i
+        val_loss = float(total) / max(len(eval_batches), 1)
         logger.info('step %d val_loss=%.4f val_ppl=%.2f', step, val_loss,
                     math.exp(min(val_loss, 30.0)))
         return val_loss
@@ -260,8 +269,6 @@ def main(argv=None) -> int:
         logger.info('profile trace written to %s', args.profile_dir)
     if dataset is not None:
         dataset.close()
-    if val_dataset is not None:
-        val_dataset.close()
     if manager is not None:
         if manager.latest_step() != args.steps:
             manager.save(args.steps, state, force=True)

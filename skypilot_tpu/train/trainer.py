@@ -180,8 +180,7 @@ def make_train_step(
     replicated = NamedSharding(mesh, PartitionSpec())
     return jax.jit(
         step,
-        in_shardings=(unboxed_shardings,
-                      {k: v for k, v in batch_sharding(mesh).items()}),
+        in_shardings=(unboxed_shardings, batch_sharding(mesh)),
         out_shardings=(unboxed_shardings,
                        {'loss': replicated, 'grad_norm': replicated,
                         'step': replicated}),
@@ -193,18 +192,34 @@ def make_eval_step(
     cfg: ModelConfig,
     mesh: Mesh,
     state_shardings: Any,
+    pipeline_repeats: int = 1,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], jax.Array]:
     """Jitted forward-only loss (no grads, no state mutation) for the
-    validation loop. Always the sequential path: eval batches are small
-    and pipelining buys nothing without a backward."""
+    validation loop. Always the sequential execution path — eval
+    batches are small and pipelining buys nothing without a backward —
+    but a CIRCULAR-trained stack (pipeline_repeats > 1) is stored in
+    stage-major permuted order, so its layers are gathered back into
+    execution order first (a weights gather per eval pass; the trained
+    function, not a layer-scrambled one)."""
     model = Transformer(cfg)
+    num_stages = mesh.shape.get('pp', 1)
+    order = None
+    if pipeline_repeats > 1 and num_stages > 1:
+        from skypilot_tpu.parallel import pipeline
+        order = jnp.asarray(pipeline.circular_execution_order(
+            cfg.num_layers, num_stages, pipeline_repeats))
 
     def step(state: TrainState, batch):
         batch = {
             k: sharding_lib.constrain(v, 'batch', 'seq')
             for k, v in batch.items()
         }
-        logits = model.apply({'params': state.params}, batch['inputs'])
+        params = state.params
+        if order is not None:
+            layers = jax.tree.map(lambda a: a[order],
+                                  params['layers']['layer'])
+            params = {**params, 'layers': {'layer': layers}}
+        logits = model.apply({'params': params}, batch['inputs'])
         return cross_entropy_loss(logits, batch['targets'],
                                   batch.get('mask'))
 
@@ -212,8 +227,7 @@ def make_eval_step(
     replicated = NamedSharding(mesh, PartitionSpec())
     return jax.jit(
         step,
-        in_shardings=(unboxed_shardings,
-                      {k: v for k, v in batch_sharding(mesh).items()}),
+        in_shardings=(unboxed_shardings, batch_sharding(mesh)),
         out_shardings=replicated,
     )
 
