@@ -256,6 +256,31 @@ class TestPipelinedTrainStep:
         assert np.isfinite(loss) and loss > 0
         assert float(metrics['grad_norm']) > 0
 
+    def test_eval_step_matches_circular_train_loss(self):
+        """make_eval_step(pipeline_repeats=v) must compute the SAME
+        function the circular schedule trains: its sequential forward
+        over the reordered stack equals the pipelined forward's loss on
+        identical params + batch (pins the eval-side stack gather)."""
+        from skypilot_tpu.train import make_eval_step
+        _need_devices(8)
+        cfg = get_config('test-tiny', num_layers=4,
+                         attention_impl='xla')
+        mesh = build_mesh(MeshConfig(pp=2, fsdp=4), jax.devices()[:8])
+        state, shardings = create_sharded_state(
+            cfg, mesh, jax.random.PRNGKey(0),
+            TrainConfig(warmup_steps=1, total_steps=4,
+                        learning_rate=0.0))  # lr 0: params unchanged
+        step = make_train_step(cfg, mesh, shardings, microbatches=4,
+                               pipeline_repeats=2)
+        eval_fn = make_eval_step(cfg, mesh, shardings,
+                                 pipeline_repeats=2)
+        batch = synthetic_batch(jax.random.PRNGKey(3), 8, 32, 512)
+        with mesh:
+            # Eval first: the train step donates the state.
+            val = float(eval_fn(state, batch))
+            _, metrics = step(state, dict(batch))
+        assert val == pytest.approx(float(metrics['loss']), rel=2e-4)
+
     def test_batch_not_divisible_raises(self):
         _need_devices(8)
         cfg = get_config('test-tiny', attention_impl='xla')
