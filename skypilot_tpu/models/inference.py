@@ -34,9 +34,42 @@ def greedy_sample(logits: jax.Array, rng: jax.Array,
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
+def filter_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Keep only the k highest logits per row (k is jit-STATIC: an
+    engine-level knob, so the step compiles once)."""
+    kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def filter_top_p(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filter: keep the smallest set of tokens whose cumulative
+    probability reaches p (top-1 always kept). p is jit-static."""
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # A token stays if the mass BEFORE it is < p (keeps top-1 even when
+    # its own probability already exceeds p).
+    keep = cum - probs < p
+    thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
+def apply_logit_filters(scaled: jax.Array, top_k: int,
+                        top_p: float) -> jax.Array:
+    """HF convention: filters apply AFTER temperature scaling."""
+    if top_k and top_k > 0:
+        scaled = filter_top_k(scaled, top_k)
+    if top_p and 0.0 < top_p < 1.0:
+        scaled = filter_top_p(scaled, top_p)
+    return scaled
+
+
 def temperature_sample(logits: jax.Array, rng: jax.Array,
-                       temperature: float) -> jax.Array:
+                       temperature: float, top_k: int = 0,
+                       top_p: float = 0.0) -> jax.Array:
     scaled = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    scaled = apply_logit_filters(scaled, top_k, top_p)
     return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
 
 
@@ -96,10 +129,16 @@ class InferenceEngine:
                  rng_seed: int = 0,
                  quantize: Optional[str] = None,
                  decode_chunk: int = 1,
-                 kv_quant: Optional[str] = None) -> None:
+                 kv_quant: Optional[str] = None,
+                 top_k: int = 0,
+                 top_p: float = 0.0) -> None:
         self.cfg, self.params = _resolve_cfg_and_params(
             cfg, params, max_seq_len, rng_seed, quantize, kv_quant)
         self.batch_size = batch_size
+        # Engine-level sampling filters (jit-static: one compile).
+        self.top_k, self.top_p = top_k, top_p
+        self._sampler = functools.partial(temperature_sample,
+                                          top_k=top_k, top_p=top_p)
         # >1 ⇒ generate() emits this many tokens per device dispatch
         # (lax.scan inside one jit): fewer host↔device round trips —
         # the dominant per-token cost on remote/tunneled chips — at the
@@ -158,7 +197,7 @@ class InferenceEngine:
         (B,) the last emitted token; temperature is TRACED so
         per-request temperatures never recompile (only greedy-vs-sampled
         is static)."""
-        sampler = greedy_sample if greedy else temperature_sample
+        sampler = greedy_sample if greedy else self._sampler
 
         def body(carry, rng):
             cache, token, index = carry
@@ -189,7 +228,7 @@ class InferenceEngine:
             f'{prompt_len}+{max_new_tokens} exceeds max_seq_len '
             f'{self.cfg.max_seq_len}')
         sampler = (greedy_sample
-                   if temperature <= 0 else temperature_sample)
+                   if temperature <= 0 else self._sampler)
 
         cache = self.init_cache()
         t0 = time.time()
@@ -307,13 +346,16 @@ class ContinuousBatchingEngine:
                  mesh: Optional[Any] = None,
                  quantize: Optional[str] = None,
                  decode_chunk: int = 1,
-                 kv_quant: Optional[str] = None) -> None:
+                 kv_quant: Optional[str] = None,
+                 top_k: int = 0,
+                 top_p: float = 0.0) -> None:
         import queue as queue_lib
         import threading
         self.cfg, self.params = _resolve_cfg_and_params(
             cfg, params, max_seq_len, rng_seed, quantize, kv_quant)
         self.num_slots = num_slots
         self.mesh = mesh
+        self.top_k, self.top_p = top_k, top_p
         # >1 ⇒ when no request is waiting to be admitted, a tick decodes
         # this many steps per dispatch (scan in one jit) — fewer
         # host round trips; admission latency is bounded by one chunk.
@@ -406,8 +448,10 @@ class ContinuousBatchingEngine:
             mutable=['cache'])
         last = logits[:, -1, :].astype(jnp.float32)
         greedy = jnp.argmax(last, axis=-1)
-        sampled = jax.random.categorical(
-            rng, last / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
+        scaled = apply_logit_filters(
+            last / jnp.maximum(temps, 1e-6)[:, None],
+            self.top_k, self.top_p)
+        sampled = jax.random.categorical(rng, scaled, axis=-1)
         out = jnp.where(temps <= 0, greedy, sampled).astype(jnp.int32)
         return out, nn.unbox(mutated['cache'])
 
@@ -443,8 +487,10 @@ class ContinuousBatchingEngine:
         if temperature <= 0:
             return int(jnp.argmax(logits_row))
         self._rng, rng = jax.random.split(self._rng)
-        return int(jax.random.categorical(
-            rng, logits_row.astype(jnp.float32) / max(temperature, 1e-6)))
+        scaled = apply_logit_filters(
+            logits_row.astype(jnp.float32) / max(temperature, 1e-6),
+            self.top_k, self.top_p)
+        return int(jax.random.categorical(rng, scaled))
 
     def _bucket(self, length: int) -> int:
         bucket = 16

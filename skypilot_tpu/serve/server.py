@@ -58,7 +58,9 @@ class InferenceServer:
                  num_slots: int = 4,
                  quantize: Optional[str] = None,
                  decode_chunk: int = 1,
-                 kv_quant: Optional[str] = None) -> None:
+                 kv_quant: Optional[str] = None,
+                 top_k: int = 0,
+                 top_p: float = 0.0) -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
@@ -88,7 +90,8 @@ class InferenceServer:
                                                max_seq_len=max_seq_len,
                                                quantize=quantize,
                                                decode_chunk=decode_chunk,
-                                               kv_quant=kv_quant)
+                                               kv_quant=kv_quant,
+                                               top_k=top_k, top_p=top_p)
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
         if tokenizer.startswith('hf:'):
@@ -174,6 +177,9 @@ class InferenceServer:
     # rejected with 400 — the engine returns whole completions),
     # temperature, max_tokens, stop strings (post-hoc truncation), and
     # usage accounting. One choice per request (`n` > 1 → 400).
+    # top_k/top_p are ENGINE-level (--top-k/--top-p: jit-static, one
+    # compile); a request's own top_p field is accepted and ignored —
+    # the standard client default (top_p=1) means "no filter" anyway.
 
     def _truncate_at_stop(self, text: str, stop) -> tuple:
         """Earliest occurrence of ANY stop sequence wins (OpenAI
@@ -347,6 +353,12 @@ def main(argv=None) -> int:
     parser.add_argument('--num-slots', type=int, default=4,
                         help='concurrent decode slots (continuous '
                              'batching width)')
+    parser.add_argument('--top-k', type=int, default=0,
+                        help='sampling: keep only the K highest-logit '
+                             'tokens (0 = off; engine-level, one '
+                             'compile)')
+    parser.add_argument('--top-p', type=float, default=0.0,
+                        help='sampling: nucleus filter mass (0 = off)')
     parser.add_argument('--kv-quant', default=None, choices=['int8'],
                         help='int8 KV cache (per-token scales): halves '
                              'the cache HBM streaming that dominates '
@@ -371,7 +383,8 @@ def main(argv=None) -> int:
                              num_slots=args.num_slots,
                              quantize=args.quantize,
                              decode_chunk=args.decode_chunk,
-                             kv_quant=args.kv_quant)
+                             kv_quant=args.kv_quant,
+                             top_k=args.top_k, top_p=args.top_p)
     server.warmup()
     web.run_app(server.make_app(), host='0.0.0.0', port=args.port,
                 handle_signals=False)

@@ -132,6 +132,55 @@ class TestKVCacheCorrectness:
         assert len(outs) > 1  # hot sampling should not collapse
 
 
+class TestSamplingFilters:
+
+    def test_top_k_masks_all_but_k(self):
+        from skypilot_tpu.models.inference import filter_top_k
+        logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0]])
+        out = np.asarray(filter_top_k(logits, 2))
+        assert np.isfinite(out[0, 1]) and np.isfinite(out[0, 2])
+        assert np.isneginf(out[0, 0]) and np.isneginf(out[0, 3])
+
+    def test_top_p_keeps_nucleus(self):
+        from skypilot_tpu.models.inference import filter_top_p
+        # Probs ≈ [0.643, 0.237, 0.087, 0.032]: p=0.7 keeps the top two.
+        logits = jnp.asarray([[4.0, 3.0, 2.0, 1.0]])
+        out = np.asarray(filter_top_p(logits, 0.7))
+        assert np.isfinite(out[0, 0]) and np.isfinite(out[0, 1])
+        assert np.isneginf(out[0, 2]) and np.isneginf(out[0, 3])
+
+    def test_top_p_always_keeps_top1(self):
+        from skypilot_tpu.models.inference import filter_top_p
+        logits = jnp.asarray([[10.0, 0.0, 0.0, 0.0]])  # top1 mass ~1.0
+        out = np.asarray(filter_top_p(logits, 0.01))
+        assert np.isfinite(out[0, 0])
+        assert np.isneginf(out[0, 1:]).all()
+
+    def test_top_k_1_sampling_is_greedy(self):
+        """top_k=1 with temperature>0 must reproduce the greedy output
+        — pins the engine-level filter wiring end to end."""
+        greedy_engine = InferenceEngine(_cfg(), batch_size=1)
+        k1_engine = InferenceEngine(_cfg(), batch_size=1, top_k=1)
+        prompt = jnp.asarray([[5, 7, 11]], jnp.int32)
+        want, _ = greedy_engine.generate(prompt, max_new_tokens=6)
+        got, _ = k1_engine.generate(prompt, max_new_tokens=6,
+                                    temperature=0.9)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_cbe_top_k_1_sampling_is_greedy(self):
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        ref = InferenceEngine(_cfg(), batch_size=1)
+        want, _ = ref.generate(jnp.asarray([[5, 7, 11]], jnp.int32),
+                               max_new_tokens=6)
+        engine = ContinuousBatchingEngine(_cfg(), num_slots=2, top_k=1)
+        try:
+            toks, _ = engine.generate([5, 7, 11], max_new_tokens=6,
+                                      temperature=0.9)
+        finally:
+            engine.stop()
+        assert toks == [int(t) for t in want[0]]
+
+
 class TestChunkedDecode:
     """decode_chunk>1 runs K decode steps per device dispatch (lax.scan
     in one jit) — it must emit exactly the same greedy tokens as the
