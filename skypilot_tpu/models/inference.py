@@ -57,7 +57,12 @@ def filter_top_p(logits: jax.Array, p: float) -> jax.Array:
 
 def apply_logit_filters(scaled: jax.Array, top_k: int,
                         top_p: float) -> jax.Array:
-    """HF convention: filters apply AFTER temperature scaling."""
+    """HF convention: filters apply AFTER temperature scaling, top-k
+    THEN top-p — and top-p's mass is computed on the RENORMALIZED
+    top-k distribution (masked entries carry no mass), so the two
+    sorts cannot be fused into one threshold pass without changing
+    which tokens survive. Two sorts per step is minor next to the
+    decode matmuls."""
     if top_k and top_k > 0:
         scaled = filter_top_k(scaled, top_k)
     if top_p and 0.0 < top_p < 1.0:

@@ -178,8 +178,9 @@ class InferenceServer:
     # temperature, max_tokens, stop strings (post-hoc truncation), and
     # usage accounting. One choice per request (`n` > 1 → 400).
     # top_k/top_p are ENGINE-level (--top-k/--top-p: jit-static, one
-    # compile); a request's own top_p field is accepted and ignored —
-    # the standard client default (top_p=1) means "no filter" anyway.
+    # compile); a request's own top_p is rejected with 400 unless it is
+    # the no-op client default (top_p=1) — silently sampling from a
+    # different distribution than asked would be worse than failing.
 
     def _truncate_at_stop(self, text: str, stop) -> tuple:
         """Earliest occurrence of ANY stop sequence wins (OpenAI
@@ -206,6 +207,12 @@ class InferenceServer:
                 'stream=false')
         if int(data.get('n') or 1) != 1:
             return self._openai_error('only n=1 is supported')
+        req_top_p = data.get('top_p')
+        if req_top_p is not None and float(req_top_p) != 1.0:
+            return self._openai_error(
+                'per-request top_p is not supported (filters are '
+                'engine-level: serve with --top-p/--top-k); send '
+                'top_p=1 or omit it')
         max_new = int(data.get('max_tokens') or 16)
         if not 0 < max_new < self.engine.cfg.max_seq_len:
             return self._openai_error(
@@ -353,12 +360,27 @@ def main(argv=None) -> int:
     parser.add_argument('--num-slots', type=int, default=4,
                         help='concurrent decode slots (continuous '
                              'batching width)')
-    parser.add_argument('--top-k', type=int, default=0,
+    def _top_k_arg(v):
+        k = int(v)
+        if k < 0:
+            raise argparse.ArgumentTypeError('--top-k must be >= 0')
+        return k
+
+    def _top_p_arg(v):
+        f = float(v)
+        if not 0.0 <= f < 1.0:
+            raise argparse.ArgumentTypeError(
+                '--top-p must be in [0, 1) (0 = off; 1.0 would be a '
+                'no-op — omit the flag instead)')
+        return f
+
+    parser.add_argument('--top-k', type=_top_k_arg, default=0,
                         help='sampling: keep only the K highest-logit '
                              'tokens (0 = off; engine-level, one '
                              'compile)')
-    parser.add_argument('--top-p', type=float, default=0.0,
-                        help='sampling: nucleus filter mass (0 = off)')
+    parser.add_argument('--top-p', type=_top_p_arg, default=0.0,
+                        help='sampling: nucleus filter mass, in [0, 1) '
+                             '(0 = off)')
     parser.add_argument('--kv-quant', default=None, choices=['int8'],
                         help='int8 KV cache (per-token scales): halves '
                              'the cache HBM streaming that dominates '
@@ -385,6 +407,8 @@ def main(argv=None) -> int:
                              decode_chunk=args.decode_chunk,
                              kv_quant=args.kv_quant,
                              top_k=args.top_k, top_p=args.top_p)
+    logger.info('sampling filters: top_k=%s top_p=%s (0 = off)',
+                args.top_k, args.top_p)
     server.warmup()
     web.run_app(server.make_app(), host='0.0.0.0', port=args.port,
                 handle_signals=False)

@@ -503,6 +503,15 @@ class TestInferenceServer:
         resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
                         json={'prompt': 'hi', 'n': 2}, timeout=5)
         assert resp.status_code == 400
+        # Per-request top_p != 1 is rejected (filters are engine-level);
+        # the client default top_p=1 passes through as a no-op.
+        resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
+                        json={'prompt': 'hi', 'top_p': 0.5}, timeout=5)
+        assert resp.status_code == 400
+        resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
+                        json={'prompt': 'hi', 'top_p': 1.0,
+                              'max_tokens': 2}, timeout=60)
+        assert resp.status_code == 200
         resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
                         json={}, timeout=5)
         assert resp.status_code == 400
@@ -515,3 +524,21 @@ class TestInferenceServer:
                         json={'prompt': 'hi', 'max_tokens': 10 ** 6},
                         timeout=5)
         assert resp.status_code == 400
+
+
+class TestCombinedFilters:
+
+    def test_composition_order_is_topk_then_topp_renormalized(self):
+        """HF semantics: top-p operates on the RENORMALIZED top-k
+        distribution (this is what makes a single fused threshold pass
+        incorrect — the combined filter can keep MORE low-rank tokens
+        than full-distribution top-p would)."""
+        from skypilot_tpu.models.inference import (apply_logit_filters,
+                                                   filter_top_k,
+                                                   filter_top_p)
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(3, 64)) * 3)
+        got = np.asarray(apply_logit_filters(logits, 8, 0.8))
+        want = np.asarray(filter_top_p(filter_top_k(logits, 8), 0.8))
+        np.testing.assert_array_equal(np.isneginf(got),
+                                      np.isneginf(want))
