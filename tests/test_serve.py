@@ -240,7 +240,9 @@ class TestServeEndToEnd:
         from skypilot_tpu.serve import core as serve_core
         result = serve_core.up(self._service_task(), 'svc')
         try:
-            endpoint = serve_core.wait_until_ready('svc', timeout=90)
+            # Generous: replica bring-up crawls when the whole suite
+            # loads the 1-core box.
+            endpoint = serve_core.wait_until_ready('svc', timeout=180)
             assert endpoint == result['endpoint']
             resp = requests.get(endpoint + '/', timeout=5)
             assert resp.status_code == 200
