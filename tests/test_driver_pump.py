@@ -11,6 +11,17 @@ import time
 from skypilot_tpu.agent import driver
 
 
+def _wait_for(predicate, timeout=20.0):
+    """Load-proof sync: poll instead of fixed sleeps (this box has one
+    core and the suite loads it heavily)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
 class TestSplitLogLines:
 
     def test_plain_newlines(self):
@@ -96,12 +107,13 @@ class TestPumpFallback:
                              daemon=True)
         t.start()
         os.write(proc.out_w, b'WORLD')
-        time.sleep(0.15)
         os.write(proc.err_w, b'[Gloo] Rank 0 is connected\n')
-        time.sleep(0.15)
+        rank_log = tmp_path / 'rank-0.log'
+        assert _wait_for(lambda: rank_log.exists() and
+                         b'[Gloo]' in rank_log.read_bytes())
         os.write(proc.out_w, b' 2 RANKSUM 1\n')
         proc.finish()
-        t.join(5)
+        t.join(15)
         assert not t.is_alive()
         gang.close()
         lines = (tmp_path / 'rank-0.log').read_text().splitlines()
@@ -118,10 +130,14 @@ class TestPumpFallback:
         t.start()
         os.write(proc.out_w, b'WORLD')
         os.close(proc.out_w)  # stdout writer dies mid-line
-        time.sleep(0.2)
+        rank_log = tmp_path / 'rank-0.log'
+        # The EOF-flush ('WORLD\n') must land before stderr writes —
+        # poll for it instead of sleeping (load-proof).
+        assert _wait_for(lambda: rank_log.exists() and
+                         b'WORLD\n' in rank_log.read_bytes())
         os.write(proc.err_w, b'[Gloo] Rank 0 is connected\n')
         proc.finish()
-        t.join(5)
+        t.join(15)
         assert not t.is_alive()
         gang.close()
         lines = (tmp_path / 'rank-0.log').read_text().split('\n')
