@@ -92,14 +92,29 @@ def _env_diagnostics() -> str:
 
 def _probe_device(timeout: float) -> str:
     """Which platform would a fresh process get? '' = unreachable/hang.
-    Disposable subprocess: a wedged tunnel hangs IT, not us."""
+
+    Probes an actual tiny COMPUTATION, not just device enumeration: a
+    half-dead tunnel can enumerate the chip in milliseconds and then
+    stall the first dispatch forever (observed r4: `jax.devices()`
+    returns `[TPU v5 lite0]` instantly while an 8x8 matmul never
+    completes — enumeration-only preflight passed and the run burned
+    all 3x1200s attempts). Disposable subprocess: a wedged tunnel
+    hangs IT, not us."""
     try:
         out = subprocess.run(
             [sys.executable, '-c',
-             'import jax; print(jax.devices()[0].platform)'],
+             'import jax, jax.numpy as jnp\n'
+             'x = jnp.ones((8, 8), jnp.float32)\n'
+             '(x @ x).block_until_ready()\n'
+             'print(jax.devices()[0].platform)'],
             capture_output=True, text=True, timeout=timeout, check=False)
         if out.returncode == 0 and out.stdout.strip():
             return out.stdout.strip().splitlines()[-1]
+        if out.returncode != 0 and out.stderr:
+            # Fast failure (not a hang): the backend said WHY — show it.
+            tail = '\n'.join(out.stderr.strip().splitlines()[-5:])
+            print(f'[bench] probe failed rc={out.returncode}:\n{tail}',
+                  file=sys.stderr)
     except (subprocess.TimeoutExpired, OSError):
         pass
     return ''
