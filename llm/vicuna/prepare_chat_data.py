@@ -1,0 +1,134 @@
+"""Chat transcripts -> prompt/completion SFT JSONL (token ids).
+
+TPU-native analogue of the reference's llm/vicuna data prep (there:
+FastChat converts ShareGPT JSON before torchrun). Here the output is
+the in-tree SFT contract (train/data.py SftJsonlDataset):
+
+    {"prompt": [ids...], "completion": [ids...]}
+
+one line per ASSISTANT turn — prompt = the chat template rendered over
+every message before that turn (with the generation prompt appended),
+completion = the assistant text + EOS. Loss is masked to completion
+tokens by the trainer, so the model trains only on what the assistant
+said, exactly the Vicuna recipe's semantics.
+
+Accepted input records (JSON array or JSONL):
+  ShareGPT : {"conversations": [{"from": "human"|"gpt", "value": ...}]}
+  OpenAI   : {"messages": [{"role": "user"|"assistant"|..., "content": ...}]}
+
+Usage:
+  python3 prepare_chat_data.py --input sharegpt.json \
+      --tokenizer lmsys/vicuna-7b-v1.5 --out chat_sft.jsonl
+"""
+import argparse
+import json
+import sys
+
+_ROLE_MAP = {'human': 'user', 'gpt': 'assistant', 'system': 'system',
+             'user': 'user', 'assistant': 'assistant'}
+
+
+def _iter_records(paths):
+    for path in paths:
+        with open(path, encoding='utf-8-sig') as f:
+            # Sniff JSON-array vs JSONL from the first non-whitespace
+            # char (pretty-printed dumps often lead with a newline).
+            head = ''
+            while True:
+                ch = f.read(1)
+                if not ch:
+                    break
+                if not ch.isspace():
+                    head = ch
+                    break
+            f.seek(0)
+            if head == '[':
+                yield from json.load(f)
+            else:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield json.loads(line)
+
+
+def _to_messages(rec):
+    """Normalize a record to [{'role', 'content'}, ...] or None."""
+    if 'messages' in rec:
+        msgs = rec['messages']
+    elif 'conversations' in rec:
+        msgs = [{'role': _ROLE_MAP.get(m.get('from', ''), None),
+                 'content': m.get('value', '')}
+                for m in rec['conversations']]
+    else:
+        return None
+    out = []
+    for m in msgs:
+        role = _ROLE_MAP.get(m.get('role') or '', None)
+        if role is None or not m.get('content'):
+            return None  # unknown speaker tag: drop the conversation
+        out.append({'role': role, 'content': m['content']})
+    return out or None
+
+
+def _render(tok, messages, add_generation_prompt):
+    """Render messages to token ids via the tokenizer's chat template,
+    falling back to a plain role-tagged format for template-less
+    tokenizers (base Llama-2, for instance)."""
+    if getattr(tok, 'chat_template', None):
+        return tok.apply_chat_template(
+            messages, add_generation_prompt=add_generation_prompt,
+            tokenize=True)
+    text = ''.join(f'### {m["role"].capitalize()}: {m["content"]}\n'
+                   for m in messages)
+    if add_generation_prompt:
+        text += '### Assistant:'
+    return tok.encode(text)
+
+
+def convert(paths, tokenizer_name, out_path, max_seq=0):
+    from transformers import AutoTokenizer
+    tok = AutoTokenizer.from_pretrained(tokenizer_name)
+    eos = [tok.eos_token_id] if tok.eos_token_id is not None else []
+    n_in = n_out = n_trunc = 0
+    with open(out_path, 'w', encoding='utf-8') as out:
+        for rec in _iter_records(paths):
+            n_in += 1
+            messages = _to_messages(rec)
+            if not messages:
+                continue
+            for i, msg in enumerate(messages):
+                if msg['role'] != 'assistant' or i == 0:
+                    continue
+                prompt = _render(tok, messages[:i],
+                                 add_generation_prompt=True)
+                completion = tok.encode(msg['content'],
+                                        add_special_tokens=False) + eos
+                if max_seq and len(prompt) + len(completion) > max_seq:
+                    if len(prompt) >= max_seq:  # nothing left to learn
+                        continue
+                    completion = completion[:max_seq - len(prompt)]
+                    n_trunc += 1
+                out.write(json.dumps({'prompt': prompt,
+                                      'completion': completion}) + '\n')
+                n_out += 1
+    print(f'{n_in} conversations -> {n_out} SFT examples '
+          f'({n_trunc} truncated) -> {out_path}', file=sys.stderr)
+    return n_out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument('--input', nargs='+', required=True,
+                   help='ShareGPT/OpenAI-style JSON or JSONL files')
+    p.add_argument('--tokenizer', required=True,
+                   help='HF tokenizer repo id or local path')
+    p.add_argument('--out', required=True, help='output SFT JSONL')
+    p.add_argument('--max-seq', type=int, default=0,
+                   help='drop/truncate examples beyond this many tokens')
+    args = p.parse_args(argv)
+    if convert(args.input, args.tokenizer, args.out, args.max_seq) == 0:
+        raise SystemExit('no trainable assistant turns found')
+
+
+if __name__ == '__main__':
+    main()

@@ -201,6 +201,33 @@ LLAMA3_70B = _register(ModelConfig(
     name='llama3-70b', vocab_size=128256, d_model=8192, num_layers=80,
     num_heads=64, num_kv_heads=8, d_mlp=28672, max_seq_len=8192))
 
+# --- Llama-2 family (reference recipes: llm/llama-2, llm/vicuna-llama-2,
+# llm/codellama). Plain pre-Llama-3 shape: MHA for 7B/13B (num_kv_heads
+# == num_heads), GQA only at 70B, rope 10k, 4k context, vocab 32000
+# (already a multiple of 128 — no MXU pad needed).
+LLAMA2_7B = _register(ModelConfig(
+    name='llama2-7b', vocab_size=32000, d_model=4096, num_layers=32,
+    num_heads=32, num_kv_heads=32, d_mlp=11008, max_seq_len=4096,
+    rope_theta=10000.0))
+
+LLAMA2_13B = _register(ModelConfig(
+    name='llama2-13b', vocab_size=32000, d_model=5120, num_layers=40,
+    num_heads=40, num_kv_heads=40, d_mlp=13824, max_seq_len=4096,
+    rope_theta=10000.0))
+
+LLAMA2_70B = _register(ModelConfig(
+    name='llama2-70b', vocab_size=32000, d_model=8192, num_layers=80,
+    num_heads=64, num_kv_heads=8, d_mlp=28672, max_seq_len=4096,
+    rope_theta=10000.0))
+
+# CodeLlama-7B: Llama-2-7B shape retrained for code — 16 tokens added
+# for infilling/EOT (vocab 32016, MXU-padded to 32128 with the pad rows
+# masked), rope theta raised to 1e6 for the 16k context window.
+CODELLAMA_7B = _register(ModelConfig(
+    name='codellama-7b', vocab_size=32128, d_model=4096, num_layers=32,
+    num_heads=32, num_kv_heads=32, d_mlp=11008, max_seq_len=16384,
+    rope_theta=1e6, unpadded_vocab_size=32016))
+
 MIXTRAL_8X7B = _register(ModelConfig(
     name='mixtral-8x7b', vocab_size=32000, d_model=4096, num_layers=32,
     num_heads=32, num_kv_heads=8, d_mlp=14336, max_seq_len=8192,

@@ -1,0 +1,140 @@
+"""llm/vicuna/prepare_chat_data.py — chat JSON -> SFT JSONL contract.
+
+Hermetic: a stub tokenizer stands in for AutoTokenizer (no network),
+and the output is validated against the exact schema
+train/data.py::SftJsonlDataset consumes.
+"""
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SPEC = importlib.util.spec_from_file_location(
+    'prepare_chat_data',
+    os.path.join(_REPO, 'llm', 'vicuna', 'prepare_chat_data.py'))
+prep = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(prep)
+
+
+class _StubTok:
+    """Byte-level stand-in: encode = UTF-8 bytes; template-less unless
+    chat_template is set (then template output is tagged per message)."""
+    eos_token_id = 255
+    chat_template = None
+
+    def encode(self, text, add_special_tokens=True):
+        return list(text.encode('utf-8'))
+
+    def apply_chat_template(self, messages, add_generation_prompt,
+                            tokenize):
+        assert tokenize
+        text = ''.join(f'<{m["role"]}>{m["content"]}' for m in messages)
+        if add_generation_prompt:
+            text += '<assistant>'
+        return list(text.encode('utf-8'))
+
+
+def test_to_messages_normalizes_both_schemas():
+    sharegpt = {'conversations': [{'from': 'human', 'value': 'hi'},
+                                  {'from': 'gpt', 'value': 'yo'}]}
+    openai = {'messages': [{'role': 'user', 'content': 'hi'},
+                           {'role': 'assistant', 'content': 'yo'}]}
+    want = [{'role': 'user', 'content': 'hi'},
+            {'role': 'assistant', 'content': 'yo'}]
+    assert prep._to_messages(sharegpt) == want
+    assert prep._to_messages(openai) == want
+    assert prep._to_messages({'junk': 1}) is None
+    # Unknown speaker tags drop the whole conversation, not just a turn.
+    assert prep._to_messages(
+        {'conversations': [{'from': 'observer', 'value': 'x'}]}) is None
+
+
+def _run_convert(tmp_path, records, monkeypatch, as_jsonl=False,
+                 max_seq=0, tok=None):
+    src = tmp_path / ('in.jsonl' if as_jsonl else 'in.json')
+    if as_jsonl:
+        src.write_text('\n'.join(json.dumps(r) for r in records))
+    else:
+        src.write_text(json.dumps(records))
+    out = tmp_path / 'out.jsonl'
+    fake_auto = type('A', (), {'from_pretrained':
+                               staticmethod(lambda name: tok or _StubTok())})
+    transformers = pytest.importorskip('transformers')
+    monkeypatch.setattr(transformers, 'AutoTokenizer', fake_auto)
+    n = prep.convert([str(src)], 'stub', str(out), max_seq=max_seq)
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert n == len(rows)
+    return rows
+
+
+def test_convert_emits_one_example_per_assistant_turn(tmp_path,
+                                                      monkeypatch):
+    records = [{'messages': [
+        {'role': 'user', 'content': 'a'},
+        {'role': 'assistant', 'content': 'b'},
+        {'role': 'user', 'content': 'c'},
+        {'role': 'assistant', 'content': 'd'},
+    ]}]
+    rows = _run_convert(tmp_path, records, monkeypatch)
+    assert len(rows) == 2
+    for row in rows:
+        assert set(row) == {'prompt', 'completion'}
+        assert all(isinstance(t, int) for t in row['prompt'])
+        # completion = text bytes + EOS appended
+        assert row['completion'][-1] == _StubTok.eos_token_id
+    # Second example's prompt contains the full history incl. turn 1.
+    assert len(rows[1]['prompt']) > len(rows[0]['prompt'])
+
+
+def test_convert_uses_chat_template_when_present(tmp_path, monkeypatch):
+    tok = _StubTok()
+    tok.chat_template = 'jinja-ish'
+    records = [{'messages': [{'role': 'user', 'content': 'hi'},
+                             {'role': 'assistant', 'content': 'yo'}]}]
+    rows = _run_convert(tmp_path, records, monkeypatch, tok=tok)
+    prompt_text = bytes(rows[0]['prompt']).decode()
+    assert prompt_text == '<user>hi<assistant>'  # generation prompt on
+
+
+def test_convert_max_seq_truncates_and_drops(tmp_path, monkeypatch):
+    records = [{'messages': [{'role': 'user', 'content': 'u' * 30},
+                             {'role': 'assistant', 'content': 'v' * 50}]}]
+    rows = _run_convert(tmp_path, records, monkeypatch, max_seq=60,
+                        as_jsonl=True)
+    assert len(rows) == 1
+    row = rows[0]
+    assert len(row['prompt']) + len(row['completion']) <= 60
+    # Prompt alone >= max_seq: example dropped entirely.
+    records = [{'messages': [{'role': 'user', 'content': 'u' * 100},
+                             {'role': 'assistant', 'content': 'v'}]}]
+    assert _run_convert(tmp_path, records, monkeypatch, max_seq=60) == []
+
+
+def test_iter_records_tolerates_leading_whitespace_array(tmp_path,
+                                                         monkeypatch):
+    """Pretty-printed dumps lead with newlines before '[' — still an
+    array, not JSONL."""
+    records = [{'messages': [{'role': 'user', 'content': 'hi'},
+                             {'role': 'assistant', 'content': 'yo'}]}]
+    src = tmp_path / 'in.json'
+    src.write_text('\n  ' + json.dumps(records, indent=2))
+    assert list(prep._iter_records([str(src)])) == records
+
+
+def test_sft_jsonl_feeds_the_trainer_dataset(tmp_path, monkeypatch):
+    """End of the contract: the emitted file loads into SftJsonlDataset
+    and yields prompt-masked batches."""
+    sys.path.insert(0, _REPO)
+    from skypilot_tpu.train.data import SftJsonlDataset
+    records = [{'messages': [{'role': 'user', 'content': 'ab'},
+                             {'role': 'assistant', 'content': 'cdef'}]},
+               {'messages': [{'role': 'user', 'content': 'gh'},
+                             {'role': 'assistant', 'content': 'ijkl'}]}]
+    _run_convert(tmp_path, records, monkeypatch)
+    ds = SftJsonlDataset(str(tmp_path / 'out.jsonl'), batch_size=2,
+                         seq_len=32)
+    batch = next(iter(ds))
+    assert batch['mask'].sum() > 0

@@ -62,6 +62,36 @@ class TestLlamaFamily:
         model = transformers.LlamaForCausalLM(hf_cfg)
         _logit_parity(model, _base_cfg())
 
+    def test_llama2_mha_logits_match(self):
+        """Llama-2 shape: MHA (num_kv_heads == num_heads), rope 10k —
+        the pre-GQA repeat-kv degenerate case must still be exact."""
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=64,
+            rope_theta=10000.0, rms_norm_eps=1e-6,
+            attn_implementation='eager')
+        model = transformers.LlamaForCausalLM(hf_cfg)
+        _logit_parity(model, _base_cfg(num_kv_heads=4))
+
+    def test_codellama_padded_vocab_logits_match(self):
+        """CodeLlama shape: HF vocab 260 (≅32016: not MXU-aligned) into
+        a padded-vocab config; pad rows must be masked, real rows exact."""
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=260, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=64,
+            rope_theta=1e6, rms_norm_eps=1e-6,
+            attn_implementation='eager')
+        model = transformers.LlamaForCausalLM(hf_cfg)
+        cfg = _base_cfg(vocab_size=384, unpadded_vocab_size=260,
+                        num_kv_heads=4, rope_theta=1e6)
+        _logit_parity(model, cfg, vocab_limit=260)
+        params = load_hf_model(model, cfg)
+        logits = np.asarray(Transformer(cfg).apply(
+            {'params': params}, jnp.asarray([[1, 2, 3]], jnp.int32)))
+        assert (logits[..., 260:] < -1e29).all()
+
     def test_mistral_sliding_window_logits_match(self):
         hf_cfg = transformers.MistralConfig(
             vocab_size=256, hidden_size=64, intermediate_size=128,

@@ -64,13 +64,15 @@ def test_llm_zoo_breadth():
     config-driven finetune, long-context."""
     dirs = {d for d in os.listdir(os.path.join(_REPO, 'llm'))
             if os.path.isdir(os.path.join(_REPO, 'llm', d))}
-    assert len(dirs) >= 10, sorted(dirs)
+    assert len(dirs) >= 15, sorted(dirs)
     for required in ('gemma-2', 'mistral', 'finetune-config',
-                     'longcontext'):
+                     'longcontext', 'llama-2', 'llama-3', 'codellama',
+                     'vicuna'):
         assert required in dirs, sorted(dirs)
     names = {os.path.relpath(p, _REPO) for p in _LLM}
     assert 'llm/gpt-2/serve.yaml' in names
     assert 'llm/qwen/serve-72b.yaml' in names
+    assert 'llm/llama-2/serve-70b.yaml' in names
 
 
 def test_examples_breadth():

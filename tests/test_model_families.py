@@ -224,6 +224,10 @@ class TestRegistry:
         ('qwen2-72b', 6.5e10, 8.0e10),
         ('gpt2-124m', 1.1e8, 1.4e8),
         ('gpt2-1.5b', 1.4e9, 1.7e9),
+        ('llama2-7b', 6.5e9, 7.0e9),
+        ('llama2-13b', 1.25e10, 1.35e10),
+        ('llama2-70b', 6.6e10, 7.1e10),
+        ('codellama-7b', 6.5e9, 7.0e9),
     ])
     def test_param_counts_in_published_range(self, name, lo, hi):
         assert lo <= get_config(name).num_params() <= hi
