@@ -1,0 +1,49 @@
+"""Python-API end-to-end app (reference analogue: examples/example_app.py).
+
+Everything the CLI does is importable: build a Task programmatically,
+optimize it, launch, tail, and tear down. Run against the hermetic fake
+cloud (no credentials needed):
+
+    SKYTPU_ENABLE_FAKE_CLOUD=1 python3 examples/example_app.py --cloud fake
+
+or against real GCP (after `skytpu check`):
+
+    python3 examples/example_app.py
+"""
+import argparse
+
+import skypilot_tpu as sky
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--cloud', default=None,
+                        help="e.g. 'fake' for the hermetic demo cloud")
+    parser.add_argument('--down', action='store_true',
+                        help='tear the cluster down afterwards')
+    args = parser.parse_args()
+
+    task = sky.Task(
+        name='api-demo',
+        run='echo "hello from task $SKYTPU_TASK_ID rank $SKYTPU_NODE_RANK"',
+    )
+    task.set_resources(
+        sky.Resources(cloud=args.cloud, accelerators='tpu-v5e-8'))
+
+    # Stage 1: see the optimizer's plan without provisioning.
+    dag = sky.Dag()
+    dag.add(task)
+    sky.optimize(dag)
+    print('picked:', task.best_resources())
+
+    # Stage 2: the real thing — provision (with failover), run, stream.
+    job_id, handle = sky.launch(task, cluster_name='api-demo')
+    print(f'job {job_id} on {handle.cluster_name}')
+    sky.tail_logs('api-demo', job_id, follow=True)
+
+    if args.down:
+        sky.down('api-demo')
+
+
+if __name__ == '__main__':
+    main()

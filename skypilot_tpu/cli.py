@@ -26,29 +26,66 @@ def _fail(message: str) -> None:
     sys.exit(1)
 
 
+def _parse_env_file(path: str) -> List[tuple]:
+    """dotenv-format KEY=VALUE lines ('#' comments, optional `export `
+    prefix, optional single/double quotes around the value)."""
+    pairs = []
+    try:
+        with open(os.path.expanduser(path), encoding='utf-8') as f:
+            lines = f.readlines()
+    except OSError as e:
+        _fail(f'--env-file {path}: {e}')
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line or line.startswith('#'):
+            continue
+        if line.startswith('export '):
+            line = line[len('export '):].lstrip()
+        if '=' not in line:
+            _fail(f'--env-file {path}:{i}: expected KEY=VALUE, '
+                  f'got {line!r}')
+        key, value = line.split('=', 1)
+        value = value.strip()
+        if len(value) >= 2 and value[0] == value[-1] and value[0] in '\'"':
+            value = value[1:-1]
+        pairs.append((key.strip(), value))
+    return pairs
+
+
 def _make_task(entrypoint: tuple, name: Optional[str],
                workdir: Optional[str], cloud: Optional[str],
                region: Optional[str], zone: Optional[str],
                accelerators: Optional[str], num_slices: Optional[int],
                use_spot: Optional[bool], env: tuple,
-               ports: tuple) -> 'sky.Task':
+               ports: tuple, env_file: Optional[str] = None) -> 'sky.Task':
     """YAML-file-or-inline-command entrypoint (reference:
     _make_task_or_dag_from_entrypoint_with_overrides, cli.py:690)."""
     entry = ' '.join(entrypoint)
     is_yaml = entry.endswith(('.yaml', '.yml')) and os.path.exists(
         os.path.expanduser(entry))
+    # --env applied after --env-file: explicit flags win on conflict
+    # (the reference's documented precedence, sky/cli.py:237).
+    env_overrides: Dict[str, str] = {}
+    if env_file:
+        env_overrides.update(_parse_env_file(env_file))
+    env_overrides.update(e.split('=', 1) if '=' in e else (e, '')
+                         for e in env)
     if is_yaml:
-        task = sky.Task.from_yaml(entry)
+        # Overrides MUST flow through from_yaml: ${VAR} substitution in
+        # run/setup/file_mounts happens at parse time, and required-env
+        # (`VAR:` with no value) validation runs there too — appending
+        # envs afterwards would silently leave the YAML defaults baked
+        # into the command text.
+        task = sky.Task.from_yaml(entry, env_overrides=env_overrides)
     else:
         if not entry:
             _fail('ENTRYPOINT required: a task YAML or an inline command.')
         task = sky.Task(run=entry)
+        task.update_envs(env_overrides)
     if name is not None:
         task.name = name
     if workdir is not None:
         task.workdir = workdir
-    task.update_envs([e.split('=', 1) if '=' in e else (e, '')
-                      for e in env])
 
     overrides: Dict[str, Any] = {}
     if cloud is not None:
@@ -108,6 +145,9 @@ _TASK_OPTIONS = [
     click.option('--use-spot/--no-use-spot', default=None,
                  help='Preemptible capacity.'),
     click.option('--env', multiple=True, help='KEY=VALUE (repeatable).'),
+    click.option('--env-file', default=None,
+                 help='dotenv file of KEY=VALUE lines; --env wins on '
+                      'conflict.'),
     click.option('--ports', multiple=True, help='Ports to open.'),
 ]
 
@@ -139,11 +179,13 @@ def cli() -> None:
 @click.option('--retry-until-up', is_flag=True, default=False)
 @click.option('--yes', '-y', is_flag=True, default=False)
 def launch(entrypoint, name, workdir, cloud, region, zone, accelerators,
-           num_slices, use_spot, env, ports, cluster, dryrun, detach_run,
-           idle_minutes_to_autostop, down, retry_until_up, yes):
+           num_slices, use_spot, env, env_file, ports, cluster, dryrun,
+           detach_run, idle_minutes_to_autostop, down, retry_until_up,
+           yes):
     """Provision a TPU slice (with failover) and run ENTRYPOINT on it."""
     task = _make_task(entrypoint, name, workdir, cloud, region, zone,
-                      accelerators, num_slices, use_spot, env, ports)
+                      accelerators, num_slices, use_spot, env, ports,
+                      env_file=env_file)
     cluster = cluster or task.name
     if not dryrun:
         _confirm(f'Launching on cluster {cluster!r}. Proceed?', yes)
@@ -185,11 +227,12 @@ def launch(entrypoint, name, workdir, cloud, region, zone, accelerators,
 @click.argument('cluster')
 @click.argument('entrypoint', nargs=-1)
 @click.option('--env', multiple=True)
+@click.option('--env-file', default=None)
 @click.option('--detach-run', '-d', is_flag=True, default=False)
-def exec_cmd(cluster, entrypoint, env, detach_run):
+def exec_cmd(cluster, entrypoint, env, env_file, detach_run):
     """Fast path: run ENTRYPOINT on an existing cluster (no provision)."""
     task = _make_task(entrypoint, None, None, None, None, None, None, None,
-                      None, env, ())
+                      None, env, (), env_file=env_file)
     try:
         job_id, _ = sky.exec(task, cluster_name=cluster,
                              detach_run=detach_run)
@@ -512,10 +555,12 @@ def jobs():
 @_with_task_options
 @click.option('--yes', '-y', is_flag=True, default=False)
 def jobs_launch(entrypoint, name, workdir, cloud, region, zone,
-                accelerators, num_slices, use_spot, env, ports, yes):
+                accelerators, num_slices, use_spot, env, env_file, ports,
+                yes):
     """Launch a managed job (provision + monitor + recover)."""
     task = _make_task(entrypoint, name, workdir, cloud, region, zone,
-                      accelerators, num_slices, use_spot, env, ports)
+                      accelerators, num_slices, use_spot, env, ports,
+                      env_file=env_file)
     _confirm(f'Launching managed job {task.name!r}. Proceed?', yes)
     job_id = sky.jobs.launch(task, name=task.name)
     click.echo(f'Managed job {job_id} submitted. '
@@ -605,11 +650,13 @@ def serve():
 @serve.command('up')
 @click.argument('entrypoint', nargs=-1)
 @click.option('--service-name', '-n', default=None)
+@click.option('--env', multiple=True, help='KEY=VALUE (repeatable).')
+@click.option('--env-file', default=None)
 @click.option('--yes', '-y', is_flag=True, default=False)
-def serve_up(entrypoint, service_name, yes):
+def serve_up(entrypoint, service_name, env, env_file, yes):
     """Bring up a service from a task YAML with a `service:` section."""
     task = _make_task(entrypoint, None, None, None, None, None, None, None,
-                      None, (), ())
+                      None, env, (), env_file=env_file)
     if task.service is None:
         _fail('Task YAML needs a `service:` section for serve up.')
     _confirm(f'Starting service {service_name or task.name!r}. Proceed?',
@@ -651,13 +698,15 @@ def serve_status(service_name, endpoint_only):
 @serve.command('update')
 @click.argument('service_name')
 @click.argument('entrypoint', nargs=-1)
+@click.option('--env', multiple=True, help='KEY=VALUE (repeatable).')
+@click.option('--env-file', default=None)
 @click.option('--yes', '-y', is_flag=True, default=False)
-def serve_update(service_name, entrypoint, yes):
+def serve_update(service_name, entrypoint, env, env_file, yes):
     """Roll a service to a new task/spec version (blue-green-ish: new
     replicas use the new spec; reference: sky serve update,
     sky/cli.py:4076)."""
     task = _make_task(entrypoint, None, None, None, None, None, None, None,
-                      None, (), ())
+                      None, env, (), env_file=env_file)
     if task.service is None:
         _fail('Task YAML needs a `service:` section for serve update.')
     _confirm(f'Update service {service_name!r} to a new version?', yes)

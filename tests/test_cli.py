@@ -80,6 +80,104 @@ class TestBasicCommands:
         assert result.exit_code == 1
 
 
+class TestEnvFile:
+    """--env-file: dotenv parsing + the documented '--env wins'
+    precedence (reference: sky/cli.py:230-237)."""
+
+    def test_parse_env_file(self, tmp_path):
+        f = tmp_path / 'app.env'
+        f.write_text('# comment\n'
+                     'PLAIN=1\n'
+                     'export EXPORTED=two\n'
+                     "QUOTED='three four'\n"
+                     'DQUOTED="five"\n'
+                     'EMPTY=\n'
+                     '\n')
+        assert cli_mod._parse_env_file(str(f)) == [
+            ('PLAIN', '1'), ('EXPORTED', 'two'),
+            ('QUOTED', 'three four'), ('DQUOTED', 'five'), ('EMPTY', ''),
+        ]
+
+    def test_parse_env_file_rejects_garbage(self, tmp_path, runner):
+        f = tmp_path / 'bad.env'
+        f.write_text('NOT A KV LINE\n')
+        result = runner.invoke(cli_mod.cli, [
+            'launch', '--dryrun', '--cloud', 'fake',
+            '--env-file', str(f), 'echo hi'])
+        assert result.exit_code == 1
+        assert 'KEY=VALUE' in result.output
+
+    def test_missing_env_file_fails(self, runner):
+        result = runner.invoke(cli_mod.cli, [
+            'launch', '--dryrun', '--cloud', 'fake',
+            '--env-file', '/nonexistent/x.env', 'echo hi'])
+        assert result.exit_code == 1
+
+    def test_env_flag_wins_over_env_file(self, tmp_path):
+        f = tmp_path / 'app.env'
+        f.write_text('A=file\nB=file\n')
+        task = cli_mod._make_task(('echo hi',), None, None, 'fake', None,
+                                  None, None, None, None, ('A=flag',),
+                                  (), env_file=str(f))
+        assert task.envs['A'] == 'flag'
+        assert task.envs['B'] == 'file'
+
+    def test_env_overrides_reach_yaml_substitution(self, tmp_path):
+        """--env/--env-file must flow into from_yaml: $VAR in `run` is
+        substituted at parse time, so late update_envs would leave the
+        YAML default baked into the command (the serve-13B-got-7B bug)."""
+        f = tmp_path / 'app.env'
+        f.write_text('MODEL=from-file\nBUCKET=bkt\n')
+        yaml_path = tmp_path / 't.yaml'
+        yaml_path.write_text(
+            'envs:\n  MODEL: default\n  BUCKET:\n'
+            'run: echo $MODEL ${BUCKET}\n')
+        task = cli_mod._make_task((str(yaml_path),), None, None, None,
+                                  None, None, None, None, None,
+                                  ('MODEL=from-flag',), (),
+                                  env_file=str(f))
+        assert task.run == 'echo from-flag bkt'
+        # Required env (BUCKET:) satisfied by the env file — no raise.
+
+    def test_required_env_satisfied_by_flag(self, tmp_path):
+        """`VAR:` (required, no default) + --env VAR=... must parse —
+        the documented managed-job launch idiom."""
+        yaml_path = tmp_path / 't.yaml'
+        yaml_path.write_text('envs:\n  BUCKET:\nrun: echo ${BUCKET}\n')
+        task = cli_mod._make_task((str(yaml_path),), None, None, None,
+                                  None, None, None, None, None,
+                                  ('BUCKET=mine',), ())
+        assert task.run == 'echo mine'
+
+    def test_serve_up_accepts_env(self, runner, tmp_path):
+        """serve up now plumbs --env/--env-file into the task (the
+        llm/chat README's documented invocation)."""
+        yaml_path = tmp_path / 'svc.yaml'
+        yaml_path.write_text(
+            'name: svc\n'
+            'envs:\n  MODEL: default\n'
+            'resources:\n  cloud: fake\n  accelerators: tpu-v5e-8\n'
+            '  ports: [8080]\n'
+            'service:\n  readiness_probe: /health\n  replicas: 1\n'
+            'run: echo $MODEL\n')
+        captured = {}
+        real = cli_mod._make_task
+
+        def spy(*args, **kwargs):
+            task = real(*args, **kwargs)
+            captured['envs'] = dict(task.envs)
+            raise SystemExit(0)  # stop before any controller launch
+
+        cli_mod._make_task, orig = spy, cli_mod._make_task
+        try:
+            runner.invoke(cli_mod.cli, [
+                'serve', 'up', str(yaml_path), '-n', 'svc',
+                '--env', 'MODEL=llama3-8b', '--yes'])
+        finally:
+            cli_mod._make_task = orig
+        assert captured['envs']['MODEL'] == 'llama3-8b'
+
+
 @pytest.mark.slow
 class TestCliEndToEnd:
 

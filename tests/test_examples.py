@@ -77,7 +77,38 @@ def test_llm_zoo_breadth():
 
 def test_examples_breadth():
     entries = os.listdir(os.path.join(_REPO, 'examples'))
-    assert len(entries) >= 30, sorted(entries)
+    assert len(entries) >= 40, sorted(entries)
+    for required in ('env_file', 'custom_image.yaml', 'disk_size.yaml',
+                     'start_stop.yaml', 'multi_resources.yaml',
+                     'using_file_mounts_with_env_vars.yaml',
+                     'example_app.py'):
+        assert required in entries
+
+
+@pytest.mark.slow
+def test_example_app_end_to_end_on_fake_cloud(tmp_path):
+    """examples/example_app.py (Python-API demo) really launches, runs,
+    and tears down on the hermetic fake cloud."""
+    import subprocess
+    import sys as _sys
+    # Own state dir: tmp_path/state.db is the FIXTURE's db and already
+    # caches enabled_clouds=['gcp'], which would mask the fake cloud.
+    sub = tmp_path / 'subproc'
+    sub.mkdir()
+    env = dict(os.environ,
+               PYTHONPATH=_REPO,
+               SKYTPU_ENABLE_FAKE_CLOUD='1',
+               SKYTPU_STATE_DB=str(sub / 'state.db'),
+               SKYTPU_FAKE_CLOUD_STATE=str(sub / 'fake_cloud.json'),
+               SKYTPU_HOME=str(sub / 'home'))
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(_REPO, 'examples',
+                                       'example_app.py'),
+         '--cloud', 'fake', '--down'],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert 'hello from task' in proc.stdout
+    assert 'picked: Resources(fake' in proc.stdout
 
 
 def test_finetune_config_maps_to_trainer_argv():
