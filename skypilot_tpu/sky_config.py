@@ -2,8 +2,10 @@
 
 Reference parity: sky/skypilot_config.py (232 LoC) — nested-key config loaded
 at import, overridable via env var (SKYTPU_CONFIG), validated against
-utils/schemas.CONFIG_SCHEMA. Precedence (highest first): task YAML > CLI
-flags > this file (applied by callers; this module only serves lookups).
+utils/schemas.CONFIG_SCHEMA. Precedence (highest first): CLI flags >
+task YAML > SKYTPU_* env vars > this file (applied by callers; this
+module only serves lookups — e.g. usage_lib and clouds/fake check their
+env knob before falling back here).
 """
 from __future__ import annotations
 
