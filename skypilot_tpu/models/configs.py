@@ -60,6 +60,17 @@ class ModelConfig:
     # channel fp32 scales halve that traffic (models/quantize.py converts
     # a float checkpoint; training always runs float).
     weight_quant: str = 'none'
+    # LoRA fine-tuning (0 ⇒ off; reference recipe this serves:
+    # llm/llama-3_1-finetuning/lora.yaml — there torchtune LoRA on GPUs).
+    # When lora_rank > 0 each targeted projection keeps its frozen base
+    # kernel and adds y += (alpha/r)·B(A(x)) with A ~ N(0, 1/r), B = 0 —
+    # identical forward at init. `lora_targets` is a comma list from
+    # {q,k,v,o,gate,up,down} (module names <t>_proj). Train with
+    # trainer.py's masked optimizer (only lora_a/lora_b update); merge
+    # for serving/export with models/lora.merge_lora.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: str = 'q,v'
     # When vocab_size is padded for MXU tiling (e.g. GPT-2 50257→50304),
     # the REAL vocabulary size: logits beyond it are masked to -inf so
     # temperature sampling can never emit an invalid token id (padded

@@ -151,6 +151,16 @@ def to_hf(params: Mapping[str, Any],
     stripped so the export matches the real tokenizer — hf_config_for
     emits the unpadded size to match; from_hf re-pads on the way back.
     """
+    from skypilot_tpu.models import lora as lora_lib
+    if cfg.lora_rank > 0:
+        # Exporting raw LoRA params would emit the UNTUNED base — fold
+        # the adapters in first so the export carries the fine-tune.
+        params = lora_lib.merge_lora(params, cfg)
+    elif lora_lib.has_lora(params):
+        raise ValueError(
+            'param tree contains lora_a/lora_b but cfg.lora_rank == 0: '
+            'pass the LoRA config (or merge_lora first) — a silent '
+            'export here would drop the fine-tune')
     p = {k: _cast_tree(v, np.float32) for k, v in params.items()}
     if 0 < cfg.unpadded_vocab_size < cfg.vocab_size:
         n = cfg.unpadded_vocab_size

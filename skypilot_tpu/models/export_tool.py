@@ -28,6 +28,14 @@ def main(argv=None) -> int:
                              'float32 (default) is lossless; bfloat16 '
                              'halves the export at the cost of '
                              'truncating the fp32 masters.')
+    parser.add_argument('--lora-rank', type=int, default=0,
+                        help='set to the training run\'s --lora-rank '
+                             'when exporting a LoRA checkpoint: the '
+                             'restore needs the adapter structure, and '
+                             'the export folds the adapters into the '
+                             'base weights (to_hf auto-merges)')
+    parser.add_argument('--lora-alpha', type=float, default=16.0)
+    parser.add_argument('--lora-targets', default='q,v')
     args = parser.parse_args(argv)
 
     import jax
@@ -36,7 +44,34 @@ def main(argv=None) -> int:
     from skypilot_tpu.models.convert import export_hf_checkpoint
     from skypilot_tpu.models.inference import load_params_from_checkpoint
 
-    cfg = get_config(args.model, param_dtype=args.dtype)
+    # The training run records its LoRA shape in <ckpt>/lora.json; it is
+    # the source of truth — merging with the wrong alpha mis-scales the
+    # fold-in, and a targets subset would silently drop adapters
+    # (partial restore ignores leaves the config doesn't ask for).
+    # Flags must agree with the sidecar when both are present.
+    import json
+    import os
+    overrides = {}
+    sidecar_path = os.path.join(
+        os.path.expanduser(args.checkpoint_dir), 'lora.json')
+    if os.path.exists(sidecar_path):
+        with open(sidecar_path, encoding='utf-8') as f:
+            sidecar = json.load(f)
+        if args.lora_rank and (
+                args.lora_rank != sidecar['lora_rank']
+                or args.lora_alpha != sidecar['lora_alpha']
+                or args.lora_targets != sidecar['lora_targets']):
+            print(f'error: --lora-* flags disagree with the training '
+                  f'run\'s {sidecar_path}: {sidecar}', file=sys.stderr)
+            return 1
+        overrides.update(sidecar)
+        print(f'LoRA checkpoint ({sidecar}): adapters will be merged '
+              f'into the base weights', file=sys.stderr)
+    elif args.lora_rank:
+        overrides.update(lora_rank=args.lora_rank,
+                         lora_alpha=args.lora_alpha,
+                         lora_targets=args.lora_targets)
+    cfg = get_config(args.model, param_dtype=args.dtype, **overrides)
     params = load_params_from_checkpoint(cfg, args.checkpoint_dir)
     host_params = jax.tree.map(jax.device_get, params)
     export_hf_checkpoint(host_params, cfg, args.out)

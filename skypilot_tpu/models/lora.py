@@ -1,0 +1,127 @@
+"""LoRA utilities: merge adapters into base weights; param overlays.
+
+Serves the reference's flagship fine-tune recipe
+(llm/llama-3_1-finetuning/lora.yaml — there torchtune LoRA on GPUs).
+The adapter itself lives in the model
+(transformer.LoRADenseGeneral: y = W·x + (alpha/r)·B(A(x))); this
+module handles the tree surgery around it:
+
+- merge_lora: fold every adapter into its base kernel
+  (W += (alpha/r)·A⊗B) and drop the lora leaves — the result is a
+  plain checkpoint servable/exportable with lora_rank=0. Handles both
+  scanned (leading num_layers stack dim) and unscanned layouts by
+  shape, not by path.
+- has_lora / overlay_base_params: helpers for init-from-HF and the
+  export guard (exporting an unmerged LoRA tree silently drops the
+  fine-tune — models/convert.to_hf refuses instead).
+"""
+from typing import Any, Dict, Mapping
+
+import jax
+import numpy as np
+from flax import linen as nn
+
+from skypilot_tpu.models.configs import ModelConfig
+
+
+def _unboxed(params: Mapping[str, Any]) -> Dict[str, Any]:
+    """Strip flax LogicallyPartitioned boxes (init-time trees carry
+    them; checkpoint/HF trees don't)."""
+    return nn.unbox(dict(params))
+
+
+def has_lora(params: Mapping[str, Any]) -> bool:
+    found = [False]
+
+    def visit(path, _leaf):
+        if any(getattr(k, 'key', None) in ('lora_a', 'lora_b')
+               for k in path):
+            found[0] = True
+
+    jax.tree_util.tree_map_with_path(visit, _unboxed(params))
+    return found[0]
+
+
+def _merge_one(kernel, a, b, scale):
+    """kernel += scale * (A contracted with B over the rank dim).
+
+    Disambiguates scanned vs flat layouts by checking which
+    interpretation reproduces kernel.shape exactly:
+      flat   : A (*in, r),    B (r, *out),    kernel (*in, *out)
+      scanned: A (L, *in, r), B (L, r, *out), kernel (L, *in, *out)
+    """
+    import jax.numpy as jnp
+    flat_ok = (a.shape[-1] == b.shape[0]
+               and kernel.shape == a.shape[:-1] + b.shape[1:])
+    scanned_ok = (a.ndim >= 2 and b.ndim >= 2
+                  and a.shape[0] == b.shape[0]
+                  and a.shape[-1] == b.shape[1]
+                  and kernel.shape ==
+                  (a.shape[0],) + a.shape[1:-1] + b.shape[2:])
+    if flat_ok == scanned_ok:
+        raise ValueError(
+            f'cannot disambiguate LoRA layout: kernel {kernel.shape}, '
+            f'A {a.shape}, B {b.shape}')
+    if flat_ok:
+        delta = jnp.tensordot(a, b, axes=[[-1], [0]])
+    else:
+        delta = jax.vmap(
+            lambda ai, bi: jnp.tensordot(ai, bi, axes=[[-1], [0]]))(a, b)
+    return (kernel.astype(np.float32) +
+            scale * delta.astype(np.float32)).astype(kernel.dtype)
+
+
+def merge_lora(params: Mapping[str, Any],
+               cfg: ModelConfig) -> Dict[str, Any]:
+    """Fold adapters into kernels; return a lora-free param tree."""
+    if cfg.lora_rank <= 0:
+        raise ValueError('merge_lora called with lora_rank == 0')
+    params = _unboxed(params)
+    scale = cfg.lora_alpha / cfg.lora_rank
+
+    def walk(node):
+        if not isinstance(node, Mapping):
+            return node
+        node = dict(node)
+        if 'lora_a' in node:
+            if 'kernel' not in node:
+                raise ValueError('lora_a without a sibling kernel')
+            node['kernel'] = _merge_one(node['kernel'], node['lora_a'],
+                                        node['lora_b'], scale)
+            del node['lora_a'], node['lora_b']
+        return {k: walk(v) for k, v in node.items()}
+
+    return walk(dict(params))
+
+
+def overlay_base_params(full: Mapping[str, Any],
+                        base: Mapping[str, Any]) -> Dict[str, Any]:
+    """Replace `full`'s leaves with `base`'s wherever base has them,
+    keeping leaves only `full` has (the lora_a/lora_b adapters) — the
+    init-from-HF path for a LoRA config: HF supplies the frozen base,
+    the fresh init supplies the adapters."""
+    out = dict(full)
+    for key, base_val in base.items():
+        if key in out and isinstance(out[key], Mapping) and \
+                isinstance(base_val, Mapping):
+            out[key] = overlay_base_params(out[key], base_val)
+        else:
+            out[key] = base_val
+    return out
+
+
+def overlay_place(full: Mapping[str, Any], base: Mapping[str, Any],
+                  shardings: Mapping[str, Any]) -> Dict[str, Any]:
+    """overlay_base_params for sharded trees: device_put each `base`
+    (host) leaf onto its mesh sharding, keep `full`'s already-placed
+    arrays (the fresh adapters) untouched. Never fetches `full` to
+    host — on a multi-host mesh its leaves span non-addressable
+    devices and jax.device_get would throw (and pulling the multi-GB
+    base down just to keep the tiny adapters is dead work anyway)."""
+    out = dict(full)
+    for key, base_val in base.items():
+        if isinstance(base_val, Mapping):
+            out[key] = overlay_place(full[key], base_val, shardings[key])
+        else:
+            out[key] = jax.device_put(base_val, shardings[key])
+    return out

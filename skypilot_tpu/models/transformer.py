@@ -99,11 +99,100 @@ class QuantDenseGeneral(nn.Module):
         return y
 
 
+class LoRADenseGeneral(nn.Module):
+    """DenseGeneral + low-rank adapter: y = W·x + (alpha/r)·B(A(x)).
+
+    Base params keep nn.DenseGeneral's exact names/shapes in THIS
+    module's scope ('kernel'/'bias'), so checkpoints and from_hf line
+    up unchanged; the adapter adds 'lora_a' (N(0, 1/r) init) and
+    'lora_b' (zeros init — forward equals the base layer at step 0).
+    A's input dims shard like the kernel's; the rank dim (tiny) is
+    replicated. Train with trainer.py's masked optimizer; fold into
+    the kernel with models/lora.merge_lora for serving/export.
+    """
+    cfg: ModelConfig
+    features: Any                 # int or tuple
+    kernel_axes: Tuple[str, ...]
+    axis: Any = -1                # int or tuple: contracted input dims
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        features = (self.features if isinstance(self.features, tuple)
+                    else (self.features,))
+        axis = (self.axis if isinstance(self.axis, tuple)
+                else (self.axis,))
+        axis = tuple(a % x.ndim for a in axis)
+        in_shape = tuple(x.shape[a] for a in axis)
+        contract = ((axis, tuple(range(len(in_shape)))), ((), ()))
+        kernel = self.param(
+            'kernel',
+            nn.with_logical_partitioning(nn.initializers.lecun_normal(),
+                                         self.kernel_axes),
+            in_shape + features, _param_dtype(cfg))
+        y = jax.lax.dot_general(x, kernel.astype(_dtype(cfg)), contract)
+        r = cfg.lora_rank
+        lora_a = self.param(
+            'lora_a',
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=r ** -0.5),  # A ~ N(0, 1/r)
+                self.kernel_axes[:len(in_shape)] + ('lora_rank',)),
+            in_shape + (r,), _param_dtype(cfg))
+        lora_b = self.param(
+            'lora_b',
+            nn.with_logical_partitioning(
+                nn.initializers.zeros,
+                ('lora_rank',) + self.kernel_axes[len(in_shape):]),
+            (r,) + features, _param_dtype(cfg))
+        z = jax.lax.dot_general(x, lora_a.astype(_dtype(cfg)), contract)
+        z = jax.lax.dot_general(
+            z, lora_b.astype(_dtype(cfg)),
+            (((z.ndim - 1,), (0,)), ((), ())))
+        y = y + z * (cfg.lora_alpha / r)
+        if self.use_bias:
+            bias = self.param(
+                'bias',
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros,
+                    self.kernel_axes[len(in_shape):]),
+                features, _param_dtype(cfg))
+            y = y + bias.astype(_dtype(cfg))
+        return y
+
+
+def lora_target_names(cfg: ModelConfig) -> Tuple[str, ...]:
+    """'q,v' → ('q_proj', 'v_proj'); validates the token set."""
+    valid = ('q', 'k', 'v', 'o', 'gate', 'up', 'down')
+    names = []
+    for tok in cfg.lora_targets.split(','):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok not in valid:
+            raise ValueError(f'lora_targets token {tok!r} not in {valid}')
+        names.append(f'{tok}_proj')
+    if cfg.lora_rank > 0 and not names:
+        raise ValueError('lora_rank > 0 but lora_targets is empty')
+    return tuple(names)
+
+
 def dense_general(cfg: ModelConfig, features, kernel_axes, name: str,
                   axis=-1, use_bias: bool = False):
     """nn.DenseGeneral, or its int8-serving twin when
-    cfg.weight_quant == 'int8' — same module name either way, so the
-    param-tree paths line up and quantize_params is a leaf rewrite."""
+    cfg.weight_quant == 'int8', or the LoRA-adapted variant when
+    cfg.lora_rank > 0 targets this projection — same module name and
+    base-param paths in every case, so checkpoints/from_hf line up and
+    quantize_params stays a leaf rewrite."""
+    if cfg.lora_rank > 0 and name in lora_target_names(cfg):
+        if cfg.weight_quant == 'int8':
+            raise NotImplementedError(
+                'LoRA trains against float base weights; serve the '
+                'merged checkpoint with int8 instead '
+                '(models/lora.merge_lora then quantize)')
+        return LoRADenseGeneral(cfg, features=features,
+                                kernel_axes=tuple(kernel_axes),
+                                axis=axis, use_bias=use_bias, name=name)
     if cfg.weight_quant == 'int8':
         return QuantDenseGeneral(cfg, features=features,
                                  kernel_axes=tuple(kernel_axes),

@@ -28,6 +28,7 @@ LOGICAL_AXIS_RULES: List[Tuple[str, object]] = [
     ('kv_heads', 'tp'),
     ('qkv_dim', None),
     ('mlp', 'tp'),                  # MLP hidden under TP
+    ('lora_rank', None),            # LoRA adapter rank: tiny, replicated
     ('vocab', 'tp'),                # embedding/unembedding vocab dim
     ('expert', 'ep'),               # MoE experts under expert parallelism
     ('layers', 'pp'),               # stacked layer dim under pipeline

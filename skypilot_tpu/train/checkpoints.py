@@ -116,9 +116,22 @@ def restore_params_only(cfg, checkpoint_dir: str):
                 f'No checkpoint found in {checkpoint_dir!r}.')
         logger.info('Restoring params-only checkpoint step %d from %s',
                     step, checkpoint_dir)
+        # Explicit per-leaf RestoreArgs carrying THIS mesh's shardings:
+        # without them, orbax falls back to the shardings recorded at
+        # save time, which cannot be rebuilt when the restoring process
+        # has a different device count (trained on a v5p-32, restored
+        # on a v5e-8 replica — or 8 sim devices vs 1) and surface as
+        # `sharding ... Got None` deep in deserialization.
+        restore_args = jax.tree.map(
+            lambda s: ocp.ArrayRestoreArgs(sharding=s.sharding,
+                                           global_shape=s.shape,
+                                           dtype=s.dtype),
+            abstract)
         restored = manager.restore(
-            step, args=ocp.args.PyTreeRestore(item={'params': abstract},
-                                              partial_restore=True))
+            step, args=ocp.args.PyTreeRestore(
+                item={'params': abstract},
+                restore_args={'params': restore_args},
+                partial_restore=True))
     finally:
         manager.close()
     return restored['params']

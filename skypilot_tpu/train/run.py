@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import logging
 import math
+import os
 import sys
 
 logger = logging.getLogger(__name__)
@@ -43,6 +44,14 @@ def main(argv=None) -> int:
                         help='batches per validation pass')
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=100)
+    parser.add_argument('--lora-rank', type=int, default=0,
+                        help='LoRA fine-tune: adapter rank (0 = full '
+                             'fine-tune). Only lora_a/lora_b train; '
+                             'merge for serving with models/convert '
+                             'export (auto-merges) ')
+    parser.add_argument('--lora-alpha', type=float, default=16.0)
+    parser.add_argument('--lora-targets', default='q,v',
+                        help='comma list from {q,k,v,o,gate,up,down}')
     parser.add_argument('--init-from-hf', default=None,
                         help='local HuggingFace checkpoint dir to '
                         'initialize params from (models/convert.py); an '
@@ -102,7 +111,12 @@ def main(argv=None) -> int:
     logger.info('mesh: %s', mesh_cfg)
 
     # 3. Sharded state, restored if a checkpoint exists.
-    cfg = get_config(args.model, param_dtype='bfloat16')
+    cfg_overrides = {}
+    if args.lora_rank:
+        cfg_overrides.update(lora_rank=args.lora_rank,
+                             lora_alpha=args.lora_alpha,
+                             lora_targets=args.lora_targets)
+    cfg = get_config(args.model, param_dtype='bfloat16', **cfg_overrides)
     train_config = TrainConfig(learning_rate=args.learning_rate,
                                total_steps=args.steps)
     state, shardings = create_sharded_state(cfg, mesh,
@@ -115,6 +129,17 @@ def main(argv=None) -> int:
         manager = CheckpointManager(
             args.checkpoint_dir,
             save_interval_steps=args.checkpoint_every)
+        if cfg.lora_rank and jax.process_index() == 0:
+            # Sidecar so export/serving can't silently merge with the
+            # wrong alpha/targets (models/export_tool reads this).
+            import json
+            lora_meta = os.path.join(
+                os.path.expanduser(args.checkpoint_dir), 'lora.json')
+            os.makedirs(os.path.dirname(lora_meta), exist_ok=True)
+            with open(lora_meta, 'w', encoding='utf-8') as f:
+                json.dump({'lora_rank': cfg.lora_rank,
+                           'lora_alpha': cfg.lora_alpha,
+                           'lora_targets': cfg.lora_targets}, f)
         state, start_step = manager.maybe_restore(state)
     if args.init_from_hf and start_step == 0:
         # Fine-tune from a local HF checkpoint: convert on host, place
@@ -124,9 +149,18 @@ def main(argv=None) -> int:
         # checkpoint only to discard it is dead work.
         from skypilot_tpu.models.convert import load_hf_checkpoint
         hf_params = load_hf_checkpoint(args.init_from_hf, cfg)
-        placed = jax.tree.map(
-            lambda x, s: jax.device_put(x, s),
-            hf_params, shardings.params)
+        if cfg.lora_rank:
+            # HF supplies the frozen base; the fresh init keeps the
+            # adapters (lora_a/lora_b) the HF checkpoint can't have.
+            # overlay_place device_puts only the HF leaves — the placed
+            # adapter arrays stay put (multi-host safe: no device_get).
+            from skypilot_tpu.models.lora import overlay_place
+            placed = overlay_place(state.params, hf_params,
+                                   shardings.params)
+        else:
+            placed = jax.tree.map(
+                lambda x, s: jax.device_put(x, s),
+                hf_params, shardings.params)
         state = state.replace(params=placed)
         logger.info('initialized params from HF checkpoint %s',
                     args.init_from_hf)

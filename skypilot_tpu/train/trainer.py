@@ -40,17 +40,38 @@ class TrainConfig:
     b2: float = 0.95
 
 
-def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+def make_optimizer(tc: TrainConfig,
+                   lora_only: bool = False) -> optax.GradientTransformation:
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=tc.learning_rate,
         warmup_steps=tc.warmup_steps,
         decay_steps=max(tc.total_steps, tc.warmup_steps + 1),
         end_value=tc.learning_rate * 0.1)
-    return optax.chain(
+    base = optax.chain(
         optax.clip_by_global_norm(tc.grad_clip_norm),
         optax.adamw(schedule, b1=tc.b1, b2=tc.b2,
                     weight_decay=tc.weight_decay),
     )
+    if not lora_only:
+        return base
+
+    # LoRA: only the adapters (lora_a/lora_b leaves) update; every base
+    # weight is frozen with zero updates. The adamw moments then exist
+    # only for the (tiny) adapter leaves — the HBM point of LoRA.
+    def label_fn(params):
+        # Match ANY path element (not just the last): at init time the
+        # leaves sit inside flax LogicallyPartitioned boxes, so the path
+        # continues past the 'lora_a'/'lora_b' dict key — labels must
+        # come out identical for the boxed (init) and unboxed (update)
+        # trees or the masked inner states misalign.
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: 'train'
+            if any(getattr(k, 'key', None) in ('lora_a', 'lora_b')
+                   for k in path)
+            else 'freeze', params)
+
+    return optax.multi_transform(
+        {'train': base, 'freeze': optax.set_to_zero()}, label_fn)
 
 
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
@@ -85,7 +106,7 @@ def create_sharded_state(
     """
     tc = train_config or TrainConfig()
     model = Transformer(cfg)
-    tx = make_optimizer(tc)
+    tx = make_optimizer(tc, lora_only=cfg.lora_rank > 0)
     dummy = jnp.ones((1, min(cfg.max_seq_len, 128)), jnp.int32)
 
     def init_fn(rng_):

@@ -416,6 +416,35 @@ class TestExportTool:
         hf = transformers.AutoModelForCausalLM.from_pretrained(out)
         assert hf.config.vocab_size == 512
 
+    def test_restore_on_different_device_count(self, tmp_path):
+        """The serving story restore_params_only promises: a checkpoint
+        saved on an 8-device mesh must restore on a 1-device process.
+        Regression: orbax fell back to save-time shardings (unbuildable
+        at a different device count) unless explicit ArrayRestoreArgs
+        carry the restoring mesh's shardings."""
+        import os as os_lib
+        import subprocess
+        import sys as _sys
+        from skypilot_tpu.train import run as train_run
+        ckpt = str(tmp_path / 'ckpt')
+        rc = train_run.main([
+            '--model', 'test-tiny', '--batch', '8', '--seq', '32',
+            '--steps', '2', '--lora-rank', '4', '--checkpoint-dir',
+            ckpt, '--checkpoint-every', '1', '--log-every', '1'])
+        assert rc == 0
+        env = dict(os_lib.environ, JAX_PLATFORMS='cpu')
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        env['XLA_FLAGS'] = ''  # 1 device — unlike this 8-device process
+        out = str(tmp_path / 'hf')
+        proc = subprocess.run(
+            [_sys.executable, '-m', 'skypilot_tpu.models.export_tool',
+             '--model', 'test-tiny', '--lora-rank', '4',
+             '--checkpoint-dir', ckpt, '--out', out],
+            capture_output=True, text=True, timeout=420, env=env)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        hf = transformers.AutoModelForCausalLM.from_pretrained(out)
+        assert not any('lora' in k for k in hf.state_dict())
+
     def test_missing_checkpoint_fails(self, tmp_path):
         from skypilot_tpu.models import export_tool
         with pytest.raises(FileNotFoundError):

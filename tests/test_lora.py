@@ -1,0 +1,137 @@
+"""LoRA fine-tuning: adapter forward, frozen-base training, merge.
+
+Covers the reference's flagship fine-tune mode
+(llm/llama-3_1-finetuning/lora.yaml — torchtune LoRA there): adapters
+are exact no-ops at init, only lora_a/lora_b update under the masked
+optimizer, and merge_lora folds the trained adapters into a plain
+checkpoint whose logits match the adapted model exactly.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import Transformer, get_config
+from skypilot_tpu.models.lora import (has_lora, merge_lora,
+                                      overlay_base_params, _merge_one)
+from skypilot_tpu.models.transformer import lora_target_names
+from skypilot_tpu.parallel import build_mesh, infer_mesh_config
+from skypilot_tpu.train import (TrainConfig, create_sharded_state,
+                                make_train_step, synthetic_batch)
+
+LORA = dict(lora_rank=4, lora_alpha=8.0,
+            lora_targets='q,k,v,o,gate,up,down')
+
+
+def _cfg(**kw):
+    return get_config('test-tiny', dtype='float32',
+                      param_dtype='float32', **kw)
+
+
+def test_target_names_parse_and_validate():
+    assert lora_target_names(_cfg(lora_rank=4)) == ('q_proj', 'v_proj')
+    assert lora_target_names(_cfg(**LORA)) == (
+        'q_proj', 'k_proj', 'v_proj', 'o_proj', 'gate_proj', 'up_proj',
+        'down_proj')
+    with pytest.raises(ValueError, match='lora_targets token'):
+        lora_target_names(_cfg(lora_rank=4, lora_targets='q,attn'))
+    with pytest.raises(ValueError, match='empty'):
+        lora_target_names(_cfg(lora_rank=4, lora_targets=''))
+
+
+def test_adapter_is_identity_at_init():
+    """B = 0 init ⇒ the LoRA model's logits equal a plain model run
+    with the same base weights."""
+    cfg = _cfg(**LORA)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    params = Transformer(cfg).init(jax.random.PRNGKey(0), tokens)['params']
+    assert has_lora(params)
+    lora_logits = Transformer(cfg).apply({'params': params}, tokens)
+    merged = merge_lora(params, cfg)   # B=0 ⇒ merged == base weights
+    assert not has_lora(merged)
+    base_logits = Transformer(_cfg()).apply({'params': merged}, tokens)
+    np.testing.assert_allclose(np.asarray(lora_logits),
+                               np.asarray(base_logits), atol=1e-5)
+
+
+def _train(cfg, steps):
+    mesh = build_mesh(infer_mesh_config(8, fsdp=4, tp=2))
+    state, shardings = create_sharded_state(
+        cfg, mesh, jax.random.PRNGKey(0),
+        TrainConfig(learning_rate=1e-2, warmup_steps=1, total_steps=50))
+    step_fn = make_train_step(cfg, mesh, shardings)
+    batch = synthetic_batch(jax.random.PRNGKey(7), 8, 64, cfg.vocab_size)
+    params0 = jax.device_get(state.params)
+    with mesh:
+        losses = []
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch)
+            losses.append(float(metrics['loss']))
+    return params0, jax.device_get(state.params), losses
+
+
+def test_only_adapters_train_and_loss_decreases():
+    cfg = _cfg(**LORA)
+    params0, params1, losses = _train(cfg, 6)
+    assert losses[-1] < losses[0], losses
+
+    changed, frozen = [], []
+
+    def visit(path, a, b):
+        name = path[-1].key
+        (changed if not np.array_equal(a, b) else frozen).append(
+            (tuple(getattr(k, 'key', k) for k in path), name))
+
+    jax.tree_util.tree_map_with_path(
+        lambda p, a, b: visit(p, a, b), params0, params1)
+    changed_names = {name for _, name in changed}
+    # Every changed leaf is an adapter; every base weight is untouched.
+    assert changed_names <= {'lora_a', 'lora_b'}, changed_names
+    assert 'lora_b' in changed_names           # B moves first (grad ≠ 0)
+    assert any(name == 'kernel' for _, name in frozen)
+    assert any(name == 'embedding' for _, name in frozen)
+
+
+def test_merged_checkpoint_reproduces_adapted_logits():
+    cfg = _cfg(**LORA)
+    _, params1, _ = _train(cfg, 4)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0,
+                                cfg.vocab_size)
+    lora_logits = Transformer(cfg).apply({'params': params1}, tokens)
+    merged = merge_lora(params1, cfg)
+    plain_logits = Transformer(_cfg()).apply({'params': merged}, tokens)
+    np.testing.assert_allclose(np.asarray(lora_logits),
+                               np.asarray(plain_logits),
+                               atol=2e-4, rtol=1e-4)
+
+
+def test_merge_one_flat_layout():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((8, 3)).astype(np.float32)
+    b = rng.standard_normal((3, 5)).astype(np.float32)
+    k = rng.standard_normal((8, 5)).astype(np.float32)
+    out = np.asarray(_merge_one(jnp.asarray(k), jnp.asarray(a),
+                                jnp.asarray(b), 2.0))
+    np.testing.assert_allclose(out, k + 2.0 * (a @ b), rtol=1e-5)
+
+
+def test_to_hf_refuses_unmerged_lora_tree():
+    from skypilot_tpu.models.convert import to_hf
+    cfg = _cfg(**LORA)
+    tokens = jnp.ones((1, 8), jnp.int32)
+    params = Transformer(cfg).init(jax.random.PRNGKey(0), tokens)['params']
+    with pytest.raises(ValueError, match='lora'):
+        to_hf(params, _cfg())          # plain cfg + lora tree = refuse
+    sd = to_hf(params, cfg)            # lora cfg auto-merges
+    assert not any('lora' in k for k in sd)
+
+
+def test_overlay_base_params_keeps_adapters():
+    full = {'layers': {'q_proj': {'kernel': np.zeros(2),
+                                  'lora_a': np.ones(2),
+                                  'lora_b': np.zeros(2)}}}
+    base = {'layers': {'q_proj': {'kernel': np.full(2, 7.0)}}}
+    out = overlay_base_params(full, base)
+    assert (out['layers']['q_proj']['kernel'] == 7.0).all()
+    assert (out['layers']['q_proj']['lora_a'] == 1.0).all()
