@@ -28,14 +28,16 @@ def main(argv=None) -> int:
                              'float32 (default) is lossless; bfloat16 '
                              'halves the export at the cost of '
                              'truncating the fp32 masters.')
-    parser.add_argument('--lora-rank', type=int, default=0,
+    parser.add_argument('--lora-rank', type=int, default=None,
                         help='set to the training run\'s --lora-rank '
                              'when exporting a LoRA checkpoint: the '
                              'restore needs the adapter structure, and '
                              'the export folds the adapters into the '
-                             'base weights (to_hf auto-merges)')
-    parser.add_argument('--lora-alpha', type=float, default=16.0)
-    parser.add_argument('--lora-targets', default='q,v')
+                             'base weights (to_hf auto-merges). '
+                             'Normally unnecessary: the run\'s '
+                             'lora.json sidecar is read automatically')
+    parser.add_argument('--lora-alpha', type=float, default=None)
+    parser.add_argument('--lora-targets', default=None)
     args = parser.parse_args(argv)
 
     import jax
@@ -52,25 +54,32 @@ def main(argv=None) -> int:
     import json
     import os
     overrides = {}
+    flags = {'lora_rank': args.lora_rank, 'lora_alpha': args.lora_alpha,
+             'lora_targets': args.lora_targets}
+    passed = {k: v for k, v in flags.items() if v is not None}
     sidecar_path = os.path.join(
         os.path.expanduser(args.checkpoint_dir), 'lora.json')
     if os.path.exists(sidecar_path):
         with open(sidecar_path, encoding='utf-8') as f:
             sidecar = json.load(f)
-        if args.lora_rank and (
-                args.lora_rank != sidecar['lora_rank']
-                or args.lora_alpha != sidecar['lora_alpha']
-                or args.lora_targets != sidecar['lora_targets']):
-            print(f'error: --lora-* flags disagree with the training '
+        # ANY explicitly-passed lora flag must agree with the sidecar —
+        # a mismatched alpha/targets would silently mis-merge.
+        conflict = {k: v for k, v in passed.items() if sidecar[k] != v}
+        if conflict:
+            print(f'error: {conflict} disagrees with the training '
                   f'run\'s {sidecar_path}: {sidecar}', file=sys.stderr)
             return 1
         overrides.update(sidecar)
         print(f'LoRA checkpoint ({sidecar}): adapters will be merged '
               f'into the base weights', file=sys.stderr)
-    elif args.lora_rank:
-        overrides.update(lora_rank=args.lora_rank,
-                         lora_alpha=args.lora_alpha,
-                         lora_targets=args.lora_targets)
+    elif passed.get('lora_rank'):
+        overrides.update(lora_rank=passed['lora_rank'],
+                         lora_alpha=passed.get('lora_alpha', 16.0),
+                         lora_targets=passed.get('lora_targets', 'q,v'))
+    elif passed:
+        print('error: --lora-alpha/--lora-targets need --lora-rank '
+              '(no lora.json sidecar found)', file=sys.stderr)
+        return 1
     cfg = get_config(args.model, param_dtype=args.dtype, **overrides)
     params = load_params_from_checkpoint(cfg, args.checkpoint_dir)
     host_params = jax.tree.map(jax.device_get, params)

@@ -682,8 +682,28 @@ def load_params_from_checkpoint(cfg: ModelConfig,
     """Restore trained params from an Orbax checkpoint written by
     train/run.py. Params-only partial restore: the fp32 AdamW moments
     (~5x the bf16 param bytes) never materialize — the difference
-    between a serving replica that fits and one that OOMs for 8B+."""
+    between a serving replica that fits and one that OOMs for 8B+.
+
+    LoRA checkpoints (train runs with --lora-rank write a lora.json
+    sidecar) restore with the adapter structure recorded there and are
+    merged on-device into plain base weights — `serve.server
+    --checkpoint-dir <lora run>` just works, no HF export detour."""
+    import dataclasses as _dc
+    import json as _json
+    import os as _os
+
     from skypilot_tpu.train.checkpoints import restore_params_only
+    sidecar = _os.path.join(_os.path.expanduser(checkpoint_dir),
+                            'lora.json')
+    if _os.path.exists(sidecar):
+        with open(sidecar, encoding='utf-8') as f:
+            meta = _json.load(f)
+        from skypilot_tpu.models.lora import merge_lora
+        lora_cfg = _dc.replace(cfg, **meta)
+        logger.info('LoRA checkpoint (%s): merging adapters into base '
+                    'weights for serving', meta)
+        return merge_lora(restore_params_only(lora_cfg, checkpoint_dir),
+                          lora_cfg)
     return restore_params_only(cfg, checkpoint_dir)
 
 

@@ -114,6 +114,31 @@ def restore_params_only(cfg, checkpoint_dir: str):
         if step is None:
             raise FileNotFoundError(
                 f'No checkpoint found in {checkpoint_dir!r}.')
+        if getattr(cfg, 'lora_rank', 0) == 0:
+            # partial_restore silently SKIPS leaves the target tree
+            # doesn't ask for — restoring a LoRA checkpoint with a
+            # plain config would drop the adapters and hand back the
+            # untuned base weights with no error. The orbax _METADATA
+            # records every saved key; refuse if adapters are present
+            # but unrequested (covers checkpoints whose lora.json
+            # sidecar was lost in a copy that took only step dirs).
+            meta_path = os_lib.path.join(
+                os_lib.path.abspath(
+                    os_lib.path.expanduser(checkpoint_dir)),
+                str(step), 'default', '_METADATA')
+            try:
+                with open(meta_path, encoding='utf-8') as f:
+                    saved_keys = f.read()
+            except OSError:
+                saved_keys = ''
+            if "'lora_a'" in saved_keys or '"lora_a"' in saved_keys:
+                raise ValueError(
+                    f'checkpoint {checkpoint_dir!r} step {step} contains '
+                    f'LoRA adapters but the config has lora_rank=0 — '
+                    f'restoring would silently drop the fine-tune. Pass '
+                    f'the training run\'s lora_rank/alpha/targets (or '
+                    f'restore the lora.json sidecar next to the step '
+                    f'dirs).')
         logger.info('Restoring params-only checkpoint step %d from %s',
                     step, checkpoint_dir)
         # Explicit per-leaf RestoreArgs carrying THIS mesh's shardings:

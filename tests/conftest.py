@@ -55,6 +55,34 @@ def _isolate_state(tmp_path, monkeypatch):
     import skypilot_tpu.global_user_state as gus
     gus._db = None  # pylint: disable=protected-access
     yield
+    _reap_test_processes(str(tmp_path))
+
+
+def _reap_test_processes(marker: str) -> None:
+    """Kill any process whose environment carries this test's isolated
+    state dir. A serve/jobs e2e that fails mid-flight can leave its
+    `serve down` teardown half-run (observed under full-suite load:
+    orphaned replica `http.server`s squatting on ports, cascading
+    'Address already in use' into every later serve test). The tmp_path
+    is unique per test, so matching SKYTPU_HOME/... in /proc environs
+    reaps exactly this test's children."""
+    import signal
+    if not os.path.isdir('/proc'):   # non-Linux dev host: nothing to reap
+        return
+    me = os.getpid()
+    for pid_dir in os.listdir('/proc'):
+        if not pid_dir.isdigit() or int(pid_dir) == me:
+            continue
+        try:
+            with open(f'/proc/{pid_dir}/environ', 'rb') as f:
+                environ = f.read().decode(errors='replace')
+        except OSError:
+            continue
+        if marker in environ:
+            try:
+                os.kill(int(pid_dir), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
 
 
 @pytest.fixture

@@ -127,6 +127,77 @@ def test_to_hf_refuses_unmerged_lora_tree():
     assert not any('lora' in k for k in sd)
 
 
+def test_serving_load_merges_lora_checkpoint(tmp_path):
+    """serve --checkpoint-dir on a LoRA training run: the lora.json
+    sidecar routes the restore through the adapter structure and the
+    load returns merged plain weights — logits must equal the adapted
+    model's."""
+    from skypilot_tpu.models.inference import load_params_from_checkpoint
+    from skypilot_tpu.train import run as train_run
+    ckpt = str(tmp_path / 'ckpt')
+    rc = train_run.main([
+        '--model', 'test-tiny', '--batch', '8', '--seq', '32',
+        '--steps', '2', '--lora-rank', '4', '--lora-targets', 'q,o',
+        '--lora-alpha', '8', '--checkpoint-dir', ckpt,
+        '--checkpoint-every', '1', '--log-every', '1'])
+    assert rc == 0
+    import os
+    assert os.path.exists(os.path.join(ckpt, 'lora.json'))
+    plain_cfg = get_config('test-tiny')
+    merged = load_params_from_checkpoint(plain_cfg, ckpt)
+    assert not has_lora(merged)
+    lora_cfg = get_config('test-tiny', lora_rank=4, lora_targets='q,o',
+                          lora_alpha=8.0)
+    from skypilot_tpu.train.checkpoints import restore_params_only
+    raw = restore_params_only(lora_cfg, ckpt)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, 8), 0,
+                                plain_cfg.vocab_size)
+    want = Transformer(lora_cfg).apply({'params': raw}, tokens)
+    got = Transformer(plain_cfg).apply({'params': merged}, tokens)
+    # bf16 checkpoint: the merged kernel rounds W+(α/r)BA to bf16 once,
+    # while the adapted path computes the two terms separately — logit
+    # deltas up to a few bf16 ulps (~0.016 at |x|≈2) are expected.
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=7e-2, rtol=2e-2)
+
+
+def test_lost_sidecar_cannot_silently_drop_adapters(tmp_path):
+    """If lora.json is lost (step-dirs-only copy), restoring with a
+    plain config must REFUSE, not silently serve untuned base weights
+    (partial restore would skip the adapter leaves)."""
+    import os
+    from skypilot_tpu.models.inference import load_params_from_checkpoint
+    from skypilot_tpu.train import run as train_run
+    ckpt = str(tmp_path / 'ckpt')
+    rc = train_run.main([
+        '--model', 'test-tiny', '--batch', '8', '--seq', '32',
+        '--steps', '2', '--lora-rank', '4', '--checkpoint-dir', ckpt,
+        '--checkpoint-every', '1', '--log-every', '1'])
+    assert rc == 0
+    os.remove(os.path.join(ckpt, 'lora.json'))
+    with pytest.raises(ValueError, match='LoRA adapters'):
+        load_params_from_checkpoint(get_config('test-tiny'), ckpt)
+
+
+def test_export_tool_rejects_conflicting_lora_flags(tmp_path, capsys):
+    """An explicit --lora-alpha that disagrees with the run's lora.json
+    must error, not silently use the sidecar value."""
+    import json
+    import os
+    from skypilot_tpu.models import export_tool
+    ckpt = tmp_path / 'ckpt'
+    ckpt.mkdir()
+    with open(os.path.join(ckpt, 'lora.json'), 'w') as f:
+        json.dump({'lora_rank': 4, 'lora_alpha': 16.0,
+                   'lora_targets': 'q,v'}, f)
+    rc = export_tool.main(['--model', 'test-tiny', '--lora-alpha', '32',
+                           '--checkpoint-dir', str(ckpt),
+                           '--out', str(tmp_path / 'hf')])
+    assert rc == 1
+    assert 'disagrees' in capsys.readouterr().err
+
+
 def test_overlay_base_params_keeps_adapters():
     full = {'layers': {'q_proj': {'kernel': np.zeros(2),
                                   'lora_a': np.ones(2),
