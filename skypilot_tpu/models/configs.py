@@ -51,6 +51,11 @@ class ModelConfig:
     # it explicitly rather than silently dropping the cap.
     attn_logit_softcap: float = 0.0
     final_logit_softcap: float = 0.0
+    # Falcon-style parallel block: ONE shared pre-norm feeds attention
+    # and MLP, whose outputs add into the residual together
+    # (x + attn(ln(x)) + mlp(ln(x))) — vs the sequential default. Pairs
+    # with MQA (num_kv_heads=1) and 'layernorm' in the Falcon family.
+    parallel_block: bool = False
     # Mistral-style uniform sliding window, in keys (0 ⇒ full causal).
     # The pallas kernels skip blocks outside the window, so long-sequence
     # attention compute drops from O(S²) to O(S·window).
@@ -149,7 +154,8 @@ class ModelConfig:
             mlp += (mlp_mats - 1) * self.d_mlp + self.d_model
         norm_params = (2 if self.norm_style == 'layernorm' else 1) * \
             self.d_model
-        norms = 2 * norm_params
+        # Parallel-block layers (Falcon) share ONE pre-norm for attn+mlp.
+        norms = (1 if self.parallel_block else 2) * norm_params
         per_layer = attn + mlp + router + norms
         return embed + self.num_layers * per_layer + norm_params
 
@@ -304,6 +310,18 @@ QWEN2_72B = _register(ModelConfig(
 # learned positions, plain GELU MLP, biases, tied unembed. Vocab padded
 # 50257 → 50304 (×128) so the unembed matmul tiles the MXU cleanly, the
 # same padding llm.c applies.
+# --- Falcon family (reference recipe: llm/falcon). Parallel block
+# (shared LayerNorm feeds attn AND mlp, both add into the residual),
+# multi-query attention (1 KV head — the original MQA paper's serving
+# win: the KV cache is num_heads× smaller), plain GELU MLP, tied
+# embeddings, rope 10k. falcon-7b is the multi_query=True pre-GQA
+# architecture (new_decoder_architecture=False in HF terms).
+FALCON_7B = _register(ModelConfig(
+    name='falcon-7b', vocab_size=65024, d_model=4544, num_layers=32,
+    num_heads=71, num_kv_heads=1, d_mlp=18176, max_seq_len=2048,
+    rope_theta=10000.0, norm_style='layernorm', mlp_style='plain',
+    mlp_activation='gelu', tie_embeddings=True, parallel_block=True))
+
 GPT2_124M = _register(ModelConfig(
     name='gpt2-124m', vocab_size=50304, d_model=768, num_layers=12,
     num_heads=12, num_kv_heads=12, d_mlp=3072, max_seq_len=1024,

@@ -86,7 +86,9 @@ def from_hf(state_dict: Mapping[str, Any],
                                   'use scan_layers=True')
     sd = _TrackedDict({k: _np(v) for k, v in state_dict.items()})
     gpt2 = cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain'
-    if gpt2:
+    if cfg.parallel_block:
+        params, layer = _falcon_top(sd, cfg), _falcon_layer
+    elif gpt2:
         params, layer = _gpt2_top(sd, cfg), _gpt2_layer
     else:
         params, layer = _llama_top(sd, cfg), _llama_layer
@@ -170,6 +172,32 @@ def to_hf(params: Mapping[str, Any],
     layers = p['layers']['layer']
     gpt2 = cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain'
     sd: Dict[str, np.ndarray] = {}
+    if cfg.parallel_block:
+        d, nh, hd = cfg.d_model, cfg.num_heads, cfg.head_dim
+        sd['transformer.word_embeddings.weight'] = p['embed']['embedding']
+        sd['transformer.ln_f.weight'] = p['final_norm']['scale']
+        sd['transformer.ln_f.bias'] = p['final_norm']['bias']
+        sd['lm_head.weight'] = (p['embed']['embedding']
+                                if cfg.tie_embeddings
+                                else p['lm_head']['kernel'].T)
+        for i in range(cfg.num_layers):
+            li = jax_tree_index(layers, i)
+            pre = f'transformer.h.{i}.'
+            attn = li['attn']
+            fused = np.concatenate([
+                attn['q_proj']['kernel'].reshape(d, nh * hd),
+                attn['k_proj']['kernel'].reshape(d, hd),
+                attn['v_proj']['kernel'].reshape(d, hd)], axis=1)
+            sd[pre + 'self_attention.query_key_value.weight'] = fused.T
+            sd[pre + 'self_attention.dense.weight'] = \
+                attn['o_proj']['kernel'].reshape(nh * hd, d).T
+            sd[pre + 'input_layernorm.weight'] = li['attn_norm']['scale']
+            sd[pre + 'input_layernorm.bias'] = li['attn_norm']['bias']
+            sd[pre + 'mlp.dense_h_to_4h.weight'] = \
+                li['mlp']['up_proj']['kernel'].T
+            sd[pre + 'mlp.dense_4h_to_h.weight'] = \
+                li['mlp']['down_proj']['kernel'].T
+        return sd
     if gpt2:
         sd['transformer.wte.weight'] = p['embed']['embedding']
         sd['transformer.wpe.weight'] = p['pos_embed']['embedding']
@@ -266,6 +294,22 @@ def hf_config_for(cfg: ModelConfig):
             'softcapped (Gemma-2-style) configs have no faithful HF '
             'export: this architecture omits Gemma-2 post-norms, so '
             'neither GemmaConfig nor Gemma2Config reproduces it')
+    if cfg.parallel_block:
+        if cfg.num_kv_heads != 1:
+            raise NotImplementedError(
+                'parallel_block HF export supports the multi_query '
+                'layout only (num_kv_heads=1, falcon-7b)')
+        return transformers.FalconConfig(
+            vocab_size=hf_vocab, hidden_size=cfg.d_model,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_heads,
+            ffn_hidden_size=cfg.d_mlp,
+            max_position_embeddings=cfg.max_seq_len,
+            rope_theta=cfg.rope_theta,
+            layer_norm_epsilon=cfg.norm_eps,
+            multi_query=True, parallel_attn=True, bias=False,
+            alibi=False, new_decoder_architecture=False,
+            tie_word_embeddings=cfg.tie_embeddings)
     if cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain':
         return transformers.GPT2Config(
             vocab_size=hf_vocab, n_embd=cfg.d_model,
@@ -376,6 +420,47 @@ def _llama_layer(sd, cfg: ModelConfig, i: int) -> Dict[str, Any]:
             'down_proj': {'kernel': sd[p + 'mlp.down_proj.weight'].T},
         }
     return layer
+
+
+# ---------------- Falcon (parallel block + MQA) ----------------------
+
+
+def _falcon_top(sd, cfg: ModelConfig) -> Dict[str, Any]:
+    return {
+        'embed': {'embedding': _pad_vocab(
+            sd['transformer.word_embeddings.weight'], cfg.vocab_size)},
+        'final_norm': {'scale': sd['transformer.ln_f.weight'],
+                       'bias': sd['transformer.ln_f.bias']},
+    }
+
+
+def _falcon_layer(sd, cfg: ModelConfig, i: int) -> Dict[str, Any]:
+    if cfg.num_kv_heads != 1:
+        raise NotImplementedError(
+            'Falcon conversion supports the multi_query layout '
+            '(num_kv_heads=1, falcon-7b); the 40B '
+            'new_decoder_architecture interleaves KV per head group')
+    p = f'transformer.h.{i}.'
+    d, nh, hd = cfg.d_model, cfg.num_heads, cfg.head_dim
+    # Fused QKV, multi_query layout: rows = [q·(nh·hd), k·hd, v·hd].
+    w = sd[p + 'self_attention.query_key_value.weight'].T  # (d, out)
+    q, k, v = np.split(w, [nh * hd, nh * hd + hd], axis=1)
+    return {
+        'attn_norm': {'scale': sd[p + 'input_layernorm.weight'],
+                      'bias': sd[p + 'input_layernorm.bias']},
+        'attn': {
+            'q_proj': {'kernel': q.reshape(d, nh, hd)},
+            'k_proj': {'kernel': k.reshape(d, 1, hd)},
+            'v_proj': {'kernel': v.reshape(d, 1, hd)},
+            'o_proj': {'kernel':
+                       sd[p + 'self_attention.dense.weight'].T.reshape(
+                           nh, hd, d)},
+        },
+        'mlp': {
+            'up_proj': {'kernel': sd[p + 'mlp.dense_h_to_4h.weight'].T},
+            'down_proj': {'kernel': sd[p + 'mlp.dense_4h_to_h.weight'].T},
+        },
+    }
 
 
 # ---------------- GPT-2 ----------------------------------------------

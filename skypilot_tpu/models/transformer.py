@@ -473,6 +473,17 @@ class DecoderLayer(nn.Module):
                  positions: jax.Array) -> jax.Array:
         cfg = self.cfg
         h = RMSNorm(cfg, name='attn_norm')(x)
+        if cfg.parallel_block:
+            if cfg.is_moe:
+                raise NotImplementedError(
+                    'parallel_block + MoE is not modeled (no family '
+                    'uses it); use the sequential block for MoE')
+            # Falcon: ONE shared pre-norm; attention and MLP read the
+            # same normed input and their outputs sum into the residual
+            # in a single step — the two matmul chains are independent,
+            # so XLA overlaps them freely.
+            return (x + Attention(cfg, name='attn')(h, positions)
+                    + SwiGLU(cfg, name='mlp')(h))
         x = x + Attention(cfg, name='attn')(h, positions)
         h = RMSNorm(cfg, name='mlp_norm')(x)
         if cfg.is_moe:

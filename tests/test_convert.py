@@ -141,6 +141,49 @@ class TestLlamaFamily:
         _logit_parity(model, cfg)
 
 
+class TestFalcon:
+
+    def test_falcon_parallel_block_mqa_logits_match(self):
+        """Falcon-7b architecture: parallel block (shared LayerNorm,
+        attn+mlp both add into the residual), MQA (1 KV head), fused
+        QKV split, plain GELU MLP, tied embeddings."""
+        hf_cfg = transformers.FalconConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, ffn_hidden_size=128,
+            max_position_embeddings=64, rope_theta=10000.0,
+            layer_norm_epsilon=1e-6, multi_query=True,
+            parallel_attn=True, bias=False, alibi=False,
+            new_decoder_architecture=False, tie_word_embeddings=True,
+            attn_implementation='eager')
+        model = transformers.FalconForCausalLM(hf_cfg)
+        cfg = _base_cfg(num_kv_heads=1, mlp_style='plain',
+                        mlp_activation='gelu', norm_style='layernorm',
+                        tie_embeddings=True, parallel_block=True)
+        _logit_parity(model, cfg)
+
+    def test_falcon_round_trip(self):
+        hf_cfg = transformers.FalconConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, ffn_hidden_size=128,
+            max_position_embeddings=64, layer_norm_epsilon=1e-6,
+            multi_query=True, parallel_attn=True, bias=False,
+            alibi=False, new_decoder_architecture=False,
+            tie_word_embeddings=True, attn_implementation='eager')
+        model = transformers.FalconForCausalLM(hf_cfg)
+        cfg = _base_cfg(num_kv_heads=1, mlp_style='plain',
+                        mlp_activation='gelu', norm_style='layernorm',
+                        tie_embeddings=True, parallel_block=True)
+        params = load_hf_model(model, cfg)
+        from skypilot_tpu.models.convert import to_hf
+        sd = to_hf(params, cfg)
+        want = {k: v.numpy() for k, v in model.state_dict().items()
+                if 'inv_freq' not in k}
+        assert set(sd) == set(want), set(sd) ^ set(want)
+        for k in want:
+            np.testing.assert_allclose(sd[k], want[k], atol=1e-6,
+                                       err_msg=k)
+
+
 class TestGPT2:
 
     def test_gpt2_logits_match(self):

@@ -184,7 +184,8 @@ class TestFamilyDecodeParity:
     every family (it shares the same Attention module, but biases,
     learned positions and softcaps all touch the decode branch)."""
 
-    @pytest.mark.parametrize('family', ['gemma', 'gemma2', 'gpt2', 'qwen'])
+    @pytest.mark.parametrize('family', ['gemma', 'gemma2', 'gpt2', 'qwen',
+                                        'falcon'])
     def test_prefill_then_decode_matches_full(self, family):
         cfg = {
             'gemma': _gemma_tiny(),
@@ -193,6 +194,13 @@ class TestFamilyDecodeParity:
                                   attention_impl='xla'),
             'gpt2': _gpt2_tiny(),
             'qwen': _tiny(qkv_bias=True),
+            # Falcon: parallel block + MQA (1 KV head) + LayerNorm +
+            # tied embeddings — the smallest KV cache the decode path
+            # ever sees.
+            'falcon': _tiny(num_kv_heads=1, mlp_style='plain',
+                            mlp_activation='gelu',
+                            norm_style='layernorm', tie_embeddings=True,
+                            parallel_block=True),
         }[family]
         engine = InferenceEngine(cfg, batch_size=1)
         tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 10), 0,
@@ -228,6 +236,7 @@ class TestRegistry:
         ('llama2-13b', 1.25e10, 1.35e10),
         ('llama2-70b', 6.6e10, 7.1e10),
         ('codellama-7b', 6.5e9, 7.0e9),
+        ('falcon-7b', 6.6e9, 7.5e9),
     ])
     def test_param_counts_in_published_range(self, name, lo, hi):
         assert lo <= get_config(name).num_params() <= hi
