@@ -173,6 +173,13 @@ def to_hf(params: Mapping[str, Any],
     gpt2 = cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain'
     sd: Dict[str, np.ndarray] = {}
     if cfg.parallel_block:
+        if (cfg.num_kv_heads != 1 or cfg.mlp_style != 'plain'
+                or cfg.qkv_bias or cfg.o_bias or cfg.mlp_bias):
+            raise NotImplementedError(
+                'parallel_block export maps the falcon-7b layout only '
+                '(MQA, plain bias-free MLP) — a composed config would '
+                'silently drop weights (gate_proj/biases) the Falcon '
+                'HF architecture has no keys for')
         d, nh, hd = cfg.d_model, cfg.num_heads, cfg.head_dim
         sd['transformer.word_embeddings.weight'] = p['embed']['embedding']
         sd['transformer.ln_f.weight'] = p['final_norm']['scale']

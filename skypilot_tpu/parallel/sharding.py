@@ -62,7 +62,13 @@ def constrain(x: jax.Array, *logical_axes: Optional[str]) -> jax.Array:
     """with_sharding_constraint by logical names; no-op outside a mesh."""
     try:
         return jax.lax.with_sharding_constraint(x, spec_for(*logical_axes))
-    except (ValueError, RuntimeError):
+    except (ValueError, RuntimeError) as e:
+        if 'divisible' in str(e):
+            # A REAL layout error (dim smaller than / not divisible by
+            # its mesh axis) must surface — swallowing it silently drops
+            # the constraint and lets GSPMD pick any layout (observed:
+            # grad-accum microbatches smaller than the dp extent).
+            raise
         # Not under a mesh context (e.g. pure single-device eval).
         return x
 

@@ -44,6 +44,12 @@ def main(argv=None) -> int:
                         help='batches per validation pass')
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=100)
+    parser.add_argument('--grad-accum', type=int, default=1,
+                        help='accumulate grads over N sequential '
+                             'microbatches per optimizer step: the '
+                             'effective batch is --batch, activation '
+                             'memory is --batch/N — global batches '
+                             'beyond slice HBM')
     parser.add_argument('--lora-rank', type=int, default=0,
                         help='LoRA fine-tune: adapter rank (0 = full '
                              'fine-tune). Only lora_a/lora_b train; '
@@ -177,27 +183,45 @@ def main(argv=None) -> int:
         raise SystemExit('--pipeline-repeats requires a pp>1 mesh '
                          '(pass --pp); with pp=1 the sequential step '
                          'would silently ignore it')
-    if microbatches and args.batch % microbatches:
+    if args.grad_accum < 1:
+        raise SystemExit('--grad-accum must be >= 1')
+    if args.grad_accum > 1 and args.batch % args.grad_accum:
         raise SystemExit(f'--batch {args.batch} must be divisible by '
-                         f'--microbatches {microbatches}')
+                         f'--grad-accum {args.grad_accum}')
+    # Everything downstream of accumulation sees ONE slice of the
+    # batch: pipeline microbatching and the dp/fsdp batch sharding
+    # both divide batch/grad_accum, not the full batch.
+    per_step_batch = args.batch // args.grad_accum
+    batch_extent = mesh_cfg.dp * mesh_cfg.fsdp
+    if per_step_batch % batch_extent:
+        raise SystemExit(
+            f'per-accumulation batch {per_step_batch} '
+            f'(--batch {args.batch} / --grad-accum {args.grad_accum}) '
+            f'must be divisible by dp*fsdp = {batch_extent}')
+    if microbatches and per_step_batch % microbatches:
+        raise SystemExit(f'per-accumulation batch {per_step_batch} must '
+                         f'be divisible by --microbatches {microbatches}')
     if mesh_cfg.pp > 1 and microbatches is None:
         # Target 4 per stage ((S-1)/(M+S-1) bubble ≈ 1/5), clamped to
         # the largest divisor of the batch ≥ pp — fail fast here, not
         # after state init, if even pp microbatches can't divide it.
         want = 4 * mesh_cfg.pp
-        microbatches = next((m for m in range(min(want, args.batch),
-                                              mesh_cfg.pp - 1, -1)
-                             if args.batch % m == 0), None)
+        microbatches = next(
+            (m for m in range(min(want, per_step_batch),
+                              mesh_cfg.pp - 1, -1)
+             if per_step_batch % m == 0), None)
         if microbatches is None:
             raise SystemExit(
-                f'--batch {args.batch} has no divisor >= pp='
-                f'{mesh_cfg.pp} to use as a microbatch count; raise '
-                f'--batch or pass --microbatches explicitly')
+                f'per-accumulation batch {per_step_batch} has no '
+                f'divisor >= pp={mesh_cfg.pp} to use as a microbatch '
+                f'count; raise --batch or pass --microbatches '
+                f'explicitly')
         logger.info('pipeline: pp=%d, defaulting to %d microbatches',
                     mesh_cfg.pp, microbatches)
     step_fn = make_train_step(cfg, mesh, shardings,
                               microbatches=microbatches,
-                              pipeline_repeats=args.pipeline_repeats)
+                              pipeline_repeats=args.pipeline_repeats,
+                              grad_accum=args.grad_accum)
     callbacks.init(total_steps=args.steps)
     dataset = None
     if args.data_dir and args.sft_data:

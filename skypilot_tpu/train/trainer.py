@@ -130,11 +130,21 @@ def make_train_step(
     state_shardings: Any,
     microbatches: Optional[int] = None,
     pipeline_repeats: int = 1,
+    grad_accum: int = 1,
 ) -> Callable[[TrainState, Dict[str, jax.Array]],
               Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build the jitted train step: loss → grad → clip → adamw update.
 
     Donates the state so params/moments update in place (HBM win).
+
+    `grad_accum` A>1 splits the batch's leading dim into A sequential
+    microbatches inside the jitted step (lax.scan): grads accumulate in
+    fp32 and ONE optimizer update applies — activation memory stays one
+    microbatch's while the effective batch is the full one. Exactly
+    equal to the single-shot step for unmasked LM batches; with SFT
+    masks the per-microbatch means are weighted equally (the standard
+    accumulation semantics) rather than by token count. Composes with
+    the pipeline schedule (accumulation wraps the pipelined forward).
 
     `microbatches` (with a pp>1 mesh) switches the forward to the
     microbatched SPMD pipeline schedule (parallel/pipeline.py): embed →
@@ -184,11 +194,61 @@ def make_train_step(
                                   batch.get('mask'))
 
     def step(state: TrainState, batch):
-        batch = {
-            k: sharding_lib.constrain(v, 'batch', 'seq')
-            for k, v in batch.items()
-        }
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        if grad_accum <= 1:
+            batch = {
+                k: sharding_lib.constrain(v, 'batch', 'seq')
+                for k, v in batch.items()
+            }
+            loss, grads = jax.value_and_grad(loss_fn)(state.params,
+                                                      batch)
+        else:
+            # Gradient accumulation: lax.scan over A microbatches —
+            # activation memory is ONE microbatch's, so the effective
+            # global batch scales past slice HBM. Accumulate in fp32
+            # (bf16 running sums lose low bits across many micro
+            # steps), then average and cast back so the optimizer sees
+            # the dtype the single-shot path produces.
+            rows = batch['inputs'].shape[0]
+            extent = 1
+            if hasattr(mesh, 'shape'):
+                extent = (mesh.shape.get('dp', 1) *
+                          mesh.shape.get('fsdp', 1))
+            if rows % grad_accum:
+                raise ValueError(f'batch {rows} not divisible by '
+                                 f'grad_accum={grad_accum}')
+            if (rows // grad_accum) % extent:
+                # GSPMD would PAD the uneven microbatch over the batch
+                # axes (involuntary rematerialization, silent dp loss)
+                # rather than erroring — refuse with a usable message.
+                raise ValueError(
+                    f'per-accumulation batch {rows // grad_accum} '
+                    f'(batch {rows} / grad_accum {grad_accum}) must be '
+                    f'divisible by dp*fsdp = {extent}')
+            micro = {
+                k: v.reshape((grad_accum, v.shape[0] // grad_accum)
+                             + v.shape[1:])
+                for k, v in batch.items()
+            }
+
+            def acc(carry, mb):
+                mb = {k: sharding_lib.constrain(v, 'batch', 'seq')
+                      for k, v in mb.items()}
+                loss_i, grads_i = jax.value_and_grad(loss_fn)(
+                    state.params, mb)
+                acc_loss, acc_grads = carry
+                acc_grads = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32),
+                    acc_grads, grads_i)
+                return (acc_loss + loss_i, acc_grads), None
+
+            zero = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (loss, grads), _ = jax.lax.scan(acc, (jnp.float32(0.0), zero),
+                                            micro)
+            loss = loss / grad_accum
+            grads = jax.tree.map(
+                lambda g, p: (g / grad_accum).astype(p.dtype),
+                grads, state.params)
         new_state = state.apply_gradients(grads=grads)
         metrics = {
             'loss': loss,

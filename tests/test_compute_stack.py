@@ -180,6 +180,67 @@ def test_sharded_train_step_loss_decreases(mesh_axes):
     assert np.isfinite(losses).all()
 
 
+def test_grad_accum_matches_single_shot():
+    """grad_accum=A must produce the SAME update as one full-batch
+    step (unmasked LM batch, fp32): same loss, same params after the
+    optimizer update."""
+    cfg = get_config('test-tiny', dtype='float32', param_dtype='float32')
+    mesh = build_mesh(infer_mesh_config(8, dp=4, tp=2))
+    tc = TrainConfig(learning_rate=1e-2, warmup_steps=1, total_steps=50)
+    batch = synthetic_batch(jax.random.PRNGKey(7), 8, 64, cfg.vocab_size)
+
+    results = {}
+    for accum in (1, 2):   # batch 8 / accum 2 = 4 rows = the dp extent
+        state, shardings = create_sharded_state(
+            cfg, mesh, jax.random.PRNGKey(0), tc)
+        step_fn = make_train_step(cfg, mesh, shardings, grad_accum=accum)
+        with mesh:
+            state, metrics = step_fn(state, batch)
+        results[accum] = (float(metrics['loss']),
+                          jax.device_get(state.params))
+    loss1, params1 = results[1]
+    loss4, params4 = results[2]
+    assert loss1 == pytest.approx(loss4, rel=1e-5)
+    flat1 = jax.tree_util.tree_leaves(params1)
+    flat4 = jax.tree_util.tree_leaves(params4)
+    for a, b in zip(flat1, flat4):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_grad_accum_microbatch_must_cover_dp_extent():
+    """A microbatch smaller than the dp/fsdp extent must RAISE: GSPMD
+    would otherwise PAD the uneven shard (involuntary rematerialization
+    — silent data-parallelism loss), not error."""
+    cfg = get_config('test-tiny', dtype='float32', param_dtype='float32')
+    mesh = build_mesh(infer_mesh_config(8, dp=4, tp=2))
+    tc = TrainConfig(learning_rate=1e-2, warmup_steps=1, total_steps=50)
+    state, shardings = create_sharded_state(
+        cfg, mesh, jax.random.PRNGKey(0), tc)
+    step_fn = make_train_step(cfg, mesh, shardings, grad_accum=4)
+    batch = synthetic_batch(jax.random.PRNGKey(7), 8, 64, cfg.vocab_size)
+    with mesh, pytest.raises(ValueError, match='divisible'):
+        step_fn(state, batch)   # 8/4 = 2 rows < dp extent 4
+
+
+def test_grad_accum_composes_with_pipeline():
+    """Accumulation wraps the pipelined forward: pp=2 mesh + accum=2
+    runs and the loss matches the accum=1 pipelined loss."""
+    cfg = get_config('test-tiny', dtype='float32', param_dtype='float32')
+    mesh = build_mesh(infer_mesh_config(8, pp=2, tp=2, fsdp=2))
+    tc = TrainConfig(learning_rate=1e-2, warmup_steps=1, total_steps=50)
+    batch = synthetic_batch(jax.random.PRNGKey(9), 8, 64, cfg.vocab_size)
+    losses = {}
+    for accum in (1, 2):
+        state, shardings = create_sharded_state(
+            cfg, mesh, jax.random.PRNGKey(0), tc)
+        step_fn = make_train_step(cfg, mesh, shardings, microbatches=2,
+                                  grad_accum=accum)
+        with mesh:
+            _, metrics = step_fn(state, batch)
+        losses[accum] = float(metrics['loss'])
+    assert losses[1] == pytest.approx(losses[2], rel=1e-5)
+
+
 def test_moe_train_step():
     cfg = get_config('test-tiny-moe')
     mesh = build_mesh(infer_mesh_config(8, ep=2, tp=2))
