@@ -72,6 +72,9 @@ def _parse_args(argv=None):
     parser.add_argument('--decode-chunk', type=int, default=8,
                         help='decode steps per dispatch for the serve '
                              'row (amortizes tunnel round-trips)')
+    parser.add_argument('--speculative', type=int, default=0,
+                        help='serve row: prompt-lookup speculative '
+                             'decoding draft length')
     parser.add_argument('--tune-attn', action='store_true',
                         help='sweep flash-attention block sizes per '
                              'sequence length (fwd+bwd wall time) and '
@@ -257,28 +260,50 @@ def _append_partial(row: dict) -> None:
 
 
 def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
-                  kv_quant=None) -> dict:
-    """p50/p99 time-to-first-token under concurrent requests on the
-    local chip(s) via the continuous-batching engine
-    (models/inference.py) — the BASELINE.md serving row."""
+                  kv_quant=None, speculative=0) -> dict:
+    """p50/p99 time-to-first-token + aggregate decode throughput under
+    concurrent requests on the local chip(s) via the continuous-batching
+    engine (models/inference.py) — the BASELINE.md serving row."""
+    import time as time_lib
+
     from skypilot_tpu.models import inference as inference_lib
     engine = inference_lib.ContinuousBatchingEngine(
         cfg, num_slots=4, mesh=mesh, quantize=quantize,
-        decode_chunk=decode_chunk, kv_quant=kv_quant)
+        decode_chunk=decode_chunk, kv_quant=kv_quant,
+        speculative=speculative)
     prompt = list(range(1, 33))
-    # Warmup: compile prefill + decode.
+    # Warmup: compile prefill + decode (and the verify step, if on).
     engine.generate(prompt, max_new_tokens=4)
-    ttfts = engine.measure_ttft(num_requests=16, prompt=prompt,
-                                max_new_tokens=16)
+    t0 = time_lib.time()
+    stats = engine.measure_ttft(num_requests=16, prompt=prompt,
+                                max_new_tokens=16, return_stats=True)
+    wall = time_lib.time() - t0
     engine.stop()
-    ttfts.sort()
+    ttfts = sorted(st['ttft_s'] for st in stats)
+    total_new = sum(st['new_tokens'] for st in stats)
+    # Two throughput views: e2e = all tokens / wall (includes prefill +
+    # queue wait through the 4 slots — the user-visible number), and the
+    # median per-request DECODE rate (post-first-token), which is the
+    # number the decode levers (chunk/speculative/kv-quant) move.
+    decode_rates = sorted(
+        (st['new_tokens'] - 1) / max(st['total_s'] - st['ttft_s'], 1e-9)
+        for st in stats if st['new_tokens'] > 1)
     import math
     n = len(ttfts)
     p99_idx = min(n - 1, math.ceil(n * 0.99) - 1)  # nearest-rank
-    return {
+    row = {
         'p50_ttft_ms': round(ttfts[n // 2] * 1e3, 2),
         'p99_ttft_ms': round(ttfts[p99_idx] * 1e3, 2),
+        'e2e_tok_per_s': round(total_new / wall, 1),
+        'decode_tok_per_s_per_req': round(
+            decode_rates[len(decode_rates) // 2], 1)
+        if decode_rates else 0.0,
     }
+    if speculative:
+        drafted = max(1, engine.spec_stats['drafted'])
+        row['spec_accept_rate'] = round(
+            engine.spec_stats['accepted'] / drafted, 3)
+    return row
 
 
 def _measure_train(cfg, mesh, n, batch, seq, steps, warmup) -> dict:
@@ -427,11 +452,14 @@ def _worker(args) -> int:
         serve_cfg = get_config(model_name, param_dtype='bfloat16')
         ttft = _measure_ttft(serve_cfg, mesh, quantize=args.quantize,
                              decode_chunk=args.decode_chunk,
-                             kv_quant=args.kv_quant)
+                             kv_quant=args.kv_quant,
+                             speculative=args.speculative)
         print(f'serve: {ttft}', file=sys.stderr)
         tags = [t for t in (args.quantize,
                             f'kv-{args.kv_quant}' if args.kv_quant
-                            else None) if t]
+                            else None,
+                            f'spec-{args.speculative}'
+                            if args.speculative else None) if t]
         result = {
             'metric': f'{serve_cfg.name} serve p50 TTFT'
                       + (f' ({"+".join(tags)})' if tags else ''),
@@ -441,6 +469,7 @@ def _worker(args) -> int:
             'decode_chunk': args.decode_chunk,
             'quantize': args.quantize or 'none',
             'kv_quant': args.kv_quant or 'none',
+            'speculative': args.speculative,
             **ttft,
         }
         print(json.dumps(result))

@@ -353,7 +353,8 @@ class ContinuousBatchingEngine:
                  decode_chunk: int = 1,
                  kv_quant: Optional[str] = None,
                  top_k: int = 0,
-                 top_p: float = 0.0) -> None:
+                 top_p: float = 0.0,
+                 speculative: int = 0) -> None:
         import queue as queue_lib
         import threading
         self.cfg, self.params = _resolve_cfg_and_params(
@@ -365,6 +366,15 @@ class ContinuousBatchingEngine:
         # this many steps per dispatch (scan in one jit) — fewer
         # host round trips; admission latency is bounded by one chunk.
         self.decode_chunk = max(1, decode_chunk)
+        # >0 ⇒ prompt-lookup speculative decoding: each tick drafts K
+        # tokens per greedy slot by n-gram lookup in the slot's own
+        # context and verifies them in ONE forward — every accepted
+        # draft saves a full decode dispatch (the dominant cost on
+        # tunneled/remote chips). Greedy output is bit-identical to
+        # plain decode (pinned by test); sampling slots fall back to
+        # one token per tick. Takes precedence over decode_chunk.
+        self.speculative = max(0, speculative)
+        self.spec_stats = {'ticks': 0, 'drafted': 0, 'accepted': 0}
         self.model = Transformer(self.cfg)
         self._rng = jax.random.PRNGKey(rng_seed)
 
@@ -375,6 +385,8 @@ class ContinuousBatchingEngine:
                                donate_argnames=('cache',))
         self._decode_multi = jax.jit(self._decode_multi_impl,
                                      donate_argnames=('cache',))
+        self._verify = jax.jit(self._verify_impl,
+                               donate_argnames=('cache',))
 
         self._queue: 'queue_lib.Queue[_Request]' = queue_lib.Queue()
         self._slots: list = [None] * num_slots  # _Request or None
@@ -476,7 +488,102 @@ class ContinuousBatchingEngine:
             body, (cache, tokens, positions), rngs)
         return toks.swapaxes(0, 1), cache
 
+    def _verify_impl(self, params, cache, tokens, positions, temps, rng):
+        """Speculative verification: ONE forward over (num_slots, K+1)
+        chunks [last_token, draft_1..draft_K] at per-row positions.
+
+        Greedy rows (temp<=0): out[:, j] is the model's argmax given the
+        drafts up to j; `accepted` = leading drafts matching those
+        argmaxes, so emitting out[:, :accepted+1] reproduces token-by-
+        token greedy decode EXACTLY — any draft content is safe, wrong
+        drafts just get 0 accepted. Sampling rows: accepted forced to 0
+        and out[:, 0] is sampled from the first position's logits,
+        identical to a normal decode tick. Cache entries written for
+        rejected positions sit at-or-after every future query position
+        (causal-masked) until the following ticks overwrite them —
+        the same stale-entry argument as finished-slot overshoot."""
+        logits, mutated = self.model.apply(
+            {'params': params, 'cache': cache}, tokens, positions,
+            mutable=['cache'])
+        logits = logits.astype(jnp.float32)        # (B, K+1, V)
+        greedy = jnp.argmax(logits, axis=-1)       # (B, K+1)
+        match = tokens[:, 1:] == greedy[:, :-1]    # (B, K) draft hits
+        accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(
+            axis=1)
+        accepted = jnp.where(temps <= 0, accepted, 0)
+        scaled = apply_logit_filters(
+            logits[:, 0, :] / jnp.maximum(temps, 1e-6)[:, None],
+            self.top_k, self.top_p)
+        sampled0 = jax.random.categorical(rng, scaled, axis=-1)
+        first = jnp.where(temps <= 0, greedy[:, 0], sampled0)
+        out = greedy.at[:, 0].set(first).astype(jnp.int32)
+        return out, accepted, nn.unbox(mutated['cache'])
+
     # ---------------- scheduler ----------------
+
+    @staticmethod
+    def _draft_tokens(context, k: int):
+        """Prompt-lookup drafting: find the most recent earlier
+        occurrence of the context's trailing n-gram (n = 3, then 2,
+        then 1) and propose the k tokens that followed it. No match →
+        zero-filler (safe: verification only ever accepts drafts equal
+        to the model's own greedy choice, so filler content merely
+        accepts nothing). Pure host-side list work — microseconds
+        against a multi-ms decode dispatch."""
+        n_ctx = len(context)
+        for n in (3, 2, 1):
+            if n_ctx < n + 1:
+                continue
+            tail = context[-n:]
+            # Scan right-to-left, excluding the trailing n-gram itself.
+            # start+n <= n_ctx-1, so `follow` is never empty.
+            for start in range(n_ctx - n - 1, -1, -1):
+                if context[start:start + n] == tail:
+                    follow = context[start + n:start + n + k]
+                    return follow + [0] * (k - len(follow))
+        return [0] * k
+
+    def _spec_tick(self, active) -> 'Optional[Any]':
+        """One speculative tick: draft K per slot, verify in one
+        forward. Returns the (num_slots, <=K+1) emit columns + per-slot
+        valid counts, or None when the tick must fall back (a slot too
+        close to the cache window)."""
+        k = self.speculative
+        for i in active:
+            req = self._slots[i]
+            if self.cfg.max_seq_len - req.next_pos <= k:
+                return None
+        tokens, positions = [], []
+        for slot in range(self.num_slots):
+            req = self._slots[slot]
+            if req is None:
+                tokens.append([0] * (k + 1))
+                positions.append([0] * (k + 1))
+                continue
+            draft = (self._draft_tokens(req.ids + req.tokens, k)
+                     if req.temperature <= 0 else [0] * k)
+            tokens.append([req.tokens[-1]] + draft)
+            positions.append(list(range(req.next_pos,
+                                        req.next_pos + k + 1)))
+        temps = [(self._slots[i].temperature
+                  if self._slots[i] is not None else 0.0)
+                 for i in range(self.num_slots)]
+        self._rng, rng = jax.random.split(self._rng)
+        out, accepted, self._cache = self._verify(
+            self.params, self._cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(temps, jnp.float32), rng)
+        import numpy as np
+        out = np.asarray(out)
+        accepted = np.asarray(accepted)
+        greedy_active = [i for i in active
+                         if self._slots[i].temperature <= 0]
+        self.spec_stats['ticks'] += 1
+        self.spec_stats['drafted'] += k * len(greedy_active)
+        self.spec_stats['accepted'] += int(accepted[greedy_active].sum())
+        valid = accepted + 1          # emit accepted drafts + 1 bonus
+        return out, valid
 
     def _ensure_thread(self) -> None:
         import threading
@@ -573,6 +680,20 @@ class ContinuousBatchingEngine:
             self._wake.wait(timeout=0.05)
             self._wake.clear()
             return
+        # Speculation only pays when a greedy slot can accept drafts;
+        # an all-sampling active set would pay (K+1)x forward cost to
+        # emit one token per slot — use the plain/chunked path instead.
+        any_greedy = any(self._slots[i].temperature <= 0 for i in active)
+        if self.speculative > 0 and any_greedy:
+            spec = self._spec_tick(active)
+            if spec is not None:
+                out, valid = spec
+                self._decode_steps += 1
+                self.step_log.append((self._decode_steps,
+                                      frozenset(active)))
+                self._emit(active, out, valid)
+                return
+            # else: a slot is near the cache window — single-step tick.
         # All-slots decode: K scanned steps per dispatch when nothing is
         # waiting to be admitted (admission latency stays bounded by one
         # chunk), a single step otherwise.
@@ -615,9 +736,16 @@ class ContinuousBatchingEngine:
             out_cols = np.asarray(out_tokens)     # (num_slots, k)
         self._decode_steps += k
         self.step_log.append((self._decode_steps, frozenset(active)))
+        self._emit(active, out_cols, None)
+
+    def _emit(self, active, out_cols, valid) -> None:
+        """Append per-slot output columns (up to valid[slot] of them —
+        None ⇒ all) with EOS/max/window termination."""
         for slot in active:
             req = self._slots[slot]
-            for c in range(out_cols.shape[1]):
+            limit = (out_cols.shape[1] if valid is None
+                     else int(valid[slot]))
+            for c in range(limit):
                 req.next_pos += 1
                 token = int(out_cols[slot, c])
                 req.tokens.append(token)
@@ -664,11 +792,16 @@ class ContinuousBatchingEngine:
                            eos_id).result(timeout=timeout)
 
     def measure_ttft(self, num_requests: int, prompt,
-                     max_new_tokens: int = 16) -> list:
-        """Submit `num_requests` concurrently; returns their TTFTs (s)."""
+                     max_new_tokens: int = 16,
+                     return_stats: bool = False):
+        """Submit `num_requests` concurrently; returns their TTFTs (s)
+        (or the full per-request stats dicts with return_stats)."""
         futures = [self.submit(prompt, max_new_tokens=max_new_tokens)
                    for _ in range(num_requests)]
-        return [f.result(timeout=600.0)[1]['ttft_s'] for f in futures]
+        stats = [f.result(timeout=600.0)[1] for f in futures]
+        if return_stats:
+            return stats
+        return [st['ttft_s'] for st in stats]
 
     def stop(self) -> None:
         self._stop.set()

@@ -60,7 +60,8 @@ class InferenceServer:
                  decode_chunk: int = 1,
                  kv_quant: Optional[str] = None,
                  top_k: int = 0,
-                 top_p: float = 0.0) -> None:
+                 top_p: float = 0.0,
+                 speculative: int = 0) -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
@@ -91,7 +92,8 @@ class InferenceServer:
                                                quantize=quantize,
                                                decode_chunk=decode_chunk,
                                                kv_quant=kv_quant,
-                                               top_k=top_k, top_p=top_p)
+                                               top_k=top_k, top_p=top_p,
+                                               speculative=speculative)
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
         if tokenizer.startswith('hf:'):
@@ -388,6 +390,13 @@ def main(argv=None) -> int:
     parser.add_argument('--quantize', default=None, choices=['int8'],
                         help='weight-only int8 serving: halves the HBM '
                              'weight traffic that bounds decode')
+    parser.add_argument('--speculative', type=int, default=0,
+                        help='prompt-lookup speculative decoding: draft '
+                             'K tokens per tick by n-gram lookup in the '
+                             'request context, verify in one forward — '
+                             'accepted drafts save decode dispatches; '
+                             'greedy output is unchanged (exact). '
+                             'Takes precedence over --decode-chunk.')
     parser.add_argument('--decode-chunk', type=int, default=1,
                         help='decode steps per device dispatch when no '
                              'request awaits admission (>1 cuts host '
@@ -406,7 +415,8 @@ def main(argv=None) -> int:
                              quantize=args.quantize,
                              decode_chunk=args.decode_chunk,
                              kv_quant=args.kv_quant,
-                             top_k=args.top_k, top_p=args.top_p)
+                             top_k=args.top_k, top_p=args.top_p,
+                             speculative=args.speculative)
     logger.info('sampling filters: top_k=%s top_p=%s (0 = off)',
                 args.top_k, args.top_p)
     server.warmup()

@@ -292,6 +292,121 @@ class TestContinuousBatchingChunked:
             assert stats['new_tokens'] == 7
 
 
+class TestSpeculativeDecoding:
+    """Prompt-lookup speculative decoding: greedy output must be
+    bit-identical to plain decode; accepted drafts must actually save
+    dispatches on repetitive text."""
+
+    def test_draft_tokens_ngram_lookup(self):
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        draft = ContinuousBatchingEngine._draft_tokens
+        # Trailing trigram [1,2,3] seen earlier, followed by 9, 8, 7.
+        ctx = [1, 2, 3, 9, 8, 7, 5, 1, 2, 3]
+        assert draft(ctx, 3) == [9, 8, 7]
+        # Bigram fallback; follow shorter than k → zero-padded.
+        assert draft([4, 6, 4, 6], 3) == [4, 6, 0]
+        # No match anywhere: zero filler (safe by construction).
+        assert draft([1, 2, 3, 4], 2) == [0, 0]
+
+    @pytest.mark.parametrize('prompt', [
+        [5, 7, 11],                              # arbitrary
+        [1, 2, 3, 4, 1, 2, 3, 4, 1, 2, 3, 4],   # repetitive: drafts hit
+    ])
+    def test_greedy_exactly_matches_plain_decode(self, prompt):
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        plain = ContinuousBatchingEngine(_cfg(), num_slots=2)
+        spec = ContinuousBatchingEngine(_cfg(), num_slots=2,
+                                        speculative=4)
+        try:
+            want, _ = plain.generate(prompt, max_new_tokens=16)
+            got, stats = spec.generate(prompt, max_new_tokens=16)
+            assert got == want
+            assert stats['new_tokens'] == 16
+        finally:
+            plain.stop()
+            spec.stop()
+
+    def test_accepted_drafts_save_dispatches(self, monkeypatch):
+        """With oracle drafts (the model's own greedy continuation),
+        every draft is accepted: 16 tokens land in ceil(16/(K+1)) = 4
+        verify ticks instead of 16 decode ticks — the dispatch saving
+        the feature exists for."""
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        prompt = [3, 1, 4, 1, 5]
+        plain = ContinuousBatchingEngine(_cfg(), num_slots=1)
+        try:
+            oracle, _ = plain.generate(prompt, max_new_tokens=24)
+        finally:
+            plain.stop()
+        full = prompt + oracle
+
+        def perfect_draft(context, k):
+            n = len(context)
+            # The engine's context is a prefix of the oracle rollout.
+            assert context == full[:n]
+            follow = full[n:n + k]
+            return follow + [0] * (k - len(follow))
+
+        spec = ContinuousBatchingEngine(_cfg(), num_slots=1,
+                                        speculative=3)
+        monkeypatch.setattr(spec, '_draft_tokens', perfect_draft)
+        try:
+            got, _ = spec.generate(prompt, max_new_tokens=16)
+            assert got == oracle[:16]
+            assert spec.spec_stats['ticks'] == 4      # ceil(16 / (3+1))
+            assert spec.spec_stats['accepted'] == 12  # 3 per tick
+        finally:
+            spec.stop()
+
+    def test_sampling_slot_coexists_with_greedy(self):
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        spec = ContinuousBatchingEngine(_cfg(), num_slots=2,
+                                        speculative=3)
+        try:
+            f1 = spec.submit([1, 2, 3, 1, 2, 3], max_new_tokens=10,
+                             temperature=0.0)
+            f2 = spec.submit([9, 8, 7], max_new_tokens=10,
+                             temperature=0.9)
+            out1, st1 = f1.result(timeout=300)
+            out2, st2 = f2.result(timeout=300)
+            assert st1['new_tokens'] == 10 and st2['new_tokens'] == 10
+            assert all(0 <= t < _cfg().vocab_size for t in out1 + out2)
+        finally:
+            spec.stop()
+
+    def test_window_edge_falls_back_and_finishes(self):
+        """Slots too close to max_seq_len for a K-draft verify must fall
+        back to single steps and still complete."""
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        spec = ContinuousBatchingEngine(_cfg(), num_slots=1,
+                                        speculative=8)
+        try:
+            # max_seq_len=64; prompt 40 + 20 new runs into the window.
+            prompt = list(range(1, 41))
+            got, stats = spec.generate(prompt, max_new_tokens=20)
+            assert stats['new_tokens'] == 20
+        finally:
+            spec.stop()
+
+    def test_eos_mid_accept_truncates(self):
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        plain = ContinuousBatchingEngine(_cfg(), num_slots=1)
+        spec = ContinuousBatchingEngine(_cfg(), num_slots=1,
+                                        speculative=4)
+        prompt = [1, 2, 3, 4, 1, 2, 3, 4]
+        try:
+            want, _ = plain.generate(prompt, max_new_tokens=16)
+            eos = want[5]   # an id greedy decode actually emits
+            want_trunc, _ = plain.generate(prompt, max_new_tokens=16,
+                                           eos_id=eos)
+            got, _ = spec.generate(prompt, max_new_tokens=16, eos_id=eos)
+            assert got == want_trunc
+            assert got[-1] == eos
+        finally:
+            plain.stop()
+            spec.stop()
+
+
 class TestContinuousBatching:
 
     @pytest.fixture(scope='class')
