@@ -41,6 +41,12 @@ class ModelConfig:
     norm_style: str = 'rms'
     # 'rope' | 'learned' (GPT-2 absolute position table).
     pos_embedding: str = 'rope'
+    # LayerNorm bias (norm_style='layernorm' only): GPT-2/Falcon carry
+    # scale+bias; DBRX is bias-free (scale-only mean-centred norm).
+    norm_bias: bool = True
+    # Clamp Q/K/V activations to ±qkv_clip after projection (DBRX's
+    # clip_qkv=8 training-stability trick; 0 ⇒ off).
+    qkv_clip: float = 0.0
     qkv_bias: bool = False            # Qwen2 (and GPT-2)
     o_bias: bool = False              # GPT-2
     mlp_bias: bool = False            # GPT-2
@@ -310,6 +316,15 @@ QWEN2_72B = _register(ModelConfig(
 # learned positions, plain GELU MLP, biases, tied unembed. Vocab padded
 # 50257 → 50304 (×128) so the unembed matmul tiles the MXU cleanly, the
 # same padding llm.c applies.
+# --- DBRX (reference recipe: llm/dbrx). 132B fine-grained MoE: 16
+# experts top-4 (vs Mixtral's 8 top-2), GQA, bias-free LayerNorm,
+# clip_qkv=8, untied 100352-vocab embeddings (÷128 exact), rope 5e5.
+DBRX = _register(ModelConfig(
+    name='dbrx', vocab_size=100352, d_model=6144, num_layers=40,
+    num_heads=48, num_kv_heads=8, d_mlp=10752, max_seq_len=32768,
+    rope_theta=500000.0, norm_style='layernorm', norm_bias=False,
+    qkv_clip=8.0, num_experts=16, experts_per_token=4))
+
 # --- Falcon family (reference recipe: llm/falcon). Parallel block
 # (shared LayerNorm feeds attn AND mlp, both add into the residual),
 # multi-query attention (1 KV head — the original MQA paper's serving

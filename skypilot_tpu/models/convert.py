@@ -88,6 +88,8 @@ def from_hf(state_dict: Mapping[str, Any],
     gpt2 = cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain'
     if cfg.parallel_block:
         params, layer = _falcon_top(sd, cfg), _falcon_layer
+    elif cfg.is_moe and cfg.norm_style == 'layernorm':
+        params, layer = _dbrx_top(sd, cfg), _dbrx_layer
     elif gpt2:
         params, layer = _gpt2_top(sd, cfg), _gpt2_layer
     else:
@@ -172,6 +174,37 @@ def to_hf(params: Mapping[str, Any],
     layers = p['layers']['layer']
     gpt2 = cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain'
     sd: Dict[str, np.ndarray] = {}
+    if cfg.is_moe and cfg.norm_style == 'layernorm':
+        d, nh, nkv, hd = (cfg.d_model, cfg.num_heads, cfg.num_kv_heads,
+                          cfg.head_dim)
+        e, ffn = cfg.num_experts, cfg.d_mlp
+        sd['transformer.wte.weight'] = p['embed']['embedding']
+        sd['transformer.norm_f.weight'] = p['final_norm']['scale']
+        sd['lm_head.weight'] = p['lm_head']['kernel'].T
+        for i in range(cfg.num_layers):
+            li = jax_tree_index(layers, i)
+            pre = f'transformer.blocks.{i}.'
+            attn = li['attn']
+            fused = np.concatenate([
+                attn['q_proj']['kernel'].reshape(d, nh * hd),
+                attn['k_proj']['kernel'].reshape(d, nkv * hd),
+                attn['v_proj']['kernel'].reshape(d, nkv * hd)], axis=1)
+            sd[pre + 'norm_attn_norm.attn.Wqkv.weight'] = fused.T
+            sd[pre + 'norm_attn_norm.attn.out_proj.weight'] = \
+                attn['o_proj']['kernel'].reshape(nh * hd, d).T
+            sd[pre + 'norm_attn_norm.norm_1.weight'] = \
+                li['attn_norm']['scale']
+            sd[pre + 'norm_attn_norm.norm_2.weight'] = \
+                li['mlp_norm']['scale']
+            moe = li['moe']
+            sd[pre + 'ffn.router.layer.weight'] = moe['router'].T
+            sd[pre + 'ffn.experts.mlp.w1'] = \
+                moe['w_gate'].transpose(0, 2, 1).reshape(e * ffn, d)
+            sd[pre + 'ffn.experts.mlp.v1'] = \
+                moe['w_up'].transpose(0, 2, 1).reshape(e * ffn, d)
+            sd[pre + 'ffn.experts.mlp.w2'] = \
+                moe['w_down'].reshape(e * ffn, d)
+        return sd
     if cfg.parallel_block:
         if (cfg.num_kv_heads != 1 or cfg.mlp_style != 'plain'
                 or cfg.qkv_bias or cfg.o_bias or cfg.mlp_bias):
@@ -331,6 +364,18 @@ def hf_config_for(cfg: ModelConfig):
         max_position_embeddings=cfg.max_seq_len,
         rope_theta=cfg.rope_theta, rms_norm_eps=cfg.norm_eps,
         tie_word_embeddings=cfg.tie_embeddings)
+    if cfg.is_moe and cfg.norm_style == 'layernorm':
+        return transformers.DbrxConfig(
+            d_model=cfg.d_model, n_heads=cfg.num_heads,
+            n_layers=cfg.num_layers, max_seq_len=cfg.max_seq_len,
+            vocab_size=hf_vocab,
+            attn_config={'kv_n_heads': cfg.num_kv_heads,
+                         'rope_theta': cfg.rope_theta,
+                         'clip_qkv': cfg.qkv_clip or None},
+            ffn_config={'ffn_hidden_size': cfg.d_mlp,
+                        'moe_num_experts': cfg.num_experts,
+                        'moe_top_k': cfg.experts_per_token},
+            tie_word_embeddings=cfg.tie_embeddings)
     if cfg.is_moe:
         return transformers.MixtralConfig(
             num_local_experts=cfg.num_experts,
@@ -427,6 +472,52 @@ def _llama_layer(sd, cfg: ModelConfig, i: int) -> Dict[str, Any]:
             'down_proj': {'kernel': sd[p + 'mlp.down_proj.weight'].T},
         }
     return layer
+
+
+# ---------------- DBRX (fine-grained MoE + GQA + clip_qkv) -----------
+
+
+def _dbrx_top(sd, cfg: ModelConfig) -> Dict[str, Any]:
+    return {
+        'embed': {'embedding': _pad_vocab(sd['transformer.wte.weight'],
+                                          cfg.vocab_size)},
+        'final_norm': {'scale': sd['transformer.norm_f.weight']},
+        'lm_head': {'kernel': _pad_vocab(sd['lm_head.weight'],
+                                         cfg.vocab_size).T},
+    }
+
+
+def _dbrx_layer(sd, cfg: ModelConfig, i: int) -> Dict[str, Any]:
+    p = f'transformer.blocks.{i}.'
+    d, nh, nkv, hd = (cfg.d_model, cfg.num_heads, cfg.num_kv_heads,
+                      cfg.head_dim)
+    e, ffn = cfg.num_experts, cfg.d_mlp
+    # Fused Wqkv rows = [q·(nh·hd), k·(nkv·hd), v·(nkv·hd)].
+    w = sd[p + 'norm_attn_norm.attn.Wqkv.weight'].T       # (d, out)
+    q, k, v = np.split(w, [nh * hd, (nh + nkv) * hd], axis=1)
+    # Experts ship as one (E·ffn, d) block per matrix; per-expert
+    # chunks are (ffn, d) applied as x·w1ᵀ (gate/up) and h·w2 (down).
+    w1 = sd[p + 'ffn.experts.mlp.w1'].reshape(e, ffn, d)
+    v1 = sd[p + 'ffn.experts.mlp.v1'].reshape(e, ffn, d)
+    w2 = sd[p + 'ffn.experts.mlp.w2'].reshape(e, ffn, d)
+    return {
+        'attn_norm': {'scale': sd[p + 'norm_attn_norm.norm_1.weight']},
+        'mlp_norm': {'scale': sd[p + 'norm_attn_norm.norm_2.weight']},
+        'attn': {
+            'q_proj': {'kernel': q.reshape(d, nh, hd)},
+            'k_proj': {'kernel': k.reshape(d, nkv, hd)},
+            'v_proj': {'kernel': v.reshape(d, nkv, hd)},
+            'o_proj': {'kernel':
+                       sd[p + 'norm_attn_norm.attn.out_proj.weight']
+                       .T.reshape(nh, hd, d)},
+        },
+        'moe': {
+            'router': sd[p + 'ffn.router.layer.weight'].T,   # (d, E)
+            'w_gate': w1.transpose(0, 2, 1),                 # (E, d, ffn)
+            'w_up': v1.transpose(0, 2, 1),
+            'w_down': w2,                                    # (E, ffn, d)
+        },
+    }
 
 
 # ---------------- Falcon (parallel block + MQA) ----------------------

@@ -521,27 +521,35 @@ class ContinuousBatchingEngine:
 
     # ---------------- scheduler ----------------
 
-    @staticmethod
-    def _draft_tokens(context, k: int):
-        """Prompt-lookup drafting: find the most recent earlier
-        occurrence of the context's trailing n-gram (n = 3, then 2,
-        then 1) and propose the k tokens that followed it. No match →
-        zero-filler (safe: verification only ever accepts drafts equal
-        to the model's own greedy choice, so filler content merely
-        accepts nothing). Pure host-side list work — microseconds
-        against a multi-ms decode dispatch."""
+    # Backward-scan cap for prompt-lookup drafting: bounds the host-side
+    # cost per tick to O(window) regardless of context length (an
+    # uncapped scan at 32k tokens costs ~10ms — rivaling the dispatch it
+    # tries to save). Repetition useful for drafting is overwhelmingly
+    # local.
+    _DRAFT_SCAN_WINDOW = 2048
+
+    @classmethod
+    def _draft_tokens(cls, context, k: int):
+        """Prompt-lookup drafting: find the most recent occurrence of
+        the context's trailing n-gram (n = 3, then 2, then 1) within the
+        scan window and propose the k tokens that followed it. Returns
+        None when nothing matches — the tick then falls back to the
+        plain/chunked path instead of burning a known-useless verify
+        (filler drafts are SAFE, just pointless: verification only ever
+        accepts drafts equal to the model's own greedy choice)."""
         n_ctx = len(context)
+        lo = max(0, n_ctx - cls._DRAFT_SCAN_WINDOW)
         for n in (3, 2, 1):
             if n_ctx < n + 1:
                 continue
             tail = context[-n:]
             # Scan right-to-left, excluding the trailing n-gram itself.
             # start+n <= n_ctx-1, so `follow` is never empty.
-            for start in range(n_ctx - n - 1, -1, -1):
+            for start in range(n_ctx - n - 1, lo - 1, -1):
                 if context[start:start + n] == tail:
                     follow = context[start + n:start + n + k]
                     return follow + [0] * (k - len(follow))
-        return [0] * k
+        return None
 
     def _spec_tick(self, active) -> 'Optional[Any]':
         """One speculative tick: draft K per slot, verify in one
@@ -554,6 +562,7 @@ class ContinuousBatchingEngine:
             if self.cfg.max_seq_len - req.next_pos <= k:
                 return None
         tokens, positions = [], []
+        any_draft = False
         for slot in range(self.num_slots):
             req = self._slots[slot]
             if req is None:
@@ -561,10 +570,19 @@ class ContinuousBatchingEngine:
                 positions.append([0] * (k + 1))
                 continue
             draft = (self._draft_tokens(req.ids + req.tokens, k)
-                     if req.temperature <= 0 else [0] * k)
+                     if req.temperature <= 0 else None)
+            if draft is None:
+                draft = [0] * k
+            else:
+                any_draft = True
             tokens.append([req.tokens[-1]] + draft)
             positions.append(list(range(req.next_pos,
                                         req.next_pos + k + 1)))
+        if not any_draft:
+            # Every greedy slot drew a lookup blank: a verify tick would
+            # emit 1 token/slot at (K+1)x forward cost — let the
+            # plain/chunked path take this round instead.
+            return None
         temps = [(self._slots[i].temperature
                   if self._slots[i] is not None else 0.0)
                  for i in range(self.num_slots)]

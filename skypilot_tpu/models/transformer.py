@@ -234,7 +234,7 @@ class RMSNorm(nn.Module):
         if cfg.norm_style == 'rms_plus1':
             w = 1.0 + w
         out = normed * w
-        if cfg.norm_style == 'layernorm':
+        if cfg.norm_style == 'layernorm' and cfg.norm_bias:
             bias = self.param(
                 'bias',
                 nn.with_logical_partitioning(nn.initializers.zeros,
@@ -273,6 +273,12 @@ class Attention(nn.Module):
                   ('embed', 'kv_heads', 'qkv_dim'), 'k_proj')(x)
         v = dense((cfg.num_kv_heads, cfg.head_dim),
                   ('embed', 'kv_heads', 'qkv_dim'), 'v_proj')(x)
+        if cfg.qkv_clip:
+            # DBRX clip_qkv: clamp projections to ±clip (training
+            # stability; must match at inference for logit parity).
+            q = jnp.clip(q, -cfg.qkv_clip, cfg.qkv_clip)
+            k = jnp.clip(k, -cfg.qkv_clip, cfg.qkv_clip)
+            v = jnp.clip(v, -cfg.qkv_clip, cfg.qkv_clip)
         q = sharding.constrain(q, 'batch', 'seq', 'act_heads', None)
         k = sharding.constrain(k, 'batch', 'seq', 'act_heads', None)
         v = sharding.constrain(v, 'batch', 'seq', 'act_heads', None)

@@ -305,8 +305,14 @@ class TestSpeculativeDecoding:
         assert draft(ctx, 3) == [9, 8, 7]
         # Bigram fallback; follow shorter than k → zero-padded.
         assert draft([4, 6, 4, 6], 3) == [4, 6, 0]
-        # No match anywhere: zero filler (safe by construction).
-        assert draft([1, 2, 3, 4], 2) == [0, 0]
+        # No match anywhere: None — the tick falls back to plain decode
+        # rather than burning a (K+1)x verify on filler.
+        assert draft([1, 2, 3, 4], 2) is None
+        # Scan window: a match older than _DRAFT_SCAN_WINDOW is unseen.
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        far = [7, 8, 9] + [0] * ContinuousBatchingEngine._DRAFT_SCAN_WINDOW \
+            + [1, 5, 7, 8, 9]
+        assert draft(far, 2) is None
 
     @pytest.mark.parametrize('prompt', [
         [5, 7, 11],                              # arbitrary

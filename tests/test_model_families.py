@@ -185,7 +185,7 @@ class TestFamilyDecodeParity:
     learned positions and softcaps all touch the decode branch)."""
 
     @pytest.mark.parametrize('family', ['gemma', 'gemma2', 'gpt2', 'qwen',
-                                        'falcon'])
+                                        'falcon', 'dbrx'])
     def test_prefill_then_decode_matches_full(self, family):
         cfg = {
             'gemma': _gemma_tiny(),
@@ -201,6 +201,11 @@ class TestFamilyDecodeParity:
                             mlp_activation='gelu',
                             norm_style='layernorm', tie_embeddings=True,
                             parallel_block=True),
+            # DBRX: MoE + bias-free LayerNorm + clip_qkv in the decode
+            # path (dense moe_impl: exact for the tiny comparison).
+            'dbrx': _tiny(num_experts=4, experts_per_token=2,
+                          moe_impl='dense', norm_style='layernorm',
+                          norm_bias=False, qkv_clip=8.0),
         }[family]
         engine = InferenceEngine(cfg, batch_size=1)
         tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 10), 0,
@@ -237,6 +242,7 @@ class TestRegistry:
         ('llama2-70b', 6.6e10, 7.1e10),
         ('codellama-7b', 6.5e9, 7.0e9),
         ('falcon-7b', 6.6e9, 7.5e9),
+        ('dbrx', 1.25e11, 1.40e11),
     ])
     def test_param_counts_in_published_range(self, name, lo, hi):
         assert lo <= get_config(name).num_params() <= hi
