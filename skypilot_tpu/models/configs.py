@@ -44,6 +44,11 @@ class ModelConfig:
     # LayerNorm bias (norm_style='layernorm' only): GPT-2/Falcon carry
     # scale+bias; DBRX is bias-free (scale-only mean-centred norm).
     norm_bias: bool = True
+    # Partial rotary (Phi/NeoX style): rope rotates only the first
+    # rotary_pct·head_dim dims; the remainder passes through unrotated.
+    rotary_pct: float = 1.0
+    # Phi puts a bias on the (untied) unembed projection.
+    lm_head_bias: bool = False
     # Clamp Q/K/V activations to ±qkv_clip after projection (DBRX's
     # clip_qkv=8 training-stability trick; 0 ⇒ off).
     qkv_clip: float = 0.0
@@ -139,6 +144,8 @@ class ModelConfig:
         """Parameter count (tied unembed counted once; biases included)."""
         embed = self.vocab_size * self.d_model * \
             (1 if self.tie_embeddings else 2)
+        if self.lm_head_bias:
+            embed += self.vocab_size
         if self.pos_embedding == 'learned':
             embed += self.max_seq_len * self.d_model
         attn = (self.d_model * self.num_heads * self.head_dim +        # q
@@ -324,6 +331,16 @@ DBRX = _register(ModelConfig(
     num_heads=48, num_kv_heads=8, d_mlp=10752, max_seq_len=32768,
     rope_theta=500000.0, norm_style='layernorm', norm_bias=False,
     qkv_clip=8.0, num_experts=16, experts_per_token=4))
+
+# --- Phi (Microsoft). Parallel block like Falcon but biased
+# everywhere (qkv/o/mlp/lm_head + LayerNorm biases), MHA, partial
+# rotary (40% of head_dim), plain GELU MLP, untied embeddings.
+PHI_2 = _register(ModelConfig(
+    name='phi-2', vocab_size=51200, d_model=2560, num_layers=32,
+    num_heads=32, num_kv_heads=32, d_mlp=10240, max_seq_len=2048,
+    rope_theta=10000.0, norm_style='layernorm', mlp_style='plain',
+    mlp_activation='gelu', parallel_block=True, qkv_bias=True,
+    o_bias=True, mlp_bias=True, lm_head_bias=True, rotary_pct=0.4))
 
 # --- Falcon family (reference recipe: llm/falcon). Parallel block
 # (shared LayerNorm feeds attn AND mlp, both add into the residual),

@@ -86,7 +86,9 @@ def from_hf(state_dict: Mapping[str, Any],
                                   'use scan_layers=True')
     sd = _TrackedDict({k: _np(v) for k, v in state_dict.items()})
     gpt2 = cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain'
-    if cfg.parallel_block:
+    if cfg.parallel_block and cfg.qkv_bias:
+        params, layer = _phi_top(sd, cfg), _phi_layer
+    elif cfg.parallel_block:
         params, layer = _falcon_top(sd, cfg), _falcon_layer
     elif cfg.is_moe and cfg.norm_style == 'layernorm':
         params, layer = _dbrx_top(sd, cfg), _dbrx_layer
@@ -170,7 +172,10 @@ def to_hf(params: Mapping[str, Any],
         n = cfg.unpadded_vocab_size
         p['embed'] = {'embedding': p['embed']['embedding'][:n]}
         if not cfg.tie_embeddings and 'lm_head' in p:
-            p['lm_head'] = {'kernel': p['lm_head']['kernel'][:, :n]}
+            head = {'kernel': p['lm_head']['kernel'][:, :n]}
+            if 'bias' in p['lm_head']:   # Phi-style biased unembed
+                head['bias'] = p['lm_head']['bias'][:n]
+            p['lm_head'] = head
     layers = p['layers']['layer']
     gpt2 = cfg.pos_embedding == 'learned' and cfg.mlp_style == 'plain'
     sd: Dict[str, np.ndarray] = {}
@@ -204,6 +209,42 @@ def to_hf(params: Mapping[str, Any],
                 moe['w_up'].transpose(0, 2, 1).reshape(e * ffn, d)
             sd[pre + 'ffn.experts.mlp.w2'] = \
                 moe['w_down'].reshape(e * ffn, d)
+        return sd
+    if cfg.parallel_block and cfg.qkv_bias:
+        # Phi: biased everything, untied, partial rotary.
+        if cfg.mlp_style != 'plain' or cfg.tie_embeddings:
+            raise NotImplementedError(
+                'biased parallel_block export maps the Phi layout only '
+                '(plain MLP, untied lm_head) — a GLU/tied config would '
+                'silently drop weights the Phi HF architecture has no '
+                'keys for')
+        d, nh, nkv, hd = (cfg.d_model, cfg.num_heads, cfg.num_kv_heads,
+                          cfg.head_dim)
+        sd['model.embed_tokens.weight'] = p['embed']['embedding']
+        sd['model.final_layernorm.weight'] = p['final_norm']['scale']
+        sd['model.final_layernorm.bias'] = p['final_norm']['bias']
+        sd['lm_head.weight'] = p['lm_head']['kernel'].T
+        sd['lm_head.bias'] = p['lm_head']['bias']
+        for i in range(cfg.num_layers):
+            li = jax_tree_index(layers, i)
+            pre = f'model.layers.{i}.'
+            attn = li['attn']
+            for name, heads in (('q_proj', nh), ('k_proj', nkv),
+                                ('v_proj', nkv)):
+                sd[pre + f'self_attn.{name}.weight'] = \
+                    attn[name]['kernel'].reshape(d, heads * hd).T
+                sd[pre + f'self_attn.{name}.bias'] = \
+                    attn[name]['bias'].reshape(-1)
+            sd[pre + 'self_attn.dense.weight'] = \
+                attn['o_proj']['kernel'].reshape(nh * hd, d).T
+            sd[pre + 'self_attn.dense.bias'] = attn['o_proj']['bias']
+            sd[pre + 'input_layernorm.weight'] = li['attn_norm']['scale']
+            sd[pre + 'input_layernorm.bias'] = li['attn_norm']['bias']
+            sd[pre + 'mlp.fc1.weight'] = li['mlp']['up_proj']['kernel'].T
+            sd[pre + 'mlp.fc1.bias'] = li['mlp']['up_proj']['bias']
+            sd[pre + 'mlp.fc2.weight'] = \
+                li['mlp']['down_proj']['kernel'].T
+            sd[pre + 'mlp.fc2.bias'] = li['mlp']['down_proj']['bias']
         return sd
     if cfg.parallel_block:
         if (cfg.num_kv_heads != 1 or cfg.mlp_style != 'plain'
@@ -334,6 +375,22 @@ def hf_config_for(cfg: ModelConfig):
             'softcapped (Gemma-2-style) configs have no faithful HF '
             'export: this architecture omits Gemma-2 post-norms, so '
             'neither GemmaConfig nor Gemma2Config reproduces it')
+    if cfg.parallel_block and cfg.qkv_bias:
+        if cfg.mlp_style != 'plain' or cfg.mlp_activation != 'gelu':
+            raise NotImplementedError(
+                'biased parallel_block config emission maps the Phi '
+                'layout only (plain GELU MLP)')
+        return transformers.PhiConfig(
+            vocab_size=hf_vocab, hidden_size=cfg.d_model,
+            intermediate_size=cfg.d_mlp,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_heads,
+            num_key_value_heads=cfg.num_kv_heads,
+            max_position_embeddings=cfg.max_seq_len,
+            rope_theta=cfg.rope_theta,
+            partial_rotary_factor=cfg.rotary_pct,
+            layer_norm_eps=cfg.norm_eps,
+            tie_word_embeddings=cfg.tie_embeddings)
     if cfg.parallel_block:
         if cfg.num_kv_heads != 1:
             raise NotImplementedError(
@@ -516,6 +573,56 @@ def _dbrx_layer(sd, cfg: ModelConfig, i: int) -> Dict[str, Any]:
             'w_gate': w1.transpose(0, 2, 1),                 # (E, d, ffn)
             'w_up': v1.transpose(0, 2, 1),
             'w_down': w2,                                    # (E, ffn, d)
+        },
+    }
+
+
+# ---------------- Phi (biased parallel block + partial rotary) -------
+
+
+def _phi_top(sd, cfg: ModelConfig) -> Dict[str, Any]:
+    return {
+        'embed': {'embedding': _pad_vocab(sd['model.embed_tokens.weight'],
+                                          cfg.vocab_size)},
+        'final_norm': {'scale': sd['model.final_layernorm.weight'],
+                       'bias': sd['model.final_layernorm.bias']},
+        'lm_head': {
+            'kernel': _pad_vocab(sd['lm_head.weight'], cfg.vocab_size).T,
+            'bias': _pad_vocab(sd['lm_head.bias'][:, None],
+                               cfg.vocab_size)[:, 0],
+        },
+    }
+
+
+def _phi_layer(sd, cfg: ModelConfig, i: int) -> Dict[str, Any]:
+    p = f'model.layers.{i}.'
+    d, nh, hd = cfg.d_model, cfg.num_heads, cfg.head_dim
+
+    def proj(name, heads):
+        return {
+            'kernel': sd[p + f'self_attn.{name}.weight'].T.reshape(
+                d, heads, hd),
+            'bias': sd[p + f'self_attn.{name}.bias'].reshape(heads, hd),
+        }
+
+    return {
+        'attn_norm': {'scale': sd[p + 'input_layernorm.weight'],
+                      'bias': sd[p + 'input_layernorm.bias']},
+        'attn': {
+            'q_proj': proj('q_proj', nh),
+            'k_proj': proj('k_proj', cfg.num_kv_heads),
+            'v_proj': proj('v_proj', cfg.num_kv_heads),
+            'o_proj': {
+                'kernel': sd[p + 'self_attn.dense.weight'].T.reshape(
+                    nh, hd, d),
+                'bias': sd[p + 'self_attn.dense.bias'],
+            },
+        },
+        'mlp': {
+            'up_proj': {'kernel': sd[p + 'mlp.fc1.weight'].T,
+                        'bias': sd[p + 'mlp.fc1.bias']},
+            'down_proj': {'kernel': sd[p + 'mlp.fc2.weight'].T,
+                          'bias': sd[p + 'mlp.fc2.bias']},
         },
     }
 

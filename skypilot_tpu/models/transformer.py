@@ -244,9 +244,15 @@ class RMSNorm(nn.Module):
         return out.astype(_dtype(cfg))
 
 
-def apply_rope(x: jax.Array, positions: jax.Array,
-               theta: float) -> jax.Array:
-    """Rotary position embedding. x: (B, S, H, D); positions: (B, S)."""
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
+               rotary_dim: int = 0) -> jax.Array:
+    """Rotary position embedding. x: (B, S, H, D); positions: (B, S).
+    rotary_dim > 0 (Phi/NeoX partial rotary): only the first rotary_dim
+    dims rotate, the rest pass through unchanged."""
+    if rotary_dim and rotary_dim < x.shape[-1]:
+        rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+        return jnp.concatenate(
+            [apply_rope(rot, positions, theta), rest], axis=-1)
     d = x.shape[-1]
     half = d // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
@@ -283,8 +289,17 @@ class Attention(nn.Module):
         k = sharding.constrain(k, 'batch', 'seq', 'act_heads', None)
         v = sharding.constrain(v, 'batch', 'seq', 'act_heads', None)
         if cfg.pos_embedding == 'rope':
-            q = apply_rope(q, positions, cfg.rope_theta)
-            k = apply_rope(k, positions, cfg.rope_theta)
+            rot = 0
+            if cfg.rotary_pct != 1.0:
+                if not 0.0 < cfg.rotary_pct < 1.0:
+                    raise ValueError(
+                        f'rotary_pct must be in (0, 1], got '
+                        f'{cfg.rotary_pct}')
+                # Even (rope pairs dims) and nonzero: int() truncation
+                # to 0 would silently mean FULL rotary (the sentinel).
+                rot = max(2, int(cfg.head_dim * cfg.rotary_pct) // 2 * 2)
+            q = apply_rope(q, positions, cfg.rope_theta, rotary_dim=rot)
+            k = apply_rope(k, positions, cfg.rope_theta, rotary_dim=rot)
         if cfg.decode:
             out = self._decode_attention(q, k, v, positions)
         else:
@@ -592,7 +607,8 @@ class Transformer(nn.Module):
             logits = embed.attend(x)
         else:
             logits = dense_general(cfg, cfg.vocab_size,
-                                   ('embed', 'vocab'), 'lm_head')(x)
+                                   ('embed', 'vocab'), 'lm_head',
+                                   use_bias=cfg.lm_head_bias)(x)
         if cfg.final_logit_softcap:
             cap = cfg.final_logit_softcap
             logits = (cap * jnp.tanh(

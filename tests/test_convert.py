@@ -195,6 +195,59 @@ class TestDbrx:
                                        err_msg=k)
 
 
+class TestPhi:
+
+    def _hf(self, rotary=0.5):
+        hf_cfg = transformers.PhiConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=64,
+            rope_theta=10000.0, partial_rotary_factor=rotary,
+            layer_norm_eps=1e-5, attn_implementation='eager')
+        return transformers.PhiForCausalLM(hf_cfg)
+
+    def _cfg(self, rotary=0.5):
+        return _base_cfg(num_kv_heads=4, mlp_style='plain',
+                         mlp_activation='gelu', norm_style='layernorm',
+                         parallel_block=True, qkv_bias=True, o_bias=True,
+                         mlp_bias=True, lm_head_bias=True,
+                         rotary_pct=rotary, norm_eps=1e-5)
+
+    def test_phi_logits_match(self):
+        """Phi-2 architecture: biased parallel block, partial rotary
+        (40%-style), plain GELU, untied + biased lm_head."""
+        _logit_parity(self._hf(), self._cfg())
+
+    def test_partial_rotary_matters(self):
+        """rotary_pct must actually gate the rotation: the same weights
+        under full rotary produce different logits."""
+        import dataclasses as _dc
+        model = self._hf(rotary=0.5)
+        cfg = self._cfg(rotary=0.5)
+        params = load_hf_model(model, cfg)
+        rng = np.random.default_rng(1)
+        tokens = rng.integers(0, cfg.vocab_size, size=(1, 12))
+        partial = Transformer(cfg).apply(
+            {'params': params}, jnp.asarray(tokens, jnp.int32))
+        full = Transformer(_dc.replace(cfg, rotary_pct=1.0)).apply(
+            {'params': params}, jnp.asarray(tokens, jnp.int32))
+        assert not np.allclose(np.asarray(partial), np.asarray(full),
+                               atol=1e-3)
+
+    def test_phi_round_trip(self):
+        model = self._hf()
+        cfg = self._cfg()
+        params = load_hf_model(model, cfg)
+        from skypilot_tpu.models.convert import to_hf
+        sd = to_hf(params, cfg)
+        want = {k: v.numpy() for k, v in model.state_dict().items()
+                if 'inv_freq' not in k}
+        assert set(sd) == set(want), set(sd) ^ set(want)
+        for k in want:
+            np.testing.assert_allclose(sd[k], want[k], atol=1e-6,
+                                       err_msg=k)
+
+
 class TestFalcon:
 
     def test_falcon_parallel_block_mqa_logits_match(self):

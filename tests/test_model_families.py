@@ -185,7 +185,7 @@ class TestFamilyDecodeParity:
     learned positions and softcaps all touch the decode branch)."""
 
     @pytest.mark.parametrize('family', ['gemma', 'gemma2', 'gpt2', 'qwen',
-                                        'falcon', 'dbrx'])
+                                        'falcon', 'dbrx', 'phi'])
     def test_prefill_then_decode_matches_full(self, family):
         cfg = {
             'gemma': _gemma_tiny(),
@@ -206,6 +206,12 @@ class TestFamilyDecodeParity:
             'dbrx': _tiny(num_experts=4, experts_per_token=2,
                           moe_impl='dense', norm_style='layernorm',
                           norm_bias=False, qkv_clip=8.0),
+            # Phi: partial rotary in the decode path (cached K must
+            # carry the same part-rotated layout as prefill).
+            'phi': _tiny(mlp_style='plain', mlp_activation='gelu',
+                         norm_style='layernorm', parallel_block=True,
+                         qkv_bias=True, o_bias=True, mlp_bias=True,
+                         lm_head_bias=True, rotary_pct=0.5),
         }[family]
         engine = InferenceEngine(cfg, batch_size=1)
         tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 10), 0,
@@ -243,6 +249,7 @@ class TestRegistry:
         ('codellama-7b', 6.5e9, 7.0e9),
         ('falcon-7b', 6.6e9, 7.5e9),
         ('dbrx', 1.25e11, 1.40e11),
+        ('phi-2', 2.6e9, 2.9e9),
     ])
     def test_param_counts_in_published_range(self, name, lo, hi):
         assert lo <= get_config(name).num_params() <= hi
