@@ -314,20 +314,29 @@ class TestSpeculativeDecoding:
             + [1, 5, 7, 8, 9]
         assert draft(far, 2) is None
 
+    @pytest.mark.parametrize('kv_quant', [None, 'int8'])
     @pytest.mark.parametrize('prompt', [
         [5, 7, 11],                              # arbitrary
         [1, 2, 3, 4, 1, 2, 3, 4, 1, 2, 3, 4],   # repetitive: drafts hit
     ])
-    def test_greedy_exactly_matches_plain_decode(self, prompt):
+    def test_greedy_exactly_matches_plain_decode(self, prompt, kv_quant):
+        """Bit-identical greedy output, with both the float and the
+        int8 KV cache (the verify step writes (K+1)-token chunks
+        through the quantized per-token-scale path)."""
         from skypilot_tpu.models.inference import ContinuousBatchingEngine
-        plain = ContinuousBatchingEngine(_cfg(), num_slots=2)
+        plain = ContinuousBatchingEngine(_cfg(), num_slots=2,
+                                         kv_quant=kv_quant)
         spec = ContinuousBatchingEngine(_cfg(), num_slots=2,
-                                        speculative=4)
+                                        kv_quant=kv_quant, speculative=4)
         try:
             want, _ = plain.generate(prompt, max_new_tokens=16)
             got, stats = spec.generate(prompt, max_new_tokens=16)
             assert got == want
             assert stats['new_tokens'] == 16
+            if len(prompt) > 4:
+                # The repetitive prompt must actually exercise the
+                # verify path — otherwise this compares plain-vs-plain.
+                assert spec.spec_stats['ticks'] > 0
         finally:
             plain.stop()
             spec.stop()
