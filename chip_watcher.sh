@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-5 chip watcher: probe real TPU compute every 10 min; the moment
+# a matmul completes, run the full bench matrix (VERDICT r4 #1) and
+# stop. Writes status lines to chip_watch.log and results to
+# bench_r5_*.json at the repo root.
+cd /root/repo
+LOG=chip_watch.log
+echo "[watcher] start $(date -u +%FT%TZ)" >> "$LOG"
+while true; do
+  timeout 180 python - <<'EOF' > /tmp/chip_probe.out 2>&1
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print('COMPUTE_OK', jax.devices())
+EOF
+  rc=$?
+  if grep -q COMPUTE_OK /tmp/chip_probe.out; then
+    echo "[watcher] $(date -u +%FT%TZ) COMPUTE_OK — running bench matrix" >> "$LOG"
+    timeout 3600 python bench.py > bench_r5_main.json 2> bench_r5_main.err
+    echo "[watcher] bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    timeout 3600 python bench.py --tune-attn > bench_r5_tune.json 2> bench_r5_tune.err
+    echo "[watcher] tune-attn rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    timeout 3600 python bench.py --serve --quantize int8 --kv-quant int8 \
+      --speculative 4 --decode-chunk 8 > bench_r5_levers.json 2> bench_r5_levers.err
+    echo "[watcher] levers rc=$? $(date -u +%FT%TZ) DONE" >> "$LOG"
+    break
+  else
+    echo "[watcher] $(date -u +%FT%TZ) probe rc=$rc dead ($(tail -c 120 /tmp/chip_probe.out | tr '\n' ' '))" >> "$LOG"
+  fi
+  sleep 600
+done

@@ -487,19 +487,60 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
                     f'`gcloud storage cp -r {src} gs://<bucket>`.')
             if src.startswith('gs://'):
                 # Download on each host via gcloud storage/gsutil.
+                # rsync-first: a directory prefix mirrors EXACTLY into
+                # rdst (idempotent across recovery relaunches, no
+                # nested-dir surprise); rsync fails on a single object,
+                # where the cp fallback applies.
                 def _fetch(rec, dst=dst, src=src):
                     runner = handle._make_runner(rec)  # pylint: disable=protected-access
                     rdst = handle.resolve_remote_path(rec, dst)
+                    # rsync needs rdst to exist as a directory; when src
+                    # turns out to be a single object the just-created
+                    # empty dir is removed so cp can write rdst as a
+                    # FILE (cp keeps -r: the fallback must still handle
+                    # directory prefixes when rsync itself is absent).
                     rc = runner.run(
                         f'mkdir -p $(dirname {rdst}) && '
-                        f'(gcloud storage cp -r {src} {rdst} || '
-                        f' gsutil -m cp -r {src} {rdst})',
+                        f'( (mkdir -p {rdst} && '
+                        f'   gcloud storage rsync -r {src} {rdst}) || '
+                        f'  (rmdir {rdst} 2>/dev/null || true; '
+                        f'   gcloud storage cp -r {src} {rdst}) || '
+                        f'  (mkdir -p {rdst} && '
+                        f'   gsutil -m rsync -r {src} {rdst}) || '
+                        f'  (rmdir {rdst} 2>/dev/null || true; '
+                        f'   gsutil -m cp -r {src} {rdst}) )',
                         stream_logs=False)
                     if rc != 0:
                         raise exceptions.CommandError(
                             rc, f'download {src}', '')
 
                 subprocess_utils.run_in_parallel(_fetch, recs)
+                continue
+            if src.startswith(data_utils.LOCAL_PREFIX):
+                # local:// fake-bucket scheme (hermetic translated
+                # mounts): the bucket is a directory on this machine and
+                # fake-cloud hosts run locally, so a plain copy realizes
+                # the fetch with the same file-vs-directory semantics as
+                # the gs:// path above.
+                bucket, key = data_utils.split_local_bucket_path(src)
+                bsrc = os.path.join(data_utils.fake_bucket_dir(bucket),
+                                    key) if key else \
+                    data_utils.fake_bucket_dir(bucket)
+
+                def _fetch_local(rec, dst=dst, bsrc=bsrc):
+                    runner = handle._make_runner(rec)  # pylint: disable=protected-access
+                    rdst = handle.resolve_remote_path(rec, dst)
+                    rc = runner.run(
+                        f'mkdir -p $(dirname {rdst}) && '
+                        f'if [ -d {bsrc} ]; then mkdir -p {rdst} && '
+                        f'cp -a {bsrc}/. {rdst}/; '
+                        f'else cp {bsrc} {rdst}; fi',
+                        stream_logs=False)
+                    if rc != 0:
+                        raise exceptions.CommandError(
+                            rc, f'copy {bsrc}', '')
+
+                subprocess_utils.run_in_parallel(_fetch_local, recs)
                 continue
             source = os.path.abspath(os.path.expanduser(src))
             if not os.path.exists(source):

@@ -232,8 +232,19 @@ def main() -> int:
                 controller.strategy.terminate_cluster()
             except Exception:  # pylint: disable=broad-except
                 pass
+        _cleanup_translated_bucket(args.job_id)
         return 1
+    _cleanup_translated_bucket(args.job_id)
     return 0
+
+
+def _cleanup_translated_bucket(job_id: int) -> None:
+    """The run-scoped mount-translation bucket outlives every recovery
+    but not the job: delete it once the job is terminal."""
+    info = jobs_state.get_job_info(job_id)
+    if info and info.get('bucket_url'):
+        from skypilot_tpu.utils import controller_utils
+        controller_utils.delete_translated_bucket(info['bucket_url'])
 
 
 if __name__ == '__main__':

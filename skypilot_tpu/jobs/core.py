@@ -54,29 +54,48 @@ def launch(
 
     os.makedirs(constants.jobs_home(), exist_ok=True)
     job_id = state.set_job_info(dag.name, '')
-    dag_yaml = constants.dag_yaml_path(job_id)
-    dag_utils.dump_chain_dag_to_yaml(dag, dag_yaml)
+    # Local workdir/file_mounts → run-scoped bucket BEFORE the dag is
+    # serialized: recovery relaunches (and remote controllers) must not
+    # depend on the submitting machine's filesystem (reference:
+    # controller_utils.maybe_translate_local_file_mounts_and_sync_up,
+    # sky/utils/controller_utils.py:567).
+    from skypilot_tpu.utils import controller_utils
+    dag = dag_utils.copy_chain_dag(dag)
+    bucket_url = \
+        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+            dag, job_id=job_id)
+    if bucket_url is not None:
+        state.set_job_bucket(job_id, bucket_url)
+    try:
+        dag_yaml = constants.dag_yaml_path(job_id)
+        dag_utils.dump_chain_dag_to_yaml(dag, dag_yaml)
 
-    for task_id, t in enumerate(dag.topological_order()):
-        resources_str = ', '.join(
-            str(r.accelerators or r.cloud_name or 'cpu')
-            for r in t.resources)
-        state.set_pending(job_id, task_id, t.name or f'task-{task_id}',
-                          resources_str)
+        for task_id, t in enumerate(dag.topological_order()):
+            resources_str = ', '.join(
+                str(r.accelerators or r.cloud_name or 'cpu')
+                for r in t.resources)
+            state.set_pending(job_id, task_id, t.name or f'task-{task_id}',
+                              resources_str)
 
-    log_path = constants.controller_log_path(job_id)
-    with open(log_path, 'ab') as log_file:
-        proc = subprocess.Popen(  # pylint: disable=consider-using-with
-            [
-                sys.executable, '-m', 'skypilot_tpu.jobs.controller',
-                '--job-id', str(job_id), '--dag-yaml', dag_yaml
-            ],
-            stdout=log_file,
-            stderr=subprocess.STDOUT,
-            stdin=subprocess.DEVNULL,
-            start_new_session=True,
-            env=os.environ.copy())
-    state.set_controller_pid(job_id, proc.pid)
+        log_path = constants.controller_log_path(job_id)
+        with open(log_path, 'ab') as log_file:
+            proc = subprocess.Popen(  # pylint: disable=consider-using-with
+                [
+                    sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+                    '--job-id', str(job_id), '--dag-yaml', dag_yaml
+                ],
+                stdout=log_file,
+                stderr=subprocess.STDOUT,
+                stdin=subprocess.DEVNULL,
+                start_new_session=True,
+                env=os.environ.copy())
+        state.set_controller_pid(job_id, proc.pid)
+    except Exception:
+        # No controller will ever run its terminal-state cleanup; the
+        # just-uploaded run-scoped bucket must not leak.
+        if bucket_url is not None:
+            controller_utils.delete_translated_bucket(bucket_url)
+        raise
 
     if not detach_run:
         proc.wait()

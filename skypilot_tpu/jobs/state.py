@@ -85,6 +85,10 @@ def _create_table(cursor: sqlite3.Cursor, conn: sqlite3.Connection) -> None:
             name TEXT,
             dag_yaml_path TEXT,
             controller_pid INTEGER)""")
+    # Run-scoped bucket holding translated local file mounts (deleted by
+    # the controller when the job reaches a terminal state).
+    db_utils.add_column_if_not_exists(cursor, 'job_info', 'bucket_url',
+                                      'TEXT')
     conn.commit()
 
 
@@ -123,16 +127,25 @@ def set_controller_pid(job_id: int, pid: int) -> None:
             (pid, job_id))
 
 
+def set_job_bucket(job_id: int, bucket_url: str) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'UPDATE job_info SET bucket_url = ? WHERE spot_job_id = ?',
+            (bucket_url, job_id))
+
+
 def get_job_info(job_id: int) -> Optional[Dict[str, Any]]:
     db = _get_db()
     with db.cursor() as cursor:
         row = cursor.execute(
-            'SELECT spot_job_id, name, dag_yaml_path, controller_pid '
-            'FROM job_info WHERE spot_job_id = ?', (job_id,)).fetchone()
+            'SELECT spot_job_id, name, dag_yaml_path, controller_pid, '
+            'bucket_url FROM job_info WHERE spot_job_id = ?',
+            (job_id,)).fetchone()
     if row is None:
         return None
-    return dict(zip(('job_id', 'name', 'dag_yaml_path', 'controller_pid'),
-                    row))
+    return dict(zip(('job_id', 'name', 'dag_yaml_path', 'controller_pid',
+                     'bucket_url'), row))
 
 
 def get_job_id_by_name(name: str) -> Optional[int]:

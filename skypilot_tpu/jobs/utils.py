@@ -79,6 +79,12 @@ def update_managed_job_status(job_ids: Optional[List[int]] = None) -> None:
                 state.set_failed(
                     job_id, None, state.ManagedJobStatus.FAILED_CONTROLLER,
                     'Controller process died unexpectedly.')
+                # The dead controller never ran its own bucket cleanup;
+                # a gs:// bucket left behind bills forever.
+                if info.get('bucket_url'):
+                    from skypilot_tpu.utils import controller_utils
+                    controller_utils.delete_translated_bucket(
+                        info['bucket_url'])
 
 
 def generate_managed_job_cluster_name(task_name: str, job_id: int) -> str:

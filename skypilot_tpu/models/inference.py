@@ -562,7 +562,7 @@ class ContinuousBatchingEngine:
             if self.cfg.max_seq_len - req.next_pos <= k:
                 return None
         tokens, positions = [], []
-        any_draft = False
+        real_draft_slots = set()
         for slot in range(self.num_slots):
             req = self._slots[slot]
             if req is None:
@@ -574,11 +574,11 @@ class ContinuousBatchingEngine:
             if draft is None:
                 draft = [0] * k
             else:
-                any_draft = True
+                real_draft_slots.add(slot)
             tokens.append([req.tokens[-1]] + draft)
             positions.append(list(range(req.next_pos,
                                         req.next_pos + k + 1)))
-        if not any_draft:
+        if not real_draft_slots:
             # Every greedy slot drew a lookup blank: a verify tick would
             # emit 1 token/slot at (K+1)x forward cost — let the
             # plain/chunked path take this round instead.
@@ -595,11 +595,14 @@ class ContinuousBatchingEngine:
         import numpy as np
         out = np.asarray(out)
         accepted = np.asarray(accepted)
-        greedy_active = [i for i in active
-                         if self._slots[i].temperature <= 0]
+        # Acceptance-rate bookkeeping counts only slots that contributed
+        # a real prompt-lookup draft; [0]*k fillers for greedy slots
+        # whose n-gram lookup came up empty would inflate the
+        # denominator and under-report the true acceptance rate.
+        drafted_active = [i for i in active if i in real_draft_slots]
         self.spec_stats['ticks'] += 1
-        self.spec_stats['drafted'] += k * len(greedy_active)
-        self.spec_stats['accepted'] += int(accepted[greedy_active].sum())
+        self.spec_stats['drafted'] += k * len(drafted_active)
+        self.spec_stats['accepted'] += int(accepted[drafted_active].sum())
         valid = accepted + 1          # emit accepted drafts + 1 bonus
         return out, valid
 

@@ -129,7 +129,15 @@ def restore_params_only(cfg, checkpoint_dir: str):
             try:
                 with open(meta_path, encoding='utf-8') as f:
                     saved_keys = f.read()
-            except OSError:
+            except OSError as e:
+                # Without _METADATA the adapter-drop guard cannot run;
+                # make its absence visible instead of degrading
+                # silently back to the failure mode it exists to stop.
+                logger.warning(
+                    'Could not read %s (%s): unable to verify the '
+                    'checkpoint has no LoRA adapters — a LoRA '
+                    'checkpoint restored with lora_rank=0 would drop '
+                    'the adapters without error.', meta_path, e)
                 saved_keys = ''
             if "'lora_a'" in saved_keys or '"lora_a"' in saved_keys:
                 raise ValueError(

@@ -135,17 +135,36 @@ def main(argv=None) -> int:
         manager = CheckpointManager(
             args.checkpoint_dir,
             save_interval_steps=args.checkpoint_every)
-        if cfg.lora_rank and jax.process_index() == 0:
+        if cfg.lora_rank:
             # Sidecar so export/serving can't silently merge with the
             # wrong alpha/targets (models/export_tool reads this).
             import json
             lora_meta = os.path.join(
                 os.path.expanduser(args.checkpoint_dir), 'lora.json')
-            os.makedirs(os.path.dirname(lora_meta), exist_ok=True)
-            with open(lora_meta, 'w', encoding='utf-8') as f:
-                json.dump({'lora_rank': cfg.lora_rank,
-                           'lora_alpha': cfg.lora_alpha,
-                           'lora_targets': cfg.lora_targets}, f)
+            meta = {'lora_rank': cfg.lora_rank,
+                    'lora_alpha': cfg.lora_alpha,
+                    'lora_targets': cfg.lora_targets}
+            if os.path.exists(lora_meta):
+                # The sidecar is the source of truth for the run that
+                # created this checkpoint dir; resuming with different
+                # adapter flags must not silently rewrite it. EVERY
+                # process that can see the file checks BEFORE the
+                # restore below (a cross-process collective): if only
+                # rank 0 exited here, the other ranks would hang at the
+                # restore barrier instead of erroring.
+                with open(lora_meta, 'r', encoding='utf-8') as f:
+                    existing = json.load(f)
+                if existing != meta:
+                    raise SystemExit(
+                        f'LoRA flags do not match the existing sidecar '
+                        f'{lora_meta}: checkpoint was written with '
+                        f'{existing}, current flags are {meta}. Resume '
+                        f'with the original flags or use a fresh '
+                        f'--checkpoint-dir.')
+            elif jax.process_index() == 0:
+                os.makedirs(os.path.dirname(lora_meta), exist_ok=True)
+                with open(lora_meta, 'w', encoding='utf-8') as f:
+                    json.dump(meta, f)
         state, start_step = manager.maybe_restore(state)
     if args.init_from_hf and start_step == 0:
         # Fine-tune from a local HF checkpoint: convert on host, place

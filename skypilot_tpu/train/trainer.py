@@ -201,6 +201,17 @@ def make_train_step(
             }
             loss, grads = jax.value_and_grad(loss_fn)(state.params,
                                                       batch)
+            if cfg.lora_rank > 0:
+                # Zero the frozen-base grads (the optimizer discards
+                # them via set_to_zero anyway) so the reported
+                # grad_norm matches the accumulation path below —
+                # otherwise toggling --grad-accum would discontinuously
+                # change the metric under LoRA.
+                grads = jax.tree_util.tree_map_with_path(
+                    lambda path, g: g if any(
+                        getattr(k, 'key', None) in ('lora_a', 'lora_b')
+                        for k in path) else jnp.zeros_like(g),
+                    grads)
         else:
             # Gradient accumulation: lax.scan over A microbatches —
             # activation memory is ONE microbatch's, so the effective
@@ -230,24 +241,40 @@ def make_train_step(
                 for k, v in batch.items()
             }
 
+            # With LoRA the base weights are frozen (set_to_zero in the
+            # optimizer), so a full param-shaped fp32 carry would burn
+            # HBM on gradients that are discarded — the accumulator
+            # holds real buffers only for adapter leaves and scalar
+            # placeholders for frozen ones (same path test as the
+            # optimizer's label_fn).
+            def _is_trained(path):
+                return cfg.lora_rank == 0 or any(
+                    getattr(k, 'key', None) in ('lora_a', 'lora_b')
+                    for k in path)
+
             def acc(carry, mb):
                 mb = {k: sharding_lib.constrain(v, 'batch', 'seq')
                       for k, v in mb.items()}
                 loss_i, grads_i = jax.value_and_grad(loss_fn)(
                     state.params, mb)
                 acc_loss, acc_grads = carry
-                acc_grads = jax.tree.map(
-                    lambda a, g: a + g.astype(jnp.float32),
+                acc_grads = jax.tree_util.tree_map_with_path(
+                    lambda path, a, g: (a + g.astype(jnp.float32)
+                                        if _is_trained(path) else a),
                     acc_grads, grads_i)
                 return (acc_loss + loss_i, acc_grads), None
 
-            zero = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            zero = jax.tree_util.tree_map_with_path(
+                lambda path, p: jnp.zeros(
+                    p.shape if _is_trained(path) else (), jnp.float32),
+                state.params)
             (loss, grads), _ = jax.lax.scan(acc, (jnp.float32(0.0), zero),
                                             micro)
             loss = loss / grad_accum
-            grads = jax.tree.map(
-                lambda g, p: (g / grad_accum).astype(p.dtype),
+            grads = jax.tree_util.tree_map_with_path(
+                lambda path, g, p: ((g / grad_accum).astype(p.dtype)
+                                    if _is_trained(path)
+                                    else jnp.zeros(p.shape, p.dtype)),
                 grads, state.params)
         new_state = state.apply_gradients(grads=grads)
         metrics = {

@@ -1,0 +1,154 @@
+"""Translate local file mounts to run-scoped bucket storage.
+
+Reference parity: sky/utils/controller_utils.py:567
+(`maybe_translate_local_file_mounts_and_sync_up`) — a managed job's
+recovery relaunches (and, with remote controllers, the initial launch)
+run on a machine that is NOT the submitting workstation, so anything the
+task reads from the local filesystem (workdir, local file_mounts) must
+be uploaded once to a run-scoped bucket at submit time and the task
+rewritten to fetch from there.
+
+Bucket layout (one bucket per managed job, shared across a chain):
+
+    gs://skytpu-jobs-<user>-<job_id>/
+        t0/workdir/...        # task 0's workdir, if any
+        t0/mounts/0           # task 0's first local file mount (file)
+        t0/mounts/1/...       # ... second (directory)
+        t1/...
+
+The workdir becomes a file mount onto ``~/sky_workdir`` — the backend
+runs setup/run from there regardless of how it was populated
+(cloud_tpu_backend.WORKDIR), so the translated task behaves identically.
+On the fake cloud the bucket is a ``local://`` store, which keeps the
+whole path hermetically testable.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import tempfile
+import typing
+from typing import Optional
+
+from skypilot_tpu.data import data_utils
+from skypilot_tpu.utils import common_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import dag as dag_lib
+
+logger = logging.getLogger(__name__)
+
+# The backend cds into this for setup/run (cloud_tpu_backend.WORKDIR).
+_WORKDIR_DST = '~/sky_workdir'
+
+
+def translated_bucket_name(prefix: str, job_id: int) -> str:
+    user = common_utils.get_user_hash()[:8].lower()
+    return f'skytpu-{prefix}-{user}-{job_id}'
+
+
+def _is_local_source(src: str) -> bool:
+    # Any URI scheme (gs://, s3://, and the unsupported r2://-style
+    # ones, which task validation rejects with an actionable message)
+    # is not a local path; treating it as one would produce a
+    # misleading 'local source not found' here.
+    return '://' not in src
+
+
+def _needs_translation(task) -> bool:
+    if task.workdir is not None:
+        return True
+    return any(_is_local_source(src)
+               for src in (task.file_mounts or {}).values())
+
+
+def maybe_translate_local_file_mounts_and_sync_up(
+        dag: 'dag_lib.Dag', job_id: int,
+        prefix: str = 'jobs') -> Optional[str]:
+    """Uploads every task's workdir + local file mounts to one
+    run-scoped bucket and rewrites the tasks to fetch from it.
+
+    Mutates the dag in place. Returns the bucket URL (``gs://...`` or
+    ``local://...``) when a bucket was created, else None — the caller
+    records it so the controller can delete the bucket when the job
+    reaches a terminal state.
+    """
+    tasks = list(dag.topological_order())
+    if not any(_needs_translation(t) for t in tasks):
+        return None
+
+    from skypilot_tpu.data import storage as storage_lib
+
+    bucket = translated_bucket_name(prefix, job_id)
+    staging = tempfile.mkdtemp(prefix='skytpu-mount-translate-')
+    # dst-path rewrites deferred until after the upload succeeds, so a
+    # failed upload leaves the dag untouched.
+    rewrites = []  # (task, new_workdir_uri_or_None, {dst: uri})
+    try:
+        for i, task in enumerate(tasks):
+            workdir_uri = None
+            mount_uris = {}
+            if task.workdir is not None:
+                src = os.path.abspath(os.path.expanduser(task.workdir))
+                shutil.copytree(
+                    src, os.path.join(staging, f't{i}', 'workdir'),
+                    ignore=shutil.ignore_patterns('.git'))
+                workdir_uri = f't{i}/workdir'
+            for j, (dst, msrc) in enumerate(
+                    sorted((task.file_mounts or {}).items())):
+                if not _is_local_source(msrc):
+                    continue
+                expanded = os.path.abspath(os.path.expanduser(msrc))
+                if not os.path.exists(expanded):
+                    raise ValueError(
+                        f'file_mounts[{dst!r}]: local source {msrc!r} '
+                        f'not found.')
+                key = os.path.join(f't{i}', 'mounts', str(j))
+                target = os.path.join(staging, key)
+                if os.path.isdir(expanded):
+                    shutil.copytree(
+                        expanded, target,
+                        ignore=shutil.ignore_patterns('.git'))
+                else:
+                    os.makedirs(os.path.dirname(target), exist_ok=True)
+                    shutil.copy2(expanded, target)
+                mount_uris[dst] = key
+            rewrites.append((task, workdir_uri, mount_uris))
+
+        storage = storage_lib.Storage(name=bucket, source=staging,
+                                      mode=storage_lib.StorageMode.COPY,
+                                      persistent=False)
+        storage.construct()
+        url_base = storage.primary_store().url()
+    finally:
+        shutil.rmtree(staging, ignore_errors=True)
+
+    for task, workdir_uri, mount_uris in rewrites:
+        new_mounts = dict(task.file_mounts or {})
+        if workdir_uri is not None:
+            task.workdir = None
+            new_mounts[_WORKDIR_DST] = f'{url_base}/{workdir_uri}'
+        for dst, key in mount_uris.items():
+            new_mounts[dst] = f'{url_base}/{key}'
+        if new_mounts:
+            task.set_file_mounts(new_mounts)
+        logger.info('Translated local file mounts of task %r to %s',
+                    task.name, url_base)
+    return url_base
+
+
+def delete_translated_bucket(bucket_url: str) -> None:
+    """Best-effort deletion of a run-scoped bucket at job termination."""
+    from skypilot_tpu.data import storage as storage_lib
+
+    store_type = storage_lib.StoreType.from_source(bucket_url)
+    bucket, _ = (data_utils.split_gcs_path(bucket_url)
+                 if bucket_url.startswith(data_utils.GCS_PREFIX) else
+                 data_utils.split_local_bucket_path(bucket_url))
+    try:
+        store = storage_lib._STORE_CLASSES[store_type](bucket, None)  # pylint: disable=protected-access
+        store.delete()
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning('Could not delete run-scoped bucket %s: %s',
+                       bucket_url, e)

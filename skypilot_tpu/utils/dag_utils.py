@@ -24,6 +24,22 @@ def convert_entrypoint_to_dag(
     return dag
 
 
+def copy_chain_dag(dag: 'dag_lib.Dag') -> 'dag_lib.Dag':
+    """Deep-enough copy of a chain dag: task specs are copied so callers
+    that rewrite them (file-mount translation) don't mutate the user's
+    Task objects."""
+    assert dag.is_chain(), 'copy_chain_dag expects a chain DAG.'
+    new = dag_lib.Dag(name=dag.name)
+    prev = None
+    for task in dag.topological_order():
+        copied = task.copy()
+        new.add(copied)
+        if prev is not None:
+            new.add_edge(prev, copied)
+        prev = copied
+    return new
+
+
 def dump_chain_dag_to_yaml(dag: 'dag_lib.Dag', path: str) -> None:
     assert dag.is_chain(), 'Managed jobs only support chain DAGs.'
     configs = [{'name': dag.name}]

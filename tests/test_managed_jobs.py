@@ -218,6 +218,57 @@ class TestManagedJobEndToEnd:
         rec2 = global_user_state.get_cluster_from_name('ev2-cl')
         assert rec2['handle'].launched_resources.zone == zone0
 
+    def test_file_mount_translation_survives_source_deletion(
+            self, tmp_path):
+        """VERDICT r4 #3: local workdir + file_mounts are uploaded to a
+        run-scoped bucket at submit; the job must succeed (and recover)
+        with the original local files gone."""
+        import shutil
+        workdir = tmp_path / 'wd'
+        workdir.mkdir()
+        (workdir / 'hello.txt').write_text('hi-wd')
+        datafile = tmp_path / 'data.txt'
+        datafile.write_text('hi-file')
+        datadir = tmp_path / 'ddir'
+        datadir.mkdir()
+        (datadir / 'inner.txt').write_text('hi-dir')
+        task = _task(
+            run='grep -q hi-wd hello.txt && '
+                'grep -q hi-file ~/input/data.txt && '
+                'grep -q hi-dir ~/ddir/inner.txt',
+            name='fmt', workdir=str(workdir))
+        task.set_file_mounts({
+            '~/input/data.txt': str(datafile),
+            '~/ddir': str(datadir),
+        })
+        job_id = jobs_core.launch(task, detach_run=True)
+        # The submitting machine's copies disappear right after submit.
+        shutil.rmtree(workdir)
+        datafile.unlink()
+        shutil.rmtree(datadir)
+        # The caller's Task object was not mutated by translation.
+        assert task.workdir == str(workdir)
+        assert task.file_mounts['~/input/data.txt'] == str(datafile)
+        info = jobs_state.get_job_info(job_id)
+        assert info['bucket_url'].startswith('local://')
+        assert _wait_status(job_id, _TERMINAL) == ManagedJobStatus.SUCCEEDED
+        # The run-scoped bucket is deleted once the job is terminal (the
+        # controller runs cleanup AFTER writing the terminal status, so
+        # poll rather than assert instantly).
+        import os
+        from skypilot_tpu.data import data_utils
+        bucket, _ = data_utils.split_local_bucket_path(info['bucket_url'])
+        deadline = time.time() + 30
+        while time.time() < deadline and os.path.exists(
+                data_utils.fake_bucket_dir(bucket)):
+            time.sleep(0.2)
+        assert not os.path.exists(data_utils.fake_bucket_dir(bucket))
+
+    def test_translation_noop_without_local_sources(self):
+        job_id = jobs_core.launch(_task(), detach_run=True)
+        assert jobs_state.get_job_info(job_id)['bucket_url'] is None
+        assert _wait_status(job_id, _TERMINAL) == ManagedJobStatus.SUCCEEDED
+
     def test_dead_controller_detection(self):
         import os
         import signal
