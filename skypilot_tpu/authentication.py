@@ -14,7 +14,7 @@ import getpass
 import logging
 import os
 import subprocess
-from typing import Tuple
+from typing import Optional, Tuple
 
 import filelock
 
@@ -108,3 +108,120 @@ def gcp_ssh_keys_metadata(user: str = 'skytpu') -> str:
     with open(public_path, encoding='utf-8') as f:
         public_key = f.read().strip()
     return f'{user}:{public_key}'
+
+
+# ---------------- GCP OS-Login ----------------
+# Orgs can enforce OS-Login project-wide (enable-oslogin=TRUE in project
+# metadata); instance `ssh-keys` metadata is then IGNORED and keys must be
+# registered against the caller's OS-Login profile instead (reference:
+# sky/authentication.py:148-230).
+
+_OSLOGIN_API_ROOT = 'https://oslogin.googleapis.com/v1'
+
+# (method, url, body) -> (status, payload); tests inject a fake.
+_oslogin_transport = None
+
+
+def set_oslogin_transport_override(transport) -> None:
+    global _oslogin_transport
+    _oslogin_transport = transport
+
+
+def _oslogin_call(method: str, url: str, body):
+    if _oslogin_transport is not None:
+        return _oslogin_transport(method, url, body)
+    import google.auth
+    import google.auth.transport.requests
+    creds, _ = google.auth.default(
+        scopes=['https://www.googleapis.com/auth/cloud-platform'])
+    session = google.auth.transport.requests.AuthorizedSession(creds)
+    resp = session.request(method, url, json=body)
+    try:
+        payload = resp.json()
+    except ValueError:
+        payload = {'error': {'message': resp.text}}
+    return resp.status_code, payload
+
+
+def _gcp_account_email() -> str:
+    import google.auth
+    creds, _ = google.auth.default()
+    email = getattr(creds, 'service_account_email', None)
+    if email and email != 'default':
+        return email
+    proc = subprocess.run(
+        ['gcloud', 'config', 'get-value', 'account'],
+        capture_output=True, text=True, check=False)
+    account = proc.stdout.strip()
+    if proc.returncode == 0 and account and account != '(unset)':
+        return account
+    raise RuntimeError(
+        'Could not determine the GCP account email for OS-Login '
+        '(no service account credentials and `gcloud config get-value '
+        'account` is unset).')
+
+
+def project_enables_oslogin(project: str) -> bool:
+    """True when project metadata carries enable-oslogin=TRUE."""
+    from skypilot_tpu.provision.gcp import compute_api
+    proj = compute_api.ComputeClient(project).get_project()
+    items = (proj.get('commonInstanceMetadata') or {}).get('items') or []
+    for item in items:
+        if item.get('key') == 'enable-oslogin':
+            return str(item.get('value', '')).upper() == 'TRUE'
+    return False
+
+
+def import_oslogin_key(project: str,
+                       email: Optional[str] = None) -> str:
+    """Registers the framework public key with the caller's OS-Login
+    profile; returns the profile's primary POSIX username (the ssh
+    user for every instance in the project)."""
+    _, public_path = get_or_generate_keys()
+    with open(public_path, encoding='utf-8') as f:
+        public_key = f.read().strip()
+    email = email or _gcp_account_email()
+    url = (f'{_OSLOGIN_API_ROOT}/users/{email}:importSshPublicKey'
+           f'?projectId={project}')
+    status, payload = _oslogin_call('POST', url, {'key': public_key})
+    if status >= 300:
+        message = payload.get('error', {}).get('message', str(payload))
+        raise RuntimeError(f'OS-Login key import failed ({status}): '
+                           f'{message}')
+    accounts = payload.get('loginProfile', {}).get('posixAccounts', [])
+    for acc in accounts:
+        if acc.get('primary'):
+            return acc['username']
+    if accounts:
+        return accounts[0]['username']
+    # Documented fallback derivation: user@example.com -> user_example_com.
+    return email.replace('@', '_').replace('.', '_')
+
+
+def setup_gcp_authentication(project: str) -> Tuple[Optional[str], str]:
+    """Decide + execute the GCP key-injection path for one project.
+
+    Returns (ssh_keys_metadata_or_None, ssh_user):
+    - OS-Login enforced: key imported to the caller's OS-Login profile,
+      no instance metadata, ssh user = the profile's POSIX username.
+    - Otherwise: classic metadata `ssh-keys` with the 'skytpu' user.
+    Detection failures (missing credentials in hermetic runs, API
+    errors) fall back to the metadata path with a warning — the
+    historical behavior.
+    """
+    try:
+        enforced = project_enables_oslogin(project)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(
+            'OS-Login detection failed for project %s (%s); using '
+            'instance-metadata ssh-keys.', project, e)
+        enforced = False
+    if enforced:
+        # DETECTION succeeded: metadata keys are known to be ignored on
+        # this project, so a failed key import must raise — falling back
+        # would create VMs that bill but can never be SSHed.
+        username = import_oslogin_key(project)
+        logger.info('OS-Login enforced on project %s; ssh user %s.',
+                    project, username)
+        return None, username
+    return gcp_ssh_keys_metadata(user='skytpu'), 'skytpu'

@@ -137,9 +137,17 @@ class CloudTpuResourceHandle(backend_lib.ResourceHandle):
 
     # --- host table / runners ---
     def _fake_host_home(self, slice_index: int, host_id: int) -> str:
-        base = os.path.expanduser(
-            os.environ.get('SKYTPU_HOME', '~/.skytpu'))
-        return os.path.join(base, 'hosts', self.cluster_name,
+        # A fake host's disk belongs to the (fake) CLOUD, not to the
+        # client that launched it: with the override set, deleting the
+        # client's state dir leaves "remote VMs" intact — which is what
+        # the remote-controller e2e relies on (a real VM's disk
+        # obviously survives the client machine).
+        root = os.environ.get('SKYTPU_FAKE_HOSTS_ROOT')
+        if root is None:
+            root = os.path.join(
+                os.path.expanduser(
+                    os.environ.get('SKYTPU_HOME', '~/.skytpu')), 'hosts')
+        return os.path.join(root, self.cluster_name,
                             f's{slice_index}h{host_id}')
 
     def host_records(self) -> List[Dict[str, Any]]:
@@ -339,12 +347,34 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
         # processes) skips keys — an unresolved (None) cloud defaults to
         # real GCP in the provisioner, so it MUST get a key.
         needs_keys = to_provision.cloud_name != 'fake'
+        ssh_user = DEFAULT_SSH_USER
+        authorized_key = None
+        if needs_keys:
+            if to_provision.cloud_name in (None, 'gcp'):
+                # GCP has two key paths: OS-Login (enforced org-wide via
+                # project metadata; instance ssh-keys are IGNORED there)
+                # and classic metadata keys. setup_gcp_authentication
+                # detects and handles both (reference:
+                # sky/authentication.py:148).
+                from skypilot_tpu import authentication
+                from skypilot_tpu.clouds import gcp as gcp_cloud
+                project = None
+                try:
+                    project = gcp_cloud.GCP.get_project_id()
+                except Exception:  # pylint: disable=broad-except
+                    pass
+                if project:
+                    authorized_key, ssh_user = \
+                        authentication.setup_gcp_authentication(project)
+                else:
+                    authorized_key = self._authorized_key(generate=True)
+            else:
+                authorized_key = self._authorized_key(generate=True)
         while True:
             try:
                 result = engine.provision_with_retries(
                     cluster_name, candidates,
-                    authorized_key=self._authorized_key(
-                        generate=needs_keys))
+                    authorized_key=authorized_key)
                 break
             except exceptions.ResourcesUnavailableError:
                 if not retry_until_up:
@@ -358,7 +388,8 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
                     blocked_resources=blocked_resources)
 
         handle = CloudTpuResourceHandle(cluster_name, result.resources,
-                                        result.cluster_info)
+                                        result.cluster_info,
+                                        ssh_user=ssh_user)
         handle.provider_extras = result.provider_config
         self._post_provision_setup(handle)
         backend_utils.update_cluster_ssh_config(cluster_name, handle)
@@ -494,20 +525,23 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
                 def _fetch(rec, dst=dst, src=src):
                     runner = handle._make_runner(rec)  # pylint: disable=protected-access
                     rdst = handle.resolve_remote_path(rec, dst)
-                    # rsync needs rdst to exist as a directory; when src
-                    # turns out to be a single object the just-created
-                    # empty dir is removed so cp can write rdst as a
-                    # FILE (cp keeps -r: the fallback must still handle
-                    # directory prefixes when rsync itself is absent).
+                    # rsync needs rdst to exist as a directory; before a
+                    # cp fallback the dir is REMOVED (rm -rf, not rmdir:
+                    # a partially-completed rsync leaves files behind,
+                    # and `cp -r prefix existing-dir/` would nest the
+                    # source under rdst/<basename> while exiting 0).
+                    # Mount destinations are owned by the mount, so
+                    # clearing is safe; cp keeps -r so directory
+                    # prefixes still work when rsync itself is absent.
                     rc = runner.run(
                         f'mkdir -p $(dirname {rdst}) && '
                         f'( (mkdir -p {rdst} && '
                         f'   gcloud storage rsync -r {src} {rdst}) || '
-                        f'  (rmdir {rdst} 2>/dev/null || true; '
+                        f'  (rm -rf {rdst}; '
                         f'   gcloud storage cp -r {src} {rdst}) || '
-                        f'  (mkdir -p {rdst} && '
+                        f'  (rm -rf {rdst}; mkdir -p {rdst} && '
                         f'   gsutil -m rsync -r {src} {rdst}) || '
-                        f'  (rmdir {rdst} 2>/dev/null || true; '
+                        f'  (rm -rf {rdst}; '
                         f'   gsutil -m cp -r {src} {rdst}) )',
                         stream_logs=False)
                     if rc != 0:

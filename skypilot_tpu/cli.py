@@ -553,18 +553,23 @@ def jobs():
 @jobs.command('launch')
 @click.argument('entrypoint', nargs=-1)
 @_with_task_options
+@click.option('--remote', is_flag=True, default=False,
+              help='Run the controller on a dedicated controller cluster '
+                   'so recovery survives this machine (reference: '
+                   'jobs-controller.yaml.j2).')
 @click.option('--yes', '-y', is_flag=True, default=False)
 def jobs_launch(entrypoint, name, workdir, cloud, region, zone,
                 accelerators, num_slices, use_spot, env, env_file, ports,
-                yes):
+                remote, yes):
     """Launch a managed job (provision + monitor + recover)."""
     task = _make_task(entrypoint, name, workdir, cloud, region, zone,
                       accelerators, num_slices, use_spot, env, ports,
                       env_file=env_file)
     _confirm(f'Launching managed job {task.name!r}. Proceed?', yes)
-    job_id = sky.jobs.launch(task, name=task.name)
-    click.echo(f'Managed job {job_id} submitted. '
-               f'`skytpu jobs logs {job_id}` to stream.')
+    job_id = sky.jobs.launch(task, name=task.name, remote=remote)
+    click.echo(f'Managed job {job_id} submitted'
+               + (' (remote controller)' if remote else '') +
+               f'. `skytpu jobs logs {job_id}` to stream.')
 
 
 @jobs.command('queue')
@@ -652,8 +657,11 @@ def serve():
 @click.option('--service-name', '-n', default=None)
 @click.option('--env', multiple=True, help='KEY=VALUE (repeatable).')
 @click.option('--env-file', default=None)
+@click.option('--remote', is_flag=True, default=False,
+              help='Run the service runner on a dedicated controller '
+                   'cluster so the fleet survives this machine.')
 @click.option('--yes', '-y', is_flag=True, default=False)
-def serve_up(entrypoint, service_name, env, env_file, yes):
+def serve_up(entrypoint, service_name, env, env_file, remote, yes):
     """Bring up a service from a task YAML with a `service:` section."""
     task = _make_task(entrypoint, None, None, None, None, None, None, None,
                       None, env, (), env_file=env_file)
@@ -662,7 +670,7 @@ def serve_up(entrypoint, service_name, env, env_file, yes):
     _confirm(f'Starting service {service_name or task.name!r}. Proceed?',
              yes)
     try:
-        result = sky.serve.up(task, service_name)
+        result = sky.serve.up(task, service_name, remote=remote)
     except (ValueError, exceptions.ServeUserTerminatedError) as e:
         _fail(str(e))
     click.echo(f"Service {result['name']!r} starting; endpoint: "

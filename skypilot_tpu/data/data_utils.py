@@ -29,7 +29,15 @@ _BUCKET_NAME_RE = re.compile(r'^[a-z0-9][a-z0-9._-]{1,61}[a-z0-9]$')
 
 
 def is_cloud_uri(path: str) -> bool:
-    return path.startswith((GCS_PREFIX, LOCAL_PREFIX))
+    return path.startswith((GCS_PREFIX, LOCAL_PREFIX, S3_PREFIX))
+
+
+def split_s3_path(s3_path: str) -> Tuple[str, str]:
+    """s3://bucket/key/parts → (bucket, key/parts)."""
+    assert s3_path.startswith(S3_PREFIX), s3_path
+    rest = s3_path[len(S3_PREFIX):]
+    bucket, _, key = rest.partition('/')
+    return bucket, key
 
 
 def split_gcs_path(gcs_path: str) -> Tuple[str, str]:

@@ -35,6 +35,7 @@ class StoreType(enum.Enum):
     """(reference: StoreType, storage.py:109)"""
     GCS = 'GCS'
     LOCAL = 'LOCAL'
+    S3 = 'S3'
 
     @classmethod
     def from_source(cls, source: str) -> 'StoreType':
@@ -42,6 +43,8 @@ class StoreType(enum.Enum):
             return cls.GCS
         if source.startswith(data_utils.LOCAL_PREFIX):
             return cls.LOCAL
+        if source.startswith(data_utils.S3_PREFIX):
+            return cls.S3
         raise exceptions.StorageSpecError(
             f'Unknown storage URI scheme: {source!r}')
 
@@ -213,9 +216,62 @@ class LocalStore(AbstractStore):
             self.bucket_dir, mount_path)
 
 
+class S3Store(AbstractStore):
+    """READ store for s3:// sources (reference: S3Store,
+    sky/data/storage.py:1080-1496).
+
+    GCS-first twist: the reference mounts S3 per-host with goofys; TPU
+    hosts speak GCS natively (gcsfuse, gcloud storage), so here the S3
+    bucket is mirrored ONCE, server-side, into a deterministic GCS
+    bucket via Storage Transfer Service (data_transfer.import_s3_source)
+    and every host-side command serves from the mirror — the S3 data
+    crosses clouds exactly once instead of per-host. Write-back to S3 is
+    not supported; GCS is the write path in this build.
+    """
+
+    STORE_TYPE = StoreType.S3
+
+    def __init__(self, name: str, source: Optional[str] = None) -> None:
+        super().__init__(name, source)
+        self._mirror_bucket: Optional[str] = None
+
+    def _mirror(self) -> GcsStore:
+        if self._mirror_bucket is None:
+            from skypilot_tpu.data import data_transfer
+            gs_uri = data_transfer.import_s3_source(f's3://{self.name}')
+            self._mirror_bucket, _ = data_utils.split_gcs_path(gs_uri)
+        return GcsStore(self._mirror_bucket, None)
+
+    def url(self) -> str:
+        return f's3://{self.name}'
+
+    def initialize(self) -> None:
+        # Run (or incrementally refresh) the server-side mirror now, at
+        # spec time — not mid-provision on the hosts.
+        self._mirror()
+
+    def upload(self) -> None:
+        raise exceptions.StorageError(
+            f's3://{self.name} is a read-only import source in this '
+            f'GCS-first build; write to a gs:// bucket instead.')
+
+    def delete(self) -> None:
+        # Deletes the GCS MIRROR only — never the user's S3 bucket.
+        from skypilot_tpu.data import data_transfer
+        mirror = data_transfer.mirror_bucket_name(self.name)
+        GcsStore(mirror, None).delete()
+
+    def mount_command(self, mount_path: str) -> str:
+        return self._mirror().mount_command(mount_path)
+
+    def copy_down_command(self, dst: str) -> str:
+        return self._mirror().copy_down_command(dst)
+
+
 _STORE_CLASSES = {
     StoreType.GCS: GcsStore,
     StoreType.LOCAL: LocalStore,
+    StoreType.S3: S3Store,
 }
 
 
@@ -240,10 +296,12 @@ class Storage:
         - name only: an empty "scratch" bucket (checkpoints land here).
         """
         if source is not None and data_utils.is_cloud_uri(source):
-            bucket, key = (
-                data_utils.split_gcs_path(source)
-                if source.startswith(data_utils.GCS_PREFIX) else
-                data_utils.split_local_bucket_path(source))
+            if source.startswith(data_utils.GCS_PREFIX):
+                bucket, key = data_utils.split_gcs_path(source)
+            elif source.startswith(data_utils.S3_PREFIX):
+                bucket, key = data_utils.split_s3_path(source)
+            else:
+                bucket, key = data_utils.split_local_bucket_path(source)
             if key:
                 # Silently mounting/copying the WHOLE bucket when the user
                 # named a prefix would read wrong data; prefixes belong in
@@ -334,7 +392,7 @@ class Storage:
 
     def primary_store(self) -> AbstractStore:
         assert self.stores, f'Storage {self.name!r} has no stores.'
-        for preferred in (StoreType.GCS, StoreType.LOCAL):
+        for preferred in (StoreType.GCS, StoreType.S3, StoreType.LOCAL):
             if preferred in self.stores:
                 return self.stores[preferred]
         return next(iter(self.stores.values()))

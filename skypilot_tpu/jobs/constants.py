@@ -56,9 +56,27 @@ def recovery_wait_seconds() -> float:
 MAX_LAUNCH_RETRIES = int(os.environ.get('SKYTPU_JOBS_MAX_LAUNCH_RETRIES',
                                         '3'))
 
+
+# Cap on concurrently-running LOCAL controller processes; jobs beyond it
+# queue and start as slots free up (reference sizing: ~4 controller
+# processes per vCPU on the controller VM, sky/jobs/constants.py:16).
+def max_local_controllers() -> int:
+    env = os.environ.get('SKYTPU_JOBS_MAX_LOCAL_CONTROLLERS')
+    if env:
+        return max(1, int(env))
+    return 4 * (os.cpu_count() or 1)
+
 # Managed-job cluster names are <task-name>-<job_id> (reference generates
 # unique cluster names per managed job, jobs/utils.py).
 JOB_CLUSTER_NAME_PREFIX = 'skytpu-jobs'
+
+
+# One controller cluster per user, shared by that user's remote managed
+# jobs (reference: JOB_CONTROLLER_NAME, sky/jobs/utils.py — a dedicated
+# SkyPilot cluster named sky-jobs-controller-<user-hash>).
+def controller_cluster_name() -> str:
+    from skypilot_tpu.utils import common_utils
+    return f'skytpu-jobs-controller-{common_utils.get_user_hash()[:8]}'
 
 # Stable across recoveries; exported into the task env so user programs can
 # key checkpoints on it (reference: SKYPILOT_TASK_ID,

@@ -209,21 +209,17 @@ class JobsController:
                 return
 
 
-def main() -> int:
-    parser = argparse.ArgumentParser(description='Managed-jobs controller.')
-    parser.add_argument('--job-id', type=int, required=True)
-    parser.add_argument('--dag-yaml', type=str, required=True)
-    args = parser.parse_args()
-    logging.basicConfig(
-        level=logging.INFO,
-        format='%(asctime)s %(levelname)s %(name)s: %(message)s')
-    controller = JobsController(args.job_id, args.dag_yaml)
+def run_controller(job_id: int, dag_yaml: str) -> int:
+    """Run one job's controller loop to completion with terminal-state
+    bookkeeping; shared by the local daemon entrypoint below and the
+    remote-controller bootstrap (jobs/remote_controller.py)."""
+    controller = JobsController(job_id, dag_yaml)
     try:
         controller.run()
     except Exception:  # pylint: disable=broad-except
         logger.error('Controller crashed:\n%s', traceback.format_exc())
         jobs_state.set_failed(
-            args.job_id, None,
+            job_id, None,
             jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
             traceback.format_exc(limit=3))
         # Best-effort cleanup of the task cluster.
@@ -232,10 +228,21 @@ def main() -> int:
                 controller.strategy.terminate_cluster()
             except Exception:  # pylint: disable=broad-except
                 pass
-        _cleanup_translated_bucket(args.job_id)
+        _cleanup_translated_bucket(job_id)
         return 1
-    _cleanup_translated_bucket(args.job_id)
+    _cleanup_translated_bucket(job_id)
     return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description='Managed-jobs controller.')
+    parser.add_argument('--job-id', type=int, required=True)
+    parser.add_argument('--dag-yaml', type=str, required=True)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(levelname)s %(name)s: %(message)s')
+    return run_controller(args.job_id, args.dag_yaml)
 
 
 def _cleanup_translated_bucket(job_id: int) -> None:

@@ -1,0 +1,136 @@
+"""Client side of remote (controller-cluster) managed jobs.
+
+Reference parity: sky/jobs/core.py:30-137 + templates/
+jobs-controller.yaml.j2:32-36 — `jobs launch` brings up (or reuses) a
+dedicated controller cluster via the ordinary launch stack and submits
+each managed job to it as a task whose run command is the controller
+module; queue/cancel then talk to that cluster by codegen-RPC
+(ManagedJobCodeGen, sky/jobs/utils.py), because the truth about a remote
+job lives in the CONTROLLER's database, not the client's.
+
+The controller outlives the client machine: once `launch_remote`
+returns, the client's state dir can disappear and the job still
+monitors, recovers from preemptions, and tears down.
+"""
+from __future__ import annotations
+
+import logging
+import shlex
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.agent import constants as agent_constants
+from skypilot_tpu.jobs import constants
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import dag as dag_lib
+
+logger = logging.getLogger(__name__)
+
+# Where the client mounts each job's dag yaml on the controller host.
+_REMOTE_DAG_DIR = '~/managed-dags'
+
+
+def _controller_resources(dag: 'dag_lib.Dag'):
+    """Controller host resources: same cloud as the job's first task (so
+    fake-cloud jobs get a fake controller), no accelerator constraint —
+    the optimizer resolves that to the cheapest single-host slice.
+    (Deviation from the reference's 8-vCPU CPU VM, jobs/constants.py:16:
+    this build's provisioners are TPU-first, so the controller rides the
+    smallest dev slice; its chips idle.)"""
+    from skypilot_tpu import resources as resources_lib
+    cloud = None
+    for task in dag.tasks:
+        for res in task.resources:
+            if res.cloud_name is not None:
+                cloud = res.cloud_name
+                break
+        if cloud:
+            break
+    return {resources_lib.Resources(cloud=cloud)}
+
+
+def launch_remote(dag: 'dag_lib.Dag', job_id: int, dag_yaml: str,
+                  bucket_url: Optional[str] = None) -> str:
+    """Submits one managed job to the (shared, launched-on-demand)
+    controller cluster. Returns the controller cluster name."""
+    from skypilot_tpu import execution
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu import task as task_lib
+
+    cluster_name = constants.controller_cluster_name()
+    remote_dag = f'{_REMOTE_DAG_DIR}/dag-{job_id}.yaml'
+    run_cmd = (
+        f'{agent_constants.RUNTIME_PY_RESOLVER}'
+        f'"$_SKYPY" -u -m skypilot_tpu.jobs.remote_controller '
+        f'--job-id {job_id} --dag-yaml {remote_dag}')
+    enabled = ','.join(global_user_state.get_enabled_clouds())
+    if enabled:
+        run_cmd += f' --enabled-clouds {shlex.quote(enabled)}'
+    if bucket_url:
+        run_cmd += f' --bucket-url {shlex.quote(bucket_url)}'
+
+    controller_task = task_lib.Task(
+        name=f'jobs-controller-{job_id}',
+        run=run_cmd,
+    )
+    controller_task.set_resources(_controller_resources(dag))
+    controller_task.set_file_mounts({remote_dag: dag_yaml})
+    execution.launch(controller_task, cluster_name=cluster_name,
+                     detach_run=True, quiet_optimizer=True,
+                     stream_logs=False)
+    return cluster_name
+
+
+# ---------------- codegen-RPC to the controller cluster ----------------
+
+
+def _rpc(cluster_name: str, body: str) -> Any:
+    """Run a python snippet on the controller head and decode the one
+    payload line it prints (utils/remote_rpc)."""
+    from skypilot_tpu.utils import remote_rpc
+    return remote_rpc.rpc(cluster_name, body, operation='jobs-rpc')
+
+
+def query_remote_records(cluster_name: str,
+                         job_id: int) -> List[Dict[str, Any]]:
+    body = (
+        'from skypilot_tpu.jobs import state; '
+        'from skypilot_tpu.utils import common_utils; '
+        f'recs = state.get_task_records({job_id}); '
+        'payload = [dict(r, status=r["status"].value) for r in recs]; '
+        'print(common_utils.encode_payload(payload))')
+    return _rpc(cluster_name, body)
+
+
+def cancel_remote(cluster_name: str, job_id: int) -> None:
+    body = ('from skypilot_tpu.jobs import utils; '
+            f'utils.send_cancel_signal({job_id}); '
+            'from skypilot_tpu.utils import common_utils; '
+            'print(common_utils.encode_payload("ok"))')
+    _rpc(cluster_name, body)
+
+
+def sync_down_remote(job_id: int, cluster_name: str) -> bool:
+    """Refresh the client-side mirror of one remote job. Returns False
+    (and marks FAILED_CONTROLLER) when the controller cluster is gone —
+    the remote analogue of dead-controller-process detection."""
+    from skypilot_tpu.jobs import state
+
+    try:
+        records = query_remote_records(cluster_name, job_id)
+    except (exceptions.ClusterNotUpError, exceptions.CommandError) as e:
+        status = state.get_status(job_id)
+        if status is not None and not status.is_terminal():
+            logger.warning(
+                'Controller cluster %s for managed job %d is '
+                'unreachable (%s); marking FAILED_CONTROLLER.',
+                cluster_name, job_id, e)
+            state.set_failed(
+                job_id, None, state.ManagedJobStatus.FAILED_CONTROLLER,
+                f'Controller cluster {cluster_name} unreachable.')
+        return False
+    if records:
+        state.sync_remote_records(job_id, records)
+    return True

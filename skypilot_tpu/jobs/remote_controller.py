@@ -1,0 +1,82 @@
+"""Bootstrap for a managed-jobs controller running ON a controller
+cluster host (the remote-controller mode).
+
+Reference parity: sky/templates/jobs-controller.yaml.j2:32-36 — there the
+controller cluster's `run:` is `python -u -m sky.jobs.controller
+<user.yaml> --job-id $SKYPILOT_INTERNAL_JOB_ID`; this module is our
+equivalent entrypoint, invoked as the controller task's run command by
+jobs/remote.py. It differs from the local daemon entrypoint
+(jobs/controller.py main) in three ways:
+
+1. **State isolation.** The process may inherit the submitting client's
+   SKYTPU_STATE_DB / SKYTPU_CONFIG through the agent env; a controller
+   host must use its OWN state under its own home (that is the whole
+   point of remote controllers — the client machine can disappear).
+   The vars are dropped before any state module is imported.
+2. **Cloud enablement.** The host's fresh state db has no enabled
+   clouds; the client ships its list via --enabled-clouds.
+3. **Registration.** The client's job record lives in the CLIENT db;
+   the controller re-registers the job here under the same job id so
+   task-cluster names, signal files, and bucket cleanup all agree.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+# MUST run before skypilot_tpu state modules import (several resolve
+# their db paths at import time). SKYTPU_FAKE_CLOUD_STATE and
+# SKYTPU_FAKE_BUCKET_ROOT deliberately survive: they simulate the CLOUD
+# (TPU API, GCS), which is shared infrastructure, not client state.
+for _var in ('SKYTPU_STATE_DB', 'SKYTPU_CONFIG'):
+    os.environ.pop(_var, None)
+
+
+def main() -> int:
+    import argparse
+    import logging
+
+    parser = argparse.ArgumentParser(
+        description='Managed-jobs controller (controller-cluster mode).')
+    parser.add_argument('--job-id', type=int, required=True)
+    parser.add_argument('--dag-yaml', type=str, required=True)
+    parser.add_argument('--enabled-clouds', type=str, default='',
+                        help='Comma-separated clouds the client had '
+                             'enabled.')
+    parser.add_argument('--bucket-url', type=str, default=None,
+                        help='Run-scoped translated-mounts bucket to '
+                             'delete at job termination.')
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(levelname)s %(name)s: %(message)s')
+
+    from skypilot_tpu import global_user_state
+    if args.enabled_clouds:
+        existing = set(global_user_state.get_enabled_clouds() or [])
+        wanted = [c for c in args.enabled_clouds.split(',') if c]
+        if set(wanted) - existing:
+            global_user_state.set_enabled_clouds(
+                sorted(existing | set(wanted)))
+
+    from skypilot_tpu.jobs import controller
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.utils import dag_utils
+
+    dag_yaml = os.path.expanduser(args.dag_yaml)
+    dag = dag_utils.load_chain_dag_from_yaml(dag_yaml)
+    jobs_state.register_job_with_id(args.job_id, dag.name or 'managed-job',
+                                    dag_yaml, bucket_url=args.bucket_url)
+    for task_id, task in enumerate(dag.topological_order()):
+        resources_str = ', '.join(
+            str(r.accelerators or r.cloud_name or 'cpu')
+            for r in task.resources)
+        jobs_state.set_pending(args.job_id, task_id,
+                               task.name or f'task-{task_id}',
+                               resources_str)
+    jobs_state.set_controller_pid(args.job_id, os.getpid())
+    return controller.run_controller(args.job_id, dag_yaml)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

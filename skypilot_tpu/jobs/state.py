@@ -89,6 +89,10 @@ def _create_table(cursor: sqlite3.Cursor, conn: sqlite3.Connection) -> None:
     # the controller when the job reaches a terminal state).
     db_utils.add_column_if_not_exists(cursor, 'job_info', 'bucket_url',
                                       'TEXT')
+    # Set when the job's controller runs on a controller CLUSTER instead
+    # of a local process; queue/cancel then RPC to that cluster.
+    db_utils.add_column_if_not_exists(cursor, 'job_info', 'remote_cluster',
+                                      'TEXT')
     conn.commit()
 
 
@@ -135,17 +139,48 @@ def set_job_bucket(job_id: int, bucket_url: str) -> None:
             (bucket_url, job_id))
 
 
+def set_dag_yaml_path(job_id: int, path: str) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'UPDATE job_info SET dag_yaml_path = ? WHERE spot_job_id = ?',
+            (path, job_id))
+
+
+def set_remote_cluster(job_id: int, cluster_name: str) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'UPDATE job_info SET remote_cluster = ? WHERE spot_job_id = ?',
+            (cluster_name, job_id))
+
+
+def register_job_with_id(job_id: int, name: str, dag_yaml_path: str,
+                         bucket_url: Optional[str] = None) -> None:
+    """Controller-cluster side: register a job under the CLIENT's job id
+    so cluster names (<task>-<job_id>) and signal files agree across the
+    two databases. INSERT OR REPLACE: a controller retried by the agent
+    re-registers idempotently."""
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'INSERT OR REPLACE INTO job_info '
+            '(spot_job_id, name, dag_yaml_path, controller_pid, '
+            'bucket_url) VALUES (?, ?, ?, NULL, ?)',
+            (job_id, name, dag_yaml_path, bucket_url))
+
+
 def get_job_info(job_id: int) -> Optional[Dict[str, Any]]:
     db = _get_db()
     with db.cursor() as cursor:
         row = cursor.execute(
             'SELECT spot_job_id, name, dag_yaml_path, controller_pid, '
-            'bucket_url FROM job_info WHERE spot_job_id = ?',
-            (job_id,)).fetchone()
+            'bucket_url, remote_cluster FROM job_info '
+            'WHERE spot_job_id = ?', (job_id,)).fetchone()
     if row is None:
         return None
     return dict(zip(('job_id', 'name', 'dag_yaml_path', 'controller_pid',
-                     'bucket_url'), row))
+                     'bucket_url', 'remote_cluster'), row))
 
 
 def get_job_id_by_name(name: str) -> Optional[int]:
@@ -268,6 +303,26 @@ def _set_all_nonterminal(job_id: int, status: ManagedJobStatus) -> None:
             'UPDATE spot SET status = ? WHERE job_id = ? AND status NOT IN '
             f'({",".join(["?"] * len(_TERMINAL))})',
             (status.value, job_id, *[s.value for s in _TERMINAL]))
+
+
+def sync_remote_records(job_id: int, records: List[Dict[str, Any]]) -> None:
+    """Mirror a remote controller's per-task rows into the client db so
+    `jobs queue` shows remote jobs without a second code path. The remote
+    db is the source of truth; this is a cache refresh."""
+    db = _get_db()
+    with db.cursor() as cursor:
+        for rec in records:
+            status = rec.get('status')
+            if isinstance(status, ManagedJobStatus):
+                status = status.value
+            values = tuple(
+                job_id if c == 'job_id' else
+                status if c == 'status' else rec.get(c)
+                for c in _COLUMNS)
+            cursor.execute(
+                'INSERT OR REPLACE INTO spot '
+                f'({", ".join(_COLUMNS)}) '
+                f'VALUES ({", ".join(["?"] * len(_COLUMNS))})', values)
 
 
 _COLUMNS = ('job_id', 'task_id', 'task_name', 'resources', 'cluster_name',

@@ -86,6 +86,18 @@ class ComputeClient:
             raise errors.classify(
                 Exception(first.get('message', str(op['error']))))
 
+    # ---------------- project ----------------
+
+    def get_project(self) -> Dict[str, Any]:
+        """The project resource; commonInstanceMetadata carries the
+        enable-oslogin flag (reference: sky/authentication.py:148)."""
+        status, payload = self._transport(
+            'GET', f'{API_ROOT}/projects/{self.project}', None)
+        if status >= 400:
+            message = payload.get('error', {}).get('message', str(payload))
+            raise errors.classify(Exception(message), http_status=status)
+        return payload
+
     # ---------------- firewalls ----------------
 
     def get_firewall(self, name: str) -> Optional[Dict[str, Any]]:

@@ -144,6 +144,56 @@ def _unschedulable_reason(pod: Dict[str, Any]) -> Optional[str]:
     return None
 
 
+def _decode_unschedulable(pod_name: str, reason: str,
+                          config: common.ProvisionConfig
+                          ) -> errors.ProvisionerError:
+    """Scheduler-condition text → BlockScope taxonomy (reference:
+    sky/provision/kubernetes/instance.py:463-655 decodes pod scheduling
+    failures into actionable messages).
+
+    - selector/affinity mismatch: NO node pool in this cluster carries
+      the requested TPU selectors — retrying other zones of the same
+      k8s cluster can't help → REGION scope, message names the exact
+      selectors so the operator can create the right node pool.
+    - insufficient google.com/tpu (or generic): pools exist but are
+      full/taken → ZONE-scope capacity, failover proceeds normally.
+    """
+    lower = reason.lower()
+    selectors = _gke_selectors(config)
+    sel_str = ', '.join(f'{k}={v}' for k, v in selectors.items())
+    if 'insufficient google.com/tpu' in lower:
+        # Checked FIRST: real scheduler messages enumerate every node
+        # group ('2 Insufficient google.com/tpu, 3 node(s) didn't match
+        # ...selector'), and an insufficient-TPU component means a
+        # matching pool EXISTS but is full — a transient capacity
+        # shortage, not a configuration error.
+        return errors.CapacityError(
+            f'Pod {pod_name} unschedulable: {reason} (TPU node pool '
+            f'matching [{sel_str}] is full or still scaling up).')
+    if ('affinity' in lower or 'didn\'t match' in lower or
+            ('match' in lower and 'selector' in lower)):
+        return errors.ProvisionerError(
+            f'Pod {pod_name} unschedulable: {reason} — no node pool in '
+            f'this cluster matches the TPU selectors [{sel_str}]. '
+            f'Create a GKE TPU node pool with accelerator '
+            f'{selectors["cloud.google.com/gke-tpu-accelerator"]!r} and '
+            f'topology '
+            f'{selectors["cloud.google.com/gke-tpu-topology"]!r} '
+            f'(`gcloud container node-pools create ... '
+            f'--tpu-topology={config.topology}`).',
+            errors.BlockScope.REGION)
+    if 'taint' in lower and 'toler' in lower:
+        return errors.ProvisionerError(
+            f'Pod {pod_name} unschedulable: {reason} — the matching TPU '
+            f'node pool is tainted; add the required toleration to the '
+            f'pod spec via provider config or remove the taint.',
+            errors.BlockScope.REGION)
+    return errors.CapacityError(
+        f'Pod {pod_name} unschedulable: {reason} (no TPU node with free '
+        f'{config.accelerator_type} capacity — node pools matching '
+        f'[{sel_str}] are full or still scaling up).')
+
+
 def run_instances(region: str, zone: Optional[str], cluster_name: str,
                   config: common.ProvisionConfig) -> common.ProvisionRecord:
     client = _client(config.provider_config)
@@ -203,10 +253,8 @@ def _wait_pods_running(client: k8s_api.KubeClient, cluster_name: str,
         for p in pods:
             reason = _unschedulable_reason(p)
             if reason is not None:
-                raise errors.CapacityError(
-                    f'Pod {p["metadata"]["name"]} unschedulable: {reason} '
-                    f'(no TPU node pool with free '
-                    f'{config.accelerator_type} capacity).')
+                raise _decode_unschedulable(p['metadata']['name'], reason,
+                                            config)
             phase = p.get('status', {}).get('phase')
             if phase == 'Failed':
                 raise errors.ProvisionerError(

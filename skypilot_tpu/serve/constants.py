@@ -42,6 +42,16 @@ def lb_controller_sync_interval_seconds() -> float:
     return _env_float('SKYTPU_SERVE_LB_SYNC_INTERVAL', 20.0)
 
 
+def drain_seconds() -> float:
+    """How long a retired (blue-green) replica keeps serving after it
+    leaves the ready set, covering the LB's cached list + in-flight
+    requests. Default: 2 LB sync intervals, floor 5s."""
+    explicit = _env_float('SKYTPU_SERVE_DRAIN_SECONDS', -1.0)
+    if explicit >= 0:
+        return explicit
+    return max(5.0, 2 * lb_controller_sync_interval_seconds())
+
+
 def probe_interval_seconds() -> float:
     return _env_float('SKYTPU_SERVE_PROBE_INTERVAL', 10.0)
 
@@ -68,3 +78,10 @@ def service_dir(service_name: str) -> str:
 
 def replica_cluster_name(service_name: str, replica_id: int) -> str:
     return f'{service_name}-replica-{replica_id}'
+
+
+# One serve controller cluster per user (reference:
+# sky-serve-controller-<user-hash>, sky/serve/serve_utils.py).
+def controller_cluster_name() -> str:
+    from skypilot_tpu.utils import common_utils
+    return f'skytpu-serve-controller-{common_utils.get_user_hash()[:8]}'

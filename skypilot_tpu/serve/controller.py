@@ -35,12 +35,18 @@ class SkyServeController:
     controller.py:33)."""
 
     def __init__(self, service_name: str, spec: 'spec_lib.SkyServiceSpec',
-                 task: 'task_lib.Task', port: int) -> None:
+                 task: 'task_lib.Task', port: int,
+                 task_yaml_path: Optional[str] = None,
+                 version: int = 1) -> None:
         self.service_name = service_name
         self.port = port
         self.replica_manager = replica_managers.SkyPilotReplicaManager(
-            service_name, spec, task)
+            service_name, spec, task, version=version)
         self.autoscaler = autoscalers.make_autoscaler(spec)
+        self.task_yaml_path = task_yaml_path
+        self.version = version
+        # Active blue-green rollout, or None (see _rollout_step).
+        self._rollout: Optional[dict] = None
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -50,14 +56,21 @@ class SkyServeController:
         """(reference: _run_autoscaler, controller.py:54-87)"""
         while not self._stop.is_set():
             try:
-                infos = self.replica_manager.get_replica_infos()
-                decisions = self.autoscaler.evaluate_scaling(infos)
-                for decision in decisions:
-                    if decision.operator == \
-                            autoscalers.AutoscalerDecisionOperator.SCALE_UP:
-                        self.replica_manager.scale_up(decision.target)
-                    else:
-                        self.replica_manager.scale_down(decision.target)
+                self._check_version_update()
+                if self._rollout is not None:
+                    # During a rollout the rollout machine owns the
+                    # fleet; ordinary autoscaling resumes after.
+                    self._rollout_step()
+                else:
+                    infos = self.replica_manager.get_replica_infos()
+                    decisions = self.autoscaler.evaluate_scaling(infos)
+                    for decision in decisions:
+                        if decision.operator == \
+                                autoscalers.AutoscalerDecisionOperator.SCALE_UP:
+                            self.replica_manager.scale_up(decision.target)
+                        else:
+                            self.replica_manager.scale_down(
+                                decision.target)
                 self._update_service_status()
             except Exception:  # pylint: disable=broad-except
                 logger.exception('autoscaler tick failed')
@@ -66,6 +79,121 @@ class SkyServeController:
                 if self.replica_manager.get_replica_infos() else
                 constants.autoscaler_no_replica_decision_interval_seconds())
             self._stop.wait(interval)
+
+    # ---------------- blue-green rollout ----------------
+    # (reference: versioned updates with old-version draining +
+    # rollback, sky/serve/replica_managers.py:1165-1233)
+
+    def _check_version_update(self) -> None:
+        """A client `serve update` bumped current_version in the db:
+        begin a blue-green rollout to the re-read task yaml."""
+        if self.task_yaml_path is None or self._rollout is not None:
+            return
+        record = serve_state.get_service(self.service_name)
+        if record is None or record['current_version'] <= self.version:
+            return
+        from skypilot_tpu import task as task_lib
+        new_version = record['current_version']
+        try:
+            new_task = task_lib.Task.from_yaml(self.task_yaml_path)
+            assert new_task.service is not None, 'no service section'
+        except Exception as e:  # pylint: disable=broad-except
+            logger.error('Update to v%d unreadable (%s); staying on v%d.',
+                         new_version, e, self.version)
+            serve_state.set_service_version(self.service_name,
+                                            self.version)
+            return
+        new_spec = new_task.service
+        rm = self.replica_manager
+        old_alive = [i for i in rm.get_replica_infos()
+                     if i.status.counts_toward_fleet()]
+        target = max(new_spec.min_replicas, len(old_alive))
+        if new_spec.max_replicas is not None:
+            target = min(target, new_spec.max_replicas)
+        self._rollout = {
+            'version': new_version,
+            'old_version': self.version,
+            'old_task': rm.task,
+            'old_spec': rm.spec,
+            'old_ids': [i.replica_id for i in old_alive],
+            'new_ids': [],
+            'target': max(1, target),
+            'draining': False,
+        }
+        rm.update_version(new_version, new_spec, new_task)
+        self.autoscaler.update_spec(new_spec)
+        self.version = new_version
+        logger.info('Rollout v%d→v%d started: target %d new replicas '
+                    'alongside %d old.', self._rollout['old_version'],
+                    new_version, self._rollout['target'], len(old_alive))
+
+    def _rollout_step(self) -> None:
+        """One tick of the blue-green machine: launch new-version
+        replicas up to target, keep old ones serving until the new set
+        is READY, then drain+retire the old set; any new-version replica
+        failing terminally rolls the whole service back."""
+        ro = self._rollout
+        rm = self.replica_manager
+        infos = {i.replica_id: i for i in rm.get_replica_infos()}
+        failed = [
+            rid for rid in ro['new_ids']
+            if rid not in infos or infos[rid].status.is_failed()
+        ]
+        if failed and not ro['draining']:
+            self._rollback(failed)
+            return
+        alive_new = [
+            rid for rid in ro['new_ids']
+            if rid in infos and infos[rid].status.counts_toward_fleet()
+        ]
+        for _ in range(ro['target'] - len(alive_new)):
+            if ro['draining']:
+                break
+            ro['new_ids'].append(rm.scale_up())
+        ready_new = [
+            rid for rid in ro['new_ids'] if rid in infos and
+            infos[rid].status == serve_state.ReplicaStatus.READY
+        ]
+        if not ro['draining'] and len(ready_new) >= ro['target']:
+            # Traffic shifts at the next LB sync (old replicas leave the
+            # ready set now); they keep serving through the drain window
+            # so no cached-route or in-flight request fails.
+            for rid in ro['old_ids']:
+                if rid in infos:
+                    rm.scale_down(rid,
+                                  drain_seconds=constants.drain_seconds())
+            ro['draining'] = True
+            logger.info('Rollout v%d: %d new replicas ready; draining '
+                        '%d old.', ro['version'], len(ready_new),
+                        len(ro['old_ids']))
+        if ro['draining'] and all(rid not in infos
+                                  for rid in ro['old_ids']):
+            logger.info('Rollout to v%d complete.', ro['version'])
+            self._rollout = None
+
+    def _rollback(self, failed_ids: List[int]) -> None:
+        """New version can't come up: revert version + spec, retire the
+        new-version replicas, keep the (untouched) old fleet serving."""
+        ro = self._rollout
+        rm = self.replica_manager
+        logger.error(
+            'Rollout to v%d FAILED (replicas %s); rolling back to v%d.',
+            ro['version'], failed_ids, ro['old_version'])
+        rm.update_version(ro['old_version'], ro['old_spec'],
+                          ro['old_task'])
+        self.autoscaler.update_spec(ro['old_spec'])
+        serve_state.set_service_version(self.service_name,
+                                        ro['old_version'])
+        if self.task_yaml_path is not None:
+            # Restore the yaml so a controller restart doesn't re-roll
+            # the bad version.
+            from skypilot_tpu.utils import common_utils
+            common_utils.dump_yaml(self.task_yaml_path,
+                                   ro['old_task'].to_yaml_config())
+        for rid in ro['new_ids']:
+            rm.scale_down(rid, purge=True)
+        self.version = ro['old_version']
+        self._rollout = None
 
     def _prober_loop(self) -> None:
         while not self._stop.is_set():

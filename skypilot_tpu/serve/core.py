@@ -51,10 +51,12 @@ def _validate_service_task(task: 'task_lib.Task') -> None:
 
 
 @timeline.event
-def up(task: 'task_lib.Task', service_name: Optional[str] = None
-       ) -> Dict[str, Any]:
+def up(task: 'task_lib.Task', service_name: Optional[str] = None,
+       remote: bool = False) -> Dict[str, Any]:
     """Spin up a service; returns {'name', 'endpoint'} (reference:
-    serve.up, serve/core.py:94)."""
+    serve.up, serve/core.py:94). With remote=True the service runner
+    lives on a dedicated controller cluster (reference:
+    sky-serve-controller.yaml.j2) so the fleet survives this machine."""
     if service_name is None:
         service_name = task.name or 'service'
     _validate_service_task(task)
@@ -72,6 +74,15 @@ def up(task: 'task_lib.Task', service_name: Optional[str] = None
 
     controller_port = _pick_port()
     lb_port = _pick_port()
+
+    if remote:
+        try:
+            endpoint = _up_remote(task, service_name, task_yaml,
+                                  controller_port, lb_port)
+        except Exception:
+            serve_state.remove_service(service_name)
+            raise
+        return {'name': service_name, 'endpoint': endpoint, 'pid': None}
     log_path = os.path.join(constants.service_dir(service_name),
                             'service.log')
     with open(log_path, 'ab') as log_file:
@@ -93,6 +104,125 @@ def up(task: 'task_lib.Task', service_name: Optional[str] = None
     return {'name': service_name, 'endpoint': endpoint, 'pid': proc.pid}
 
 
+def _up_remote(task: 'task_lib.Task', service_name: str, task_yaml: str,
+               controller_port: int, lb_port: int) -> str:
+    """Launch (or reuse) the serve controller cluster and start the
+    service runner on it (reference: sky-serve-controller.yaml.j2 +
+    serve/core.py:94-302). Returns the LB endpoint on the controller
+    host."""
+    import shlex
+
+    from skypilot_tpu import execution
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib_mod
+    from skypilot_tpu.agent import constants as agent_constants
+
+    cluster_name = constants.controller_cluster_name()
+    remote_yaml = f'~/serve-tasks/{service_name}.yaml'
+    run_cmd = (
+        f'{agent_constants.RUNTIME_PY_RESOLVER}'
+        f'"$_SKYPY" -u -m skypilot_tpu.serve.remote_service '
+        f'--service-name {shlex.quote(service_name)} '
+        f'--task-yaml {remote_yaml} '
+        f'--controller-port {controller_port} --lb-port {lb_port}')
+    enabled = ','.join(global_user_state.get_enabled_clouds() or [])
+    if enabled:
+        run_cmd += f' --enabled-clouds {shlex.quote(enabled)}'
+
+    cloud = None
+    for res in task.resources:
+        if res.cloud_name is not None:
+            cloud = res.cloud_name
+            break
+    controller_task = task_lib_mod.Task(
+        name=f'serve-controller-{service_name}', run=run_cmd)
+    controller_task.set_resources({resources_lib.Resources(cloud=cloud)})
+    controller_task.set_file_mounts({remote_yaml: task_yaml})
+    _, handle = execution.launch(controller_task,
+                                 cluster_name=cluster_name,
+                                 detach_run=True, quiet_optimizer=True,
+                                 stream_logs=False)
+    serve_state.set_service_remote_cluster(service_name, cluster_name)
+    serve_state.set_service_controller(service_name, -1, controller_port,
+                                       lb_port)
+    head_ip = handle.host_records()[0]['ip']
+    return f'http://{head_ip}:{lb_port}'
+
+
+def _sync_remote_service(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Refresh one remote service's client-side row from the controller
+    cluster; marks CONTROLLER_FAILED when the cluster is unreachable."""
+    from skypilot_tpu.serve.serve_state import ServiceStatus
+    from skypilot_tpu.utils import remote_rpc
+
+    name = record['name']
+    body = (
+        'from skypilot_tpu.serve import serve_state; '
+        'from skypilot_tpu.utils import common_utils; '
+        f'rec = serve_state.get_service({name!r}); '
+        f'infos = serve_state.get_replica_infos({name!r}); '
+        'payload = (None if rec is None else '
+        '{"status": rec["status"].value, '
+        '"current_version": rec["current_version"], '
+        '"controller_port": rec["controller_port"], '
+        '"lb_port": rec["lb_port"], '
+        '"replica_info": [r.to_info_dict() for r in infos]}); '
+        'print(common_utils.encode_payload(payload))')
+    try:
+        remote = remote_rpc.rpc(record['remote_cluster'], body,
+                                operation='serve-rpc')
+    except (exceptions.ClusterNotUpError, exceptions.CommandError):
+        serve_state.set_service_status(name,
+                                       ServiceStatus.CONTROLLER_FAILED)
+        record['status'] = ServiceStatus.CONTROLLER_FAILED
+        record['replica_info'] = []
+        return record
+    if remote is None:
+        # Runner finished host-side (downed out-of-band): reflect that.
+        record['replica_info'] = []
+        return record
+    serve_state.set_service_status(name, ServiceStatus(remote['status']))
+    if remote.get('lb_port') and (
+            remote['lb_port'] != record['lb_port'] or
+            remote['controller_port'] != record['controller_port']):
+        # The host may have re-picked ports the client's guesses
+        # collided with; the host's numbers are the truth.
+        serve_state.set_service_controller(name, -1,
+                                           remote['controller_port'],
+                                           remote['lb_port'])
+        record['controller_port'] = remote['controller_port']
+        record['lb_port'] = remote['lb_port']
+    record['status'] = ServiceStatus(remote['status'])
+    record['current_version'] = remote['current_version']
+    record['replica_info'] = remote['replica_info']
+    return record
+
+
+def _down_remote(record: Dict[str, Any]) -> None:
+    """`down` for a remote service: run the ordinary down() ON the
+    controller host (it owns the runner pid + replica fleet), then drop
+    the client-side row."""
+    from skypilot_tpu.utils import remote_rpc
+
+    name = record['name']
+    body = ('from skypilot_tpu.serve import core; '
+            f'core.down({name!r}, purge=True); '
+            'from skypilot_tpu.utils import common_utils; '
+            'print(common_utils.encode_payload("ok"))')
+    try:
+        remote_rpc.rpc(record['remote_cluster'], body,
+                       operation='serve-down', timeout=600.0)
+    except (exceptions.ClusterNotUpError, exceptions.CommandError) as e:
+        raise exceptions.ServeUserTerminatedError(
+            f'Could not reach controller cluster '
+            f'{record["remote_cluster"]!r} to tear down '
+            f'{name!r}: {e}. If the cluster is gone, rerun with '
+            f'purge=True after `skytpu down` of any leftover replicas.'
+        ) from e
+    serve_state.remove_service(name)
+
+
 @timeline.event
 def update(task: 'task_lib.Task', service_name: str) -> int:
     """Roll the service to a new task/spec version (reference:
@@ -102,15 +232,60 @@ def update(task: 'task_lib.Task', service_name: str) -> int:
     if record is None:
         raise exceptions.ServeUserTerminatedError(
             f'Service {service_name!r} does not exist.')
+    if record.get('remote_cluster'):
+        return _update_remote(record, task)
     version = record['current_version'] + 1
-    serve_state.add_version_spec(service_name, version, task.service)
-    serve_state.set_service_version(service_name, version)
-    # The running service process watches version_specs via its next
-    # controller tick; for now the contract is restart-based rollout:
-    # new replicas launch with the new spec after the controller reloads.
+    # Yaml FIRST, version bump LAST: the version bump is the trigger the
+    # running controller watches (_check_version_update) — it must find
+    # the new task in place when it fires. The controller then runs a
+    # blue-green rollout: v+1 replicas launch alongside v, traffic
+    # shifts once they are READY, v drains, and a v+1 that never comes
+    # up rolls back (reference: replica_managers.py:1165-1233).
     task_yaml = record['task_yaml_path']
     from skypilot_tpu.utils import common_utils
     common_utils.dump_yaml(task_yaml, task.to_yaml_config())
+    serve_state.add_version_spec(service_name, version, task.service)
+    serve_state.set_service_version(service_name, version)
+    return version
+
+
+def _update_remote(record: Dict[str, Any], task: 'task_lib.Task') -> int:
+    """update for a remote service: ship the new yaml to the controller
+    host and perform the db writes there; the host-side controller's
+    version watch picks it up exactly like the local case."""
+    from skypilot_tpu.utils import common_utils
+    from skypilot_tpu.utils import remote_rpc
+
+    name = record['name']
+    import tempfile
+    with tempfile.NamedTemporaryFile('w', suffix='.yaml',
+                                     delete=False) as f:
+        common_utils.dump_yaml(f.name, task.to_yaml_config())
+        local_yaml = f.name
+    try:
+        runner = remote_rpc.head_runner(record['remote_cluster'],
+                                        'serve-update')
+        staged = f'/tmp/skytpu-update-{name}.yaml'
+        runner.rsync(local_yaml, staged, up=True)
+        body = (
+            'import shutil; '
+            'from skypilot_tpu import task as task_lib; '
+            'from skypilot_tpu.serve import serve_state; '
+            'from skypilot_tpu.utils import common_utils; '
+            f'rec = serve_state.get_service({name!r}); '
+            'assert rec is not None, "service gone host-side"; '
+            f't = task_lib.Task.from_yaml({staged!r}); '
+            'assert t.service is not None; '
+            'version = rec["current_version"] + 1; '
+            f'shutil.copy({staged!r}, rec["task_yaml_path"]); '
+            f'serve_state.add_version_spec({name!r}, version, t.service); '
+            f'serve_state.set_service_version({name!r}, version); '
+            'print(common_utils.encode_payload(version))')
+        version = remote_rpc.rpc(record['remote_cluster'], body,
+                                 operation='serve-update')
+    finally:
+        os.unlink(local_yaml)
+    serve_state.set_service_version(name, version)
     return version
 
 
@@ -125,6 +300,9 @@ def down(service_name: str, purge: bool = False) -> None:
             return
         raise exceptions.ServeUserTerminatedError(
             f'Service {service_name!r} does not exist.')
+    if record.get('remote_cluster'):
+        _down_remote(record)
+        return
     pid = record['controller_pid']
     from skypilot_tpu.utils import subprocess_utils
     if pid is not None and subprocess_utils.pid_alive(pid):
@@ -172,6 +350,10 @@ def update_service_status() -> None:
                 ServiceStatus.CONTROLLER_FAILED, ServiceStatus.FAILED,
                 ServiceStatus.FAILED_CLEANUP, ServiceStatus.SHUTTING_DOWN):
             continue
+        if record.get('remote_cluster'):
+            # Remote runner: liveness comes from the RPC sync in
+            # status(), not a local pid probe.
+            continue
         pid = record['controller_pid']
         if pid is None:
             continue
@@ -193,6 +375,15 @@ def status(service_name: Optional[str] = None,
         records = [r for r in records if r['name'] == service_name]
     out = []
     for record in records:
+        if record.get('remote_cluster'):
+            if refresh:
+                record = _sync_remote_service(dict(record))
+            record.setdefault('replica_info', [])
+            out.append({
+                **record,
+                'endpoint': get_endpoint(record['name']),
+            })
+            continue
         replicas = serve_state.get_replica_infos(record['name'])
         out.append({
             **record,
@@ -233,6 +424,14 @@ def get_endpoint(service_name: str) -> Optional[str]:
     record = serve_state.get_service(service_name)
     if record is None or not record['lb_port']:
         return None
+    if record.get('remote_cluster'):
+        from skypilot_tpu import global_user_state
+        rec = global_user_state.get_cluster_from_name(
+            record['remote_cluster'])
+        if rec is None or rec.get('handle') is None:
+            return None
+        head_ip = rec['handle'].host_records()[0]['ip']
+        return f'http://{head_ip}:{record["lb_port"]}'
     return f'http://127.0.0.1:{record["lb_port"]}'
 
 
@@ -242,6 +441,14 @@ def wait_until_ready(service_name: str, timeout: float = 600.0,
     deadline = time.time() + timeout
     endpoint = None
     while time.time() < deadline:
+        record = serve_state.get_service(service_name)
+        if record is not None and record.get('remote_cluster'):
+            # Sync host-side truth (including host-re-picked ports)
+            # before computing the endpoint.
+            try:
+                _sync_remote_service(dict(record))
+            except Exception:  # pylint: disable=broad-except
+                pass
         endpoint = get_endpoint(service_name)
         if endpoint is not None:
             try:

@@ -1,0 +1,78 @@
+"""Bootstrap for a serve service runner ON a controller cluster host
+(the remote-serve mode).
+
+Reference parity: sky/templates/sky-serve-controller.yaml.j2:31-40 — the
+serve controller cluster's `run:` is `python -u -m sky.serve.service
+--service-name ... --task-yaml ...`; this module is our equivalent,
+invoked as the controller task's run command by serve/core.up(remote=
+True). Mirrors jobs/remote_controller.py: drop client state env, enable
+clouds, register host-side, then run the (blocking) service runner —
+the agent job stays RUNNING for the service's lifetime, and a cancel of
+that job SIGTERMs the runner, which tears the replica fleet down.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+# Before any state module import (see jobs/remote_controller.py: the
+# fake-cloud/bucket vars deliberately survive — they simulate shared
+# cloud infrastructure, not client state).
+for _var in ('SKYTPU_STATE_DB', 'SKYTPU_CONFIG'):
+    os.environ.pop(_var, None)
+
+
+def main() -> int:
+    import argparse
+    import logging
+
+    parser = argparse.ArgumentParser(
+        description='Serve service runner (controller-cluster mode).')
+    parser.add_argument('--service-name', required=True)
+    parser.add_argument('--task-yaml', required=True)
+    parser.add_argument('--controller-port', type=int, required=True)
+    parser.add_argument('--lb-port', type=int, required=True)
+    parser.add_argument('--enabled-clouds', type=str, default='')
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(levelname)s %(name)s: %(message)s')
+
+    from skypilot_tpu import global_user_state
+    if args.enabled_clouds:
+        existing = set(global_user_state.get_enabled_clouds() or [])
+        wanted = [c for c in args.enabled_clouds.split(',') if c]
+        if set(wanted) - existing:
+            global_user_state.set_enabled_clouds(
+                sorted(existing | set(wanted)))
+
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve import service as service_lib
+
+    def _usable(port: int) -> int:
+        # The client picked these ports on ITS machine; a port free
+        # there can be taken here. Fall back to a host-chosen free port
+        # — the client syncs the actual numbers down via the status RPC.
+        import socket
+        with socket.socket() as sock:
+            try:
+                sock.bind(('', port))
+                return port
+            except OSError:
+                pass
+        with socket.socket() as sock:
+            sock.bind(('', 0))
+            return sock.getsockname()[1]
+
+    controller_port = _usable(args.controller_port)
+    lb_port = _usable(args.lb_port)
+    task_yaml = os.path.expanduser(args.task_yaml)
+    serve_state.add_service(args.service_name, 'round_robin', task_yaml)
+    serve_state.set_service_controller(args.service_name, os.getpid(),
+                                       controller_port, lb_port)
+    return service_lib.run_service(args.service_name, task_yaml,
+                                   controller_port, lb_port)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -139,16 +139,34 @@ class SkyPilotReplicaManager:
                     resources_override or {})
         return replica_id
 
-    def scale_down(self, replica_id: int, purge: bool = False) -> None:
+    def scale_down(self, replica_id: int, purge: bool = False,
+                   drain_seconds: float = 0.0) -> None:
         """Async teardown (reference: scale_down → _terminate_replica,
-        replica_managers.py:720)."""
+        replica_managers.py:720). drain_seconds delays the actual
+        teardown AFTER the replica leaves the ready set — in-flight
+        requests (and the LB's cached ready list, refreshed every sync
+        interval) finish against a still-serving replica. Blue-green
+        retirement uses this for its zero-failed-requests contract."""
         with self.lock:
             info = self.replicas.get(replica_id)
             if info is None:
                 return
+            if info.status == ReplicaStatus.SHUTTING_DOWN:
+                # A teardown worker is already running (probe loop and a
+                # rollout/rollback can both retire the same replica);
+                # a second concurrent core.down on one cluster races the
+                # first into FAILED_CLEANUP and strands the row.
+                return
             info.status = ReplicaStatus.SHUTTING_DOWN
             self._persist(info)
-        self._spawn(self._terminate_replica, replica_id, purge)
+        self._spawn(self._terminate_replica_after_drain, replica_id,
+                    purge, drain_seconds)
+
+    def _terminate_replica_after_drain(self, replica_id: int, purge: bool,
+                                       drain_seconds: float) -> None:
+        if drain_seconds > 0:
+            time.sleep(drain_seconds)
+        self._terminate_replica(replica_id, purge)
 
     def _spawn(self, target, *args) -> None:
         thread = threading.Thread(target=target, args=args, daemon=True)

@@ -103,6 +103,10 @@ def _create_table(cursor: sqlite3.Cursor, conn: sqlite3.Connection) -> None:
             version INTEGER,
             spec BLOB,
             PRIMARY KEY (service_name, version))""")
+    # Set when the service runner lives on a controller CLUSTER (remote
+    # mode); status/down then RPC to that cluster.
+    db_utils.add_column_if_not_exists(cursor, 'services', 'remote_cluster',
+                                      'TEXT')
     conn.commit()
 
 
@@ -173,8 +177,17 @@ def set_service_version(name: str, version: int) -> None:
             (version, name))
 
 
+def set_service_remote_cluster(name: str, cluster_name: str) -> None:
+    db = _get_db()
+    with db.cursor() as cursor:
+        cursor.execute(
+            'UPDATE services SET remote_cluster = ? WHERE name = ?',
+            (cluster_name, name))
+
+
 _SERVICE_COLS = ('name', 'controller_pid', 'controller_port', 'lb_port',
-                 'status', 'policy', 'task_yaml_path', 'current_version')
+                 'status', 'policy', 'task_yaml_path', 'current_version',
+                 'remote_cluster')
 
 
 def get_service(name: str) -> Optional[Dict[str, Any]]:

@@ -45,9 +45,12 @@ def run_service(service_name: str, task_yaml: str, controller_port: int,
     assert task.service is not None, 'Task has no service section.'
     spec = task.service
 
-    serve_state.add_version_spec(service_name, 1, spec)
+    record = serve_state.get_service(service_name)
+    version = record['current_version'] if record else 1
+    serve_state.add_version_spec(service_name, version, spec)
     controller = controller_lib.SkyServeController(
-        service_name, spec, task, controller_port)
+        service_name, spec, task, controller_port,
+        task_yaml_path=task_yaml, version=version)
     # Seed the fleet at min_replicas; the autoscaler takes over from here.
     for _ in range(spec.min_replicas):
         controller.replica_manager.scale_up()

@@ -1,0 +1,33 @@
+"""Codegen-RPC to a controller cluster's head host.
+
+The JobCodeGen idiom (agent/codegen.py) pointed at controller clusters:
+run a python snippet on the head over the cluster's command runner and
+decode the single payload line it prints. Shared by jobs/remote.py and
+serve/core.py's remote paths.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Any
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.agent import constants as agent_constants
+from skypilot_tpu.utils import common_utils
+
+
+def head_runner(cluster_name: str, operation: str = 'controller-rpc'):
+    from skypilot_tpu.backends import backend_utils
+    handle = backend_utils.check_cluster_available(cluster_name, operation)
+    return handle.get_head_runner()
+
+
+def rpc(cluster_name: str, body: str, operation: str = 'controller-rpc',
+        timeout: float = 300.0) -> Any:
+    runner = head_runner(cluster_name, operation)
+    cmd = (f'{agent_constants.RUNTIME_PY_RESOLVER}'
+           f'"$_SKYPY" -u -c {shlex.quote(body)}')
+    rc, stdout, stderr = runner.run(cmd, require_outputs=True,
+                                    stream_logs=False, timeout=timeout)
+    if rc != 0:
+        raise exceptions.CommandError(rc, operation, stderr)
+    return common_utils.decode_payload(stdout)

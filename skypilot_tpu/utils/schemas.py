@@ -100,7 +100,7 @@ STORAGE_SCHEMA: Dict[str, Any] = {
     'properties': {
         'name': {'type': 'string'},
         'source': {'type': 'string'},
-        'store': _case_insensitive_enum(['gcs', 'local']),
+        'store': _case_insensitive_enum(['gcs', 'local', 's3']),
         'mode': _case_insensitive_enum(['MOUNT', 'COPY']),
         'persistent': {'type': 'boolean'},
     },

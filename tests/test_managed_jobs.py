@@ -269,6 +269,43 @@ class TestManagedJobEndToEnd:
         assert jobs_state.get_job_info(job_id)['bucket_url'] is None
         assert _wait_status(job_id, _TERMINAL) == ManagedJobStatus.SUCCEEDED
 
+    def test_controller_cap_queues_then_drains(self, monkeypatch):
+        """VERDICT r4 #9: beyond the local-controller cap, jobs queue
+        (PENDING, no pid) and start as slots free up."""
+        monkeypatch.setenv('SKYTPU_JOBS_MAX_LOCAL_CONTROLLERS', '1')
+        j1 = jobs_core.launch(_task(run='sleep 2', name='slot1'),
+                              detach_run=True)
+        j2 = jobs_core.launch(_task(run='echo queued', name='slot2'),
+                              detach_run=True)
+        info2 = jobs_state.get_job_info(j2)
+        assert info2['controller_pid'] is None
+        assert jobs_state.get_status(j2) == ManagedJobStatus.PENDING
+        # First job finishes → a queue() refresh drains the queue.
+        _wait_status(j1, _TERMINAL)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            jobs_core.queue()
+            if jobs_state.get_job_info(j2)['controller_pid'] is not None:
+                break
+            time.sleep(0.3)
+        assert jobs_state.get_job_info(j2)['controller_pid'] is not None
+        assert _wait_status(j2, _TERMINAL) == ManagedJobStatus.SUCCEEDED
+
+    def test_cancel_queued_job_before_spawn(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_JOBS_MAX_LOCAL_CONTROLLERS', '1')
+        j1 = jobs_core.launch(_task(run='sleep 120', name='holder'),
+                              detach_run=True)
+        j2 = jobs_core.launch(_task(run='echo never', name='victim'),
+                              detach_run=True)
+        assert jobs_state.get_job_info(j2)['controller_pid'] is None
+        assert jobs_core.cancel(job_ids=[j2]) == [j2]
+        assert jobs_state.get_status(j2) == ManagedJobStatus.CANCELLED
+        # The drained queue must NOT resurrect it.
+        jobs_core.queue()
+        assert jobs_state.get_job_info(j2)['controller_pid'] is None
+        jobs_core.cancel(job_ids=[j1])
+        _wait_status(j1, _TERMINAL)
+
     def test_dead_controller_detection(self):
         import os
         import signal

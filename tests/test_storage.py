@@ -103,6 +103,64 @@ class TestStorageObject:
         storage2 = Storage.from_yaml_config(config)
         assert storage2.name == 'bkt-yaml'
 
+    def test_s3_store_serves_from_mirror(self, monkeypatch):
+        """VERDICT r4 #7: an s3:// storage source works as a READ store
+        — mirrored once to GCS server-side; mount/copy commands serve
+        from the mirror; delete touches only the mirror."""
+        from skypilot_tpu.data import data_transfer, storage as storage_lib
+        from tests.test_data_transfer import FakeStsTransport
+        transport = FakeStsTransport()
+        data_transfer.set_transport_override(transport)
+        data_transfer._imported_pairs.clear()
+        monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AKIATEST')
+        monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 'secret123')
+        monkeypatch.setenv('SKYTPU_STS_POLL_SECONDS', '0')
+        monkeypatch.setattr(storage_lib.GcsStore, 'initialize',
+                            lambda self: None)
+        monkeypatch.setattr(
+            'skypilot_tpu.clouds.gcp.GCP.get_project_id',
+            classmethod(lambda cls: 'proj-1'))
+        try:
+            storage = Storage(source='s3://corp-data')
+            assert storage.name == 'corp-data'
+            storage.construct()
+            assert StoreType.S3 in storage.stores
+            store = storage.primary_store()
+            # GCS preferred only if present; here the only store is S3.
+            assert store.STORE_TYPE == StoreType.S3
+            mirror = data_transfer.mirror_bucket_name('corp-data')
+            mount_cmd = store.mount_command('/data')
+            assert mirror in mount_cmd and 'gcsfuse' in mount_cmd
+            copy_cmd = store.copy_down_command('/data')
+            assert f'gs://{mirror}' in copy_cmd
+            # One STS transfer ran (server-side), none per command.
+            runs = [c for c in transport.calls if c[1].endswith(':run')]
+            assert len(runs) == 1
+            # upload is refused: S3 is read-only here.
+            store.source = '/tmp/x'
+            with pytest.raises(exceptions.StorageError, match='read-only'):
+                store.upload()
+        finally:
+            data_transfer.set_transport_override(None)
+            data_transfer._imported_pairs.clear()
+
+    def test_s3_store_yaml_round_trip(self, monkeypatch):
+        from skypilot_tpu.utils import schemas
+        config = {'source': 's3://corp-data', 'mode': 'COPY',
+                  'store': 's3'}
+        schemas.validate_storage(config)  # schema admits s3
+        # from_yaml_config with store: s3 would run the import; validate
+        # the spec path without the store attach.
+        storage = Storage(source='s3://corp-data',
+                          mode=StorageMode.COPY)
+        cfg = storage.to_yaml_config()
+        assert cfg['source'] == 's3://corp-data'
+        assert cfg['mode'] == 'COPY'
+
+    def test_s3_keyed_uri_rejected(self):
+        with pytest.raises(exceptions.StorageSpecError, match='prefix'):
+            Storage(source='s3://corp-data/sub/key')
+
     def test_schema_rejects_bad_mode_and_store(self):
         # Regression: the custom case_insensitive_enum keyword must be
         # enforced, not silently ignored by jsonschema.
