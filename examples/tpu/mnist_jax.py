@@ -8,7 +8,6 @@ host has egress.
 import argparse
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import optax
 from flax import linen as nn
@@ -38,27 +37,52 @@ def synthetic_mnist(rng, n):
     for i, label in enumerate(labels):
         col = 2 + 2 * label
         images[i, :, col:col + 2, 0] += 1.0
-    return jnp.asarray(images), jnp.asarray(labels)
+    # numpy (not device arrays): identical host-local inputs are what
+    # jit shards across a multi-host mesh.
+    return images, labels.astype('int32')
 
 
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument('--epochs', type=int, default=2)
     parser.add_argument('--batch', type=int, default=256)
+    parser.add_argument('--distributed', action='store_true',
+                        help='Multi-host pod slice: initialize '
+                             'jax.distributed from the SKYTPU-exported '
+                             'coordinator env and shard the batch over '
+                             'all hosts (data parallel).')
     args = parser.parse_args()
+
+    if args.distributed:
+        # The framework exports JAX_COORDINATOR_ADDRESS / JAX_PROCESS_ID
+        # / JAX_NUM_PROCESSES on every host of the slice (agent/driver
+        # rank wiring); initialize() reads them and the device list
+        # becomes the GLOBAL slice.
+        jax.distributed.initialize()
+        print(f'process {jax.process_index()}/{jax.process_count()}')
 
     print(f'devices: {jax.devices()}')
     rng = np.random.default_rng(0)
     train_x, train_y = synthetic_mnist(rng, 8192)
     test_x, test_y = synthetic_mnist(rng, 1024)
 
-    model = CNN()
-    params = model.init(jax.random.PRNGKey(0), train_x[:1])
-    tx = optax.adam(1e-3)
-    opt_state = tx.init(params)
+    # Idiomatic TPU data parallelism, 1 chip or a whole pod: one mesh
+    # over every (global) device, batch sharded along it, params
+    # replicated. Under jit, XLA inserts the cross-chip/ICI grad
+    # reduction itself — there is no hand-written collective.
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    mesh = Mesh(np.asarray(jax.devices()), ('batch',))
+    data_sharding = NamedSharding(mesh, PartitionSpec('batch'))
+    replicated = NamedSharding(mesh, PartitionSpec())
+    assert args.batch % len(jax.devices()) == 0, 'batch % devices != 0'
 
-    @jax.jit
-    def step(params, opt_state, x, y):
+    model = CNN()
+    params = jax.device_put(model.init(jax.random.PRNGKey(0),
+                                       train_x[:1]), replicated)
+    tx = optax.adam(1e-3)
+    opt_state = jax.device_put(tx.init(params), replicated)
+
+    def step_fn(params, opt_state, x, y):
         def loss_fn(p):
             logits = model.apply(p, x)
             return optax.softmax_cross_entropy_with_integer_labels(
@@ -68,9 +92,18 @@ def main():
         updates, opt_state = tx.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    @jax.jit
-    def accuracy(params, x, y):
+    step = jax.jit(step_fn,
+                   in_shardings=(replicated, replicated, data_sharding,
+                                 data_sharding),
+                   out_shardings=(replicated, replicated, replicated))
+
+    def accuracy_fn(params, x, y):
         return (model.apply(params, x).argmax(-1) == y).mean()
+
+    accuracy = jax.jit(accuracy_fn,
+                       in_shardings=(replicated, data_sharding,
+                                     data_sharding),
+                       out_shardings=replicated)
 
     steps_per_epoch = len(train_x) // args.batch
     for epoch in range(args.epochs):

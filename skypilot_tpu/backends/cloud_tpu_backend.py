@@ -24,6 +24,7 @@ import getpass
 import json
 import logging
 import os
+import shlex
 import time
 import typing
 from typing import Any, Dict, List, Optional
@@ -525,24 +526,28 @@ class CloudTpuBackend(backend_lib.Backend['CloudTpuResourceHandle']):
                 def _fetch(rec, dst=dst, src=src):
                     runner = handle._make_runner(rec)  # pylint: disable=protected-access
                     rdst = handle.resolve_remote_path(rec, dst)
-                    # rsync needs rdst to exist as a directory; before a
-                    # cp fallback the dir is REMOVED (rm -rf, not rmdir:
-                    # a partially-completed rsync leaves files behind,
-                    # and `cp -r prefix existing-dir/` would nest the
-                    # source under rdst/<basename> while exiting 0).
-                    # Mount destinations are owned by the mount, so
-                    # clearing is safe; cp keeps -r so directory
-                    # prefixes still work when rsync itself is absent.
+                    # Attempt order, never destroying pre-existing dst
+                    # contents: (1) rsync into rdst-as-a-dir (prefix
+                    # sources; idempotent, keeps extra files); (2) the
+                    # just-made dir was empty+removable → src is a
+                    # single OBJECT, plain cp writes rdst as a file;
+                    # (3) rsync unavailable entirely → copy the
+                    # prefix's CONTENTS via a trailing wildcard (quoted:
+                    # gcloud/gsutil expand it against GCS), which cannot
+                    # nest src under rdst/<basename> the way
+                    # `cp -r prefix existing-dir` does.
                     rc = runner.run(
                         f'mkdir -p $(dirname {rdst}) && '
                         f'( (mkdir -p {rdst} && '
-                        f'   gcloud storage rsync -r {src} {rdst}) || '
-                        f'  (rm -rf {rdst}; '
-                        f'   gcloud storage cp -r {src} {rdst}) || '
-                        f'  (rm -rf {rdst}; mkdir -p {rdst} && '
-                        f'   gsutil -m rsync -r {src} {rdst}) || '
-                        f'  (rm -rf {rdst}; '
-                        f'   gsutil -m cp -r {src} {rdst}) )',
+                        f'   (gcloud storage rsync -r {src} {rdst} || '
+                        f'    gsutil -m rsync -r {src} {rdst})) || '
+                        f'  (([ ! -d {rdst} ] || rmdir {rdst} '
+                        f'    2>/dev/null) && '
+                        f'   (gcloud storage cp {src} {rdst} || '
+                        f'    gsutil cp {src} {rdst})) || '
+                        f'  (mkdir -p {rdst} && '
+                        f'   (gcloud storage cp -r {shlex.quote(src + "/*")} {rdst} || '
+                        f'    gsutil -m cp -r {shlex.quote(src + "/*")} {rdst})) )',
                         stream_logs=False)
                     if rc != 0:
                         raise exceptions.CommandError(

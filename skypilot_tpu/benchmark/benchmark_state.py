@@ -15,6 +15,9 @@ class BenchmarkStatus(enum.Enum):
     INIT = 'INIT'
     RUNNING = 'RUNNING'
     FINISHED = 'FINISHED'
+    # Loser terminated early once the ranking was clear (reference:
+    # time-to-K-steps early termination, benchmark_utils.py:584).
+    TERMINATED = 'TERMINATED'
 
 
 def _db_path() -> str:

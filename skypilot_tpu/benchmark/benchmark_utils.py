@@ -135,6 +135,113 @@ def report(benchmark: str,
     return out
 
 
+def wait_and_terminate_losers(
+    benchmark: str,
+    steps_target: int,
+    keep_top: int = 1,
+    min_measured_steps: int = 3,
+    by: str = 'cost',
+    poll_seconds: float = 5.0,
+    timeout: float = 3600.0,
+) -> List[Dict[str, Any]]:
+    """Poll candidates until every one has a measured step time, rank by
+    projected cost (or time) to `steps_target`, and terminate all but
+    the top `keep_top` — a losing candidate should not burn chips for
+    the rest of a long benchmark run (reference: time-to-K-steps early
+    termination, sky/benchmark/benchmark_utils.py:584).
+
+    Returns the final report (losers marked TERMINATED). On timeout,
+    terminates nothing measured-less and returns what exists.
+    """
+    import time
+
+    assert by in ('cost', 'time'), by
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        results = update_benchmark_results(benchmark)
+        measured = [r for r in results
+                    if r['num_steps'] and
+                    r['num_steps'] >= min_measured_steps and
+                    r['seconds_per_step']]
+        if len(measured) == len(results):
+            break
+        time.sleep(poll_seconds)
+    else:
+        logger.warning(
+            'Benchmark %s: not every candidate measured %d steps within '
+            '%.0fs; ranking the ones that did.', benchmark,
+            min_measured_steps, timeout)
+        results = update_benchmark_results(benchmark)
+        measured = [r for r in results
+                    if r['num_steps'] and r['seconds_per_step']]
+
+    def projected(rec):
+        sps = rec['seconds_per_step']
+        if by == 'time':
+            return sps * steps_target
+        return rec['hourly_cost'] * sps / 3600.0 * steps_target
+
+    ranked = sorted(measured, key=projected)
+    losers = ranked[keep_top:]
+    from skypilot_tpu import core
+    from skypilot_tpu import global_user_state
+
+    def _terminate(rec):
+        if global_user_state.get_cluster_from_name(
+                rec['cluster']) is not None:
+            try:
+                core.down(rec['cluster'], purge=True)
+            except exceptions.SkyTpuError as e:
+                logger.warning('early-terminate %s: %s', rec['cluster'], e)
+        benchmark_state.update_result(
+            benchmark, rec['cluster'], BenchmarkStatus.TERMINATED,
+            rec['num_steps'], rec['seconds_per_step'],
+            rec['first_step_ts'], rec['last_step_ts'])
+
+    subprocess_utils.run_in_parallel(_terminate, losers)
+    if losers:
+        logger.info(
+            'Benchmark %s: kept %s; terminated %d loser(s) early.',
+            benchmark, [r['cluster'] for r in ranked[:keep_top]],
+            len(losers))
+    return report(benchmark, steps_target=steps_target)
+
+
+def _report_path(benchmark: str) -> str:
+    from skypilot_tpu.agent import constants as agent_constants
+    return os.path.join(agent_constants.agent_home(), 'benchmarks',
+                        f'{benchmark}.json')
+
+
+def save_report(benchmark: str,
+                steps_target: Optional[int] = None) -> str:
+    """Persist the current report to disk so results survive
+    `bench down` (reference: the reference keeps benchmark records in
+    its state db after clusters die, benchmark_utils.py:274)."""
+    rows = report(benchmark, steps_target=steps_target)
+    path = _report_path(benchmark)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    serializable = []
+    for row in rows:
+        row = dict(row)
+        status = row.get('status')
+        if isinstance(status, BenchmarkStatus):
+            row['status'] = status.value
+        serializable.append(row)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump({'benchmark': benchmark, 'steps_target': steps_target,
+                   'results': serializable}, f, indent=2)
+    return path
+
+
+def load_report(benchmark: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_report_path(benchmark), encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def down_benchmark(benchmark: str) -> None:
     """Terminate every candidate cluster and drop state."""
     from skypilot_tpu import core

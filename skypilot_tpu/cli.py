@@ -791,12 +791,17 @@ def bench_launch(entrypoint, benchmark, candidates, cloud, yes):
 @click.argument('benchmark')
 @click.option('--steps', type=int, default=None,
               help='Report time/cost to reach this step count.')
-def bench_show(benchmark, steps):
+@click.option('--save', is_flag=True, default=False,
+              help='Persist the report to disk (survives bench down).')
+def bench_show(benchmark, steps, save):
     from skypilot_tpu.benchmark import benchmark_utils
     try:
         benchmark_utils.update_benchmark_results(benchmark)
     except exceptions.SkyTpuError as e:
         _fail(str(e))
+    if save:
+        path = benchmark_utils.save_report(benchmark, steps_target=steps)
+        click.echo(f'Report saved to {path}.')
     rows = []
     for r in benchmark_utils.report(benchmark, steps_target=steps):
         rows.append([
@@ -822,10 +827,37 @@ def bench_down(benchmark, yes):
     from skypilot_tpu.benchmark import benchmark_utils
     _confirm(f'Tear down benchmark {benchmark!r} clusters?', yes)
     try:
+        # Preserve the final numbers before the state rows disappear.
+        benchmark_utils.save_report(benchmark)
         benchmark_utils.down_benchmark(benchmark)
     except exceptions.SkyTpuError as e:
         _fail(str(e))
-    click.echo(f'Benchmark {benchmark!r} torn down.')
+    click.echo(f'Benchmark {benchmark!r} torn down; final report kept '
+               'on disk.')
+
+
+@bench.command('race')
+@click.argument('benchmark')
+@click.option('--steps', type=int, required=True,
+              help='Target step count for the projection.')
+@click.option('--keep-top', type=int, default=1,
+              help='Candidates to keep running; losers terminate.')
+@click.option('--by', type=click.Choice(['cost', 'time']),
+              default='cost')
+@click.option('--timeout', type=float, default=3600.0)
+def bench_race(benchmark, steps, keep_top, by, timeout):
+    """Wait for measured step times, then terminate the losers early
+    (keeps the top candidates running to the target)."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    try:
+        rows = benchmark_utils.wait_and_terminate_losers(
+            benchmark, steps_target=steps, keep_top=keep_top, by=by,
+            timeout=timeout)
+    except exceptions.SkyTpuError as e:
+        _fail(str(e))
+    for r in rows:
+        click.echo(f"{r['cluster']}: {r['status'].value} "
+                   f"sec/step={r['seconds_per_step']}")
 
 
 def main() -> None:

@@ -229,11 +229,16 @@ def queue(refresh: bool = True,
         jobs_utils.update_managed_job_status()
         _drain_controller_queue()
         from skypilot_tpu.jobs import remote as jobs_remote
+        # Batched by controller cluster: N remote jobs on one cluster
+        # cost one RPC round-trip, not N.
+        by_cluster: Dict[str, List[int]] = {}
         for job_id in state.get_nonterminal_job_ids():
             info = state.get_job_info(job_id)
             if info and info.get('remote_cluster'):
-                jobs_remote.sync_down_remote(job_id,
-                                             info['remote_cluster'])
+                by_cluster.setdefault(info['remote_cluster'],
+                                      []).append(job_id)
+        for cluster, ids in by_cluster.items():
+            jobs_remote.sync_down_remote_batch(cluster, ids)
     records = state.get_managed_jobs()
     if skip_finished:
         records = [r for r in records if not r['status'].is_terminal()]
@@ -260,15 +265,23 @@ def cancel(name: Optional[str] = None,
         elif info and info.get('controller_pid') is None:
             # Still queued behind the controller cap (never spawned):
             # nothing is provisioned — cancel directly so the slot
-            # queue doesn't start it later. No controller will ever run
-            # its bucket cleanup, so do it here.
-            state.set_cancelling(job_id)
-            state.set_cancelled(job_id)
-            jobs_utils.check_cancel_signal(job_id)  # consume any signal
-            if info.get('bucket_url'):
-                from skypilot_tpu.utils import controller_utils
-                controller_utils.delete_translated_bucket(
-                    info['bucket_url'])
+            # queue doesn't start it later. Under _spawn_lock and with
+            # a pid re-read: a concurrent drain could otherwise spawn
+            # the controller between our read and the CANCELLED write,
+            # resurrecting a job whose bucket we just deleted.
+            with _spawn_lock():
+                info = state.get_job_info(job_id)
+                if info.get('controller_pid') is None:
+                    state.set_cancelling(job_id)
+                    state.set_cancelled(job_id)
+                    jobs_utils.check_cancel_signal(job_id)
+                    if info.get('bucket_url'):
+                        from skypilot_tpu.utils import controller_utils
+                        controller_utils.delete_translated_bucket(
+                            info['bucket_url'])
+                else:
+                    # Lost the race: it IS running now — signal it.
+                    jobs_utils.send_cancel_signal(job_id)
         else:
             jobs_utils.send_cancel_signal(job_id)
         cancelled.append(job_id)

@@ -40,15 +40,9 @@ def _controller_resources(dag: 'dag_lib.Dag'):
     this build's provisioners are TPU-first, so the controller rides the
     smallest dev slice; its chips idle.)"""
     from skypilot_tpu import resources as resources_lib
-    cloud = None
-    for task in dag.tasks:
-        for res in task.resources:
-            if res.cloud_name is not None:
-                cloud = res.cloud_name
-                break
-        if cloud:
-            break
-    return {resources_lib.Resources(cloud=cloud)}
+    from skypilot_tpu.utils import remote_rpc
+    return {resources_lib.Resources(cloud=remote_rpc.first_cloud_of(
+        dag.tasks))}
 
 
 def launch_remote(dag: 'dag_lib.Dag', job_id: int, dag_yaml: str,
@@ -112,25 +106,48 @@ def cancel_remote(cluster_name: str, job_id: int) -> None:
     _rpc(cluster_name, body)
 
 
-def sync_down_remote(job_id: int, cluster_name: str) -> bool:
-    """Refresh the client-side mirror of one remote job. Returns False
-    (and marks FAILED_CONTROLLER) when the controller cluster is gone —
-    the remote analogue of dead-controller-process detection."""
+def sync_down_remote_batch(cluster_name: str,
+                           job_ids: List[int]) -> bool:
+    """Refresh the client-side mirror of every given remote job on one
+    controller cluster in a SINGLE round-trip. Returns False (and marks
+    the jobs FAILED_CONTROLLER) only when the controller cluster itself
+    is GONE — a transient RPC failure leaves the last-known state
+    untouched (a one-off SSH hiccup must not brand a live job failed
+    forever: FAILED_CONTROLLER is terminal and never re-synced)."""
     from skypilot_tpu.jobs import state
 
+    body = (
+        'from skypilot_tpu.jobs import state; '
+        'from skypilot_tpu.utils import common_utils; '
+        f'payload = {{job_id: [dict(r, status=r["status"].value) '
+        f'for r in state.get_task_records(job_id)] '
+        f'for job_id in {sorted(job_ids)!r}}}; '
+        'print(common_utils.encode_payload(payload))')
     try:
-        records = query_remote_records(cluster_name, job_id)
-    except (exceptions.ClusterNotUpError, exceptions.CommandError) as e:
-        status = state.get_status(job_id)
-        if status is not None and not status.is_terminal():
-            logger.warning(
-                'Controller cluster %s for managed job %d is '
-                'unreachable (%s); marking FAILED_CONTROLLER.',
-                cluster_name, job_id, e)
-            state.set_failed(
-                job_id, None, state.ManagedJobStatus.FAILED_CONTROLLER,
-                f'Controller cluster {cluster_name} unreachable.')
+        by_job = _rpc(cluster_name, body)
+    except exceptions.ClusterNotUpError as e:
+        for job_id in job_ids:
+            status = state.get_status(job_id)
+            if status is not None and not status.is_terminal():
+                logger.warning(
+                    'Controller cluster %s for managed job %d is gone '
+                    '(%s); marking FAILED_CONTROLLER.', cluster_name,
+                    job_id, e)
+                state.set_failed(
+                    job_id, None,
+                    state.ManagedJobStatus.FAILED_CONTROLLER,
+                    f'Controller cluster {cluster_name} is gone.')
         return False
-    if records:
-        state.sync_remote_records(job_id, records)
+    except exceptions.CommandError as e:
+        logger.warning(
+            'Transient RPC failure to controller cluster %s (%s); '
+            'keeping last-known job states.', cluster_name, e)
+        return True
+    for job_id, records in by_job.items():
+        if records:
+            state.sync_remote_records(int(job_id), records)
     return True
+
+
+def sync_down_remote(job_id: int, cluster_name: str) -> bool:
+    return sync_down_remote_batch(cluster_name, [job_id])

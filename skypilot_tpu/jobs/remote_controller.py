@@ -51,13 +51,8 @@ def main() -> int:
         level=logging.INFO,
         format='%(asctime)s %(levelname)s %(name)s: %(message)s')
 
-    from skypilot_tpu import global_user_state
-    if args.enabled_clouds:
-        existing = set(global_user_state.get_enabled_clouds() or [])
-        wanted = [c for c in args.enabled_clouds.split(',') if c]
-        if set(wanted) - existing:
-            global_user_state.set_enabled_clouds(
-                sorted(existing | set(wanted)))
+    from skypilot_tpu.utils import remote_rpc
+    remote_rpc.merge_enabled_clouds(args.enabled_clouds)
 
     from skypilot_tpu.jobs import controller
     from skypilot_tpu.jobs import state as jobs_state

@@ -7,9 +7,10 @@ between stages (:75-106), pretty plan table (:709).
 
 Differences by design: candidates are (accelerator, region, spot) triples
 from the TPU catalog rather than cross-cloud instance types; the general-DAG
-solver is an exact enumerator with branch-and-bound for small DAGs and
-coordinate-descent local search for large ones (pulp/CBC is not a
-dependency). Both specialize to the same DP on chains.
+solver is an exact enumerator for small assignment spaces and an exact
+MILP (scipy/HiGHS instead of the reference's pulp/CBC) for large ones,
+with coordinate-descent local search only as a no-scipy fallback. All
+specialize to the same DP on chains.
 """
 from __future__ import annotations
 
@@ -205,8 +206,14 @@ def _solve(
                     best, best_cost = a, c
             assign = best
         else:
-            assign = _solve_local_search(tasks, candidates, node_costs,
-                                         assignment_cost)
+            try:
+                assign = _solve_ilp(tasks, dag, candidates, node_costs,
+                                    minimize)
+            except Exception:  # pylint: disable=broad-except
+                # scipy missing or the MILP failed: coordinate descent
+                # keeps the optimizer available (approximate).
+                assign = _solve_local_search(tasks, candidates, node_costs,
+                                             assignment_cost)
 
     plan = {}
     for t in tasks:
@@ -239,6 +246,90 @@ def _solve_chain_dp(tasks, dag, candidates, node_costs,
     for i in range(n - 1, -1, -1):
         assign[tasks[i]] = j
         j = parent_ptr[i][j]
+    return assign
+
+
+def _solve_ilp(tasks, dag, candidates, node_costs,
+               minimize) -> Dict['Task', int]:
+    """Exact MILP for large general DAGs (reference: _optimize_by_ilp via
+    pulp/CBC, sky/optimizer.py:461; here scipy's HiGHS — already in the
+    image — so large DAGs get an optimality guarantee instead of
+    coordinate descent).
+
+    Standard assignment linearization: binary x[t,j] picks candidate j
+    for task t (sum_j x[t,j] = 1); for each DAG edge with any nonzero
+    egress, continuous e[u,i,v,j] >= x[u,i] + x[v,j] - 1 carries the
+    egress cost (at a minimizing optimum with binary x, e is exactly the
+    product). Edges whose egress is all-zero create no variables, so the
+    common TPU case (same-cloud stages) stays a pure per-task argmin.
+    """
+    import numpy as np
+    from scipy import sparse
+    from scipy.optimize import Bounds, LinearConstraint, milp
+
+    offsets: Dict['Task', int] = {}
+    nvar = 0
+    for t in tasks:
+        offsets[t] = nvar
+        nvar += len(candidates[t])
+    n_x = nvar
+
+    # (u_offset+i, v_offset+j, cost) per nonzero-egress pair.
+    edge_entries: List[Tuple[int, int, float]] = []
+    for u in tasks:
+        for v in dag.downstream(u):
+            pair_costs = [
+                (i, j, _edge_cost(u, candidates[u][i], v,
+                                  candidates[v][j], minimize))
+                for i in range(len(candidates[u]))
+                for j in range(len(candidates[v]))
+            ]
+            if any(c != 0.0 for _, _, c in pair_costs):
+                for i, j, c in pair_costs:
+                    edge_entries.append(
+                        (offsets[u] + i, offsets[v] + j, c))
+    n_e = len(edge_entries)
+
+    obj = np.zeros(n_x + n_e)
+    for t in tasks:
+        for j, (o, _, _) in enumerate(node_costs[t]):
+            obj[offsets[t] + j] = o
+    for k, (_, _, c) in enumerate(edge_entries):
+        obj[n_x + k] = c
+
+    rows, cols, vals = [], [], []
+    lbs, ubs = [], []
+    row = 0
+    for t in tasks:  # sum_j x[t,j] == 1
+        for j in range(len(candidates[t])):
+            rows.append(row)
+            cols.append(offsets[t] + j)
+            vals.append(1.0)
+        lbs.append(1.0)
+        ubs.append(1.0)
+        row += 1
+    for k, (xi, xj, _) in enumerate(edge_entries):
+        # x_u_i + x_v_j - e_k <= 1
+        rows.extend([row, row, row])
+        cols.extend([xi, xj, n_x + k])
+        vals.extend([1.0, 1.0, -1.0])
+        lbs.append(-np.inf)
+        ubs.append(1.0)
+        row += 1
+
+    a_mat = sparse.csr_matrix((vals, (rows, cols)), shape=(row, n_x + n_e))
+    integrality = np.concatenate(
+        [np.ones(n_x), np.zeros(n_e)])  # x binary, e continuous
+    result = milp(c=obj,
+                  constraints=LinearConstraint(a_mat, lbs, ubs),
+                  integrality=integrality,
+                  bounds=Bounds(0.0, 1.0))
+    if not result.success or result.x is None:
+        raise RuntimeError(f'MILP failed: {result.message}')
+    assign: Dict['Task', int] = {}
+    for t in tasks:
+        block = result.x[offsets[t]:offsets[t] + len(candidates[t])]
+        assign[t] = int(max(range(len(block)), key=lambda j: block[j]))
     return assign
 
 

@@ -166,8 +166,14 @@ class SkyServeController:
             logger.info('Rollout v%d: %d new replicas ready; draining '
                         '%d old.', ro['version'], len(ready_new),
                         len(ro['old_ids']))
-        if ro['draining'] and all(rid not in infos
-                                  for rid in ro['old_ids']):
+        def _retired(rid: int) -> bool:
+            # Gone, or wedged in a terminal failure (e.g. FAILED_CLEANUP
+            # after a teardown error — the row persists for visibility
+            # but must not pin the rollout open forever, freezing
+            # autoscaling and all future updates).
+            return rid not in infos or infos[rid].status.is_failed()
+
+        if ro['draining'] and all(_retired(rid) for rid in ro['old_ids']):
             logger.info('Rollout to v%d complete.', ro['version'])
             self._rollout = None
 

@@ -117,6 +117,7 @@ def _up_remote(task: 'task_lib.Task', service_name: str, task_yaml: str,
     from skypilot_tpu import resources as resources_lib
     from skypilot_tpu import task as task_lib_mod
     from skypilot_tpu.agent import constants as agent_constants
+    from skypilot_tpu.utils import remote_rpc
 
     cluster_name = constants.controller_cluster_name()
     remote_yaml = f'~/serve-tasks/{service_name}.yaml'
@@ -130,14 +131,12 @@ def _up_remote(task: 'task_lib.Task', service_name: str, task_yaml: str,
     if enabled:
         run_cmd += f' --enabled-clouds {shlex.quote(enabled)}'
 
-    cloud = None
-    for res in task.resources:
-        if res.cloud_name is not None:
-            cloud = res.cloud_name
-            break
     controller_task = task_lib_mod.Task(
         name=f'serve-controller-{service_name}', run=run_cmd)
-    controller_task.set_resources({resources_lib.Resources(cloud=cloud)})
+    controller_task.set_resources({
+        resources_lib.Resources(
+            cloud=remote_rpc.first_cloud_of([task]))
+    })
     controller_task.set_file_mounts({remote_yaml: task_yaml})
     _, handle = execution.launch(controller_task,
                                  cluster_name=cluster_name,

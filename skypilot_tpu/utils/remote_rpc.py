@@ -15,6 +15,30 @@ from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.utils import common_utils
 
 
+def merge_enabled_clouds(comma_list: str) -> None:
+    """Controller-host bootstrap: union the client-shipped cloud list
+    into this host's (fresh) state db. Shared by
+    jobs/remote_controller.py and serve/remote_service.py."""
+    if not comma_list:
+        return
+    from skypilot_tpu import global_user_state
+    existing = set(global_user_state.get_enabled_clouds() or [])
+    wanted = {c for c in comma_list.split(',') if c}
+    if wanted - existing:
+        global_user_state.set_enabled_clouds(sorted(existing | wanted))
+
+
+def first_cloud_of(tasks) -> 'str | None':
+    """The first explicit cloud among the tasks' resources — the cloud
+    the controller cluster itself launches into (fake jobs get a fake
+    controller)."""
+    for task in tasks:
+        for res in task.resources:
+            if res.cloud_name is not None:
+                return res.cloud_name
+    return None
+
+
 def head_runner(cluster_name: str, operation: str = 'controller-rpc'):
     from skypilot_tpu.backends import backend_utils
     handle = backend_utils.check_cluster_available(cluster_name, operation)
