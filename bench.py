@@ -75,6 +75,10 @@ def _parse_args(argv=None):
     parser.add_argument('--speculative', type=int, default=0,
                         help='serve row: prompt-lookup speculative '
                              'decoding draft length')
+    parser.add_argument('--prefix-cache', type=int, default=0,
+                        help='serve row: LRU of N prefilled prompts; '
+                             'shared-prefix requests prefill only the '
+                             'suffix')
     parser.add_argument('--tune-attn', action='store_true',
                         help='sweep flash-attention block sizes per '
                              'sequence length (fwd+bwd wall time) and '
@@ -260,7 +264,7 @@ def _append_partial(row: dict) -> None:
 
 
 def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
-                  kv_quant=None, speculative=0) -> dict:
+                  kv_quant=None, speculative=0, prefix_cache=0) -> dict:
     """p50/p99 time-to-first-token + aggregate decode throughput under
     concurrent requests on the local chip(s) via the continuous-batching
     engine (models/inference.py) — the BASELINE.md serving row."""
@@ -270,7 +274,7 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
     engine = inference_lib.ContinuousBatchingEngine(
         cfg, num_slots=4, mesh=mesh, quantize=quantize,
         decode_chunk=decode_chunk, kv_quant=kv_quant,
-        speculative=speculative)
+        speculative=speculative, prefix_cache=prefix_cache)
     prompt = list(range(1, 33))
     # Warmup: compile prefill + decode (and the verify step, if on).
     engine.generate(prompt, max_new_tokens=4)
@@ -303,6 +307,14 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
         drafted = max(1, engine.spec_stats['drafted'])
         row['spec_accept_rate'] = round(
             engine.spec_stats['accepted'] / drafted, 3)
+    if prefix_cache:
+        # All 16 requests share one prompt: after the first admit, every
+        # prefill is a (near-total) prefix hit — the lever's upper bound.
+        row['prefix_hit_rate'] = round(
+            engine.prefix_stats['hits'] /
+            max(1, engine.prefix_stats['hits'] +
+                engine.prefix_stats['misses']), 3)
+        row['prefix_tokens_reused'] = engine.prefix_stats['tokens_reused']
     return row
 
 
@@ -453,13 +465,16 @@ def _worker(args) -> int:
         ttft = _measure_ttft(serve_cfg, mesh, quantize=args.quantize,
                              decode_chunk=args.decode_chunk,
                              kv_quant=args.kv_quant,
-                             speculative=args.speculative)
+                             speculative=args.speculative,
+                             prefix_cache=args.prefix_cache)
         print(f'serve: {ttft}', file=sys.stderr)
         tags = [t for t in (args.quantize,
                             f'kv-{args.kv_quant}' if args.kv_quant
                             else None,
                             f'spec-{args.speculative}'
-                            if args.speculative else None) if t]
+                            if args.speculative else None,
+                            f'pfx-{args.prefix_cache}'
+                            if args.prefix_cache else None) if t]
         result = {
             'metric': f'{serve_cfg.name} serve p50 TTFT'
                       + (f' ({"+".join(tags)})' if tags else ''),
@@ -470,6 +485,7 @@ def _worker(args) -> int:
             'quantize': args.quantize or 'none',
             'kv_quant': args.kv_quant or 'none',
             'speculative': args.speculative,
+            'prefix_cache': args.prefix_cache,
             **ttft,
         }
         print(json.dumps(result))

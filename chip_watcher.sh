@@ -21,7 +21,8 @@ EOF
     timeout 3600 python bench.py --tune-attn > bench_r5_tune.json 2> bench_r5_tune.err
     echo "[watcher] tune-attn rc=$? $(date -u +%FT%TZ)" >> "$LOG"
     timeout 3600 python bench.py --serve --quantize int8 --kv-quant int8 \
-      --speculative 4 --decode-chunk 8 > bench_r5_levers.json 2> bench_r5_levers.err
+      --speculative 4 --decode-chunk 8 --prefix-cache 4 \
+      > bench_r5_levers.json 2> bench_r5_levers.err
     echo "[watcher] levers rc=$? $(date -u +%FT%TZ) DONE" >> "$LOG"
     break
   else

@@ -172,8 +172,12 @@ def wait_and_terminate_losers(
             '%.0fs; ranking the ones that did.', benchmark,
             min_measured_steps, timeout)
         results = update_benchmark_results(benchmark)
+        # SAME reliability bar as the happy path: a single
+        # compile-inflated step must not get a candidate terminated.
         measured = [r for r in results
-                    if r['num_steps'] and r['seconds_per_step']]
+                    if r['num_steps'] and
+                    r['num_steps'] >= min_measured_steps and
+                    r['seconds_per_step']]
 
     def projected(rec):
         sps = rec['seconds_per_step']

@@ -200,6 +200,141 @@ def _wait_operation(op_name: str) -> None:
         f'{_POLL_TIMEOUT_S:.0f}s (SKYTPU_STS_TIMEOUT to raise)')
 
 
+# ---------------- GCS → S3 export (the reverse direction) ----------------
+# The reference drives this with rclone (data_transfer.py:123-192); this
+# image carries neither rclone nor boto, so the export is a self-contained
+# stdlib implementation: list+read objects via the GCS JSON API (same
+# injectable transport as the import path) and PUT them to S3 with SigV4
+# request signing. Data streams THROUGH this machine (exactly like
+# rclone would); for bucket-scale exports prefer running it from a VM in
+# the source region.
+
+# s3_transport(method, url, headers, body_bytes) -> (status, body_bytes)
+_s3_transport_override = None
+
+
+def set_s3_transport_override(transport) -> None:
+    global _s3_transport_override
+    _s3_transport_override = transport
+
+
+def _s3_request(method: str, url: str, headers: Dict[str, str],
+                body: bytes) -> Tuple[int, bytes]:
+    if _s3_transport_override is not None:
+        return _s3_transport_override(method, url, headers, body)
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(url, data=body or None, method=method,
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _sigv4_headers(method: str, host: str, path: str, region: str,
+                   body: bytes, access_key: str, secret_key: str,
+                   now=None) -> Dict[str, str]:
+    """AWS Signature Version 4 for one S3 request (stdlib only)."""
+    import datetime
+    import hashlib
+    import hmac
+
+    if now is None:
+        now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime('%Y%m%dT%H%M%SZ')
+    datestamp = now.strftime('%Y%m%d')
+    payload_hash = hashlib.sha256(body).hexdigest()
+    canonical_headers = (f'host:{host}\n'
+                         f'x-amz-content-sha256:{payload_hash}\n'
+                         f'x-amz-date:{amz_date}\n')
+    signed_headers = 'host;x-amz-content-sha256;x-amz-date'
+    canonical_request = (f'{method}\n{path}\n\n{canonical_headers}\n'
+                         f'{signed_headers}\n{payload_hash}')
+    scope = f'{datestamp}/{region}/s3/aws4_request'
+    string_to_sign = (
+        'AWS4-HMAC-SHA256\n' + amz_date + '\n' + scope + '\n' +
+        hashlib.sha256(canonical_request.encode()).hexdigest())
+
+    def hmac_sha256(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k_date = hmac_sha256(('AWS4' + secret_key).encode(), datestamp)
+    k_region = hmac_sha256(k_date, region)
+    k_service = hmac_sha256(k_region, 's3')
+    k_signing = hmac_sha256(k_service, 'aws4_request')
+    signature = hmac.new(k_signing, string_to_sign.encode(),
+                         hashlib.sha256).hexdigest()
+    return {
+        'x-amz-date': amz_date,
+        'x-amz-content-sha256': payload_hash,
+        'Authorization': (
+            f'AWS4-HMAC-SHA256 Credential={access_key}/{scope}, '
+            f'SignedHeaders={signed_headers}, Signature={signature}'),
+    }
+
+
+def _gcs_list_objects(gs_bucket: str, prefix: str) -> list:
+    import urllib.parse
+    names = []
+    page_token = ''
+    while True:
+        query = f'prefix={urllib.parse.quote(prefix)}' if prefix else ''
+        if page_token:
+            query += f'&pageToken={page_token}'
+        url = f'{STORAGE_ROOT}/b/{gs_bucket}/o'
+        if query:
+            url += f'?{query.lstrip("&")}'
+        listing = _call('GET', url)
+        names.extend(o['name'] for o in listing.get('items', []))
+        page_token = listing.get('nextPageToken', '')
+        if not page_token:
+            break
+    return names
+
+
+def _gcs_read_object(gs_bucket: str, name: str) -> bytes:
+    import base64
+    import urllib.parse
+    url = (f'{STORAGE_ROOT}/b/{gs_bucket}/o/'
+           f'{urllib.parse.quote(name, safe="")}?alt=media')
+    payload = _call('GET', url)
+    # Through the dict transport, media comes back base64-wrapped.
+    if isinstance(payload, dict):
+        return base64.b64decode(payload.get('data_b64', ''))
+    return payload
+
+
+def gcs_to_s3(gs_bucket: str, s3_bucket: str, *, prefix: str = '',
+              region: str = 'us-east-1') -> int:
+    """Copy every object under gs://{gs_bucket}/{prefix} to
+    s3://{s3_bucket}/ (same keys). Returns the object count.
+
+    Client-streamed (see module note); both endpoints are injectable so
+    the whole direction is hermetically testable.
+    """
+    access_key, secret_key = aws_credentials()
+    names = _gcs_list_objects(gs_bucket, prefix)
+    host = f'{s3_bucket}.s3.{region}.amazonaws.com'
+    import urllib.parse
+    for name in names:
+        body = _gcs_read_object(gs_bucket, name)
+        path = '/' + urllib.parse.quote(name)
+        headers = _sigv4_headers('PUT', host, path, region, body,
+                                 access_key, secret_key)
+        headers['host'] = host
+        status, resp = _s3_request('PUT', f'https://{host}{path}',
+                                   headers, body)
+        if status >= 300:
+            raise exceptions.StorageError(
+                f'S3 PUT s3://{s3_bucket}{path} failed ({status}): '
+                f'{resp[:300]!r}')
+    logger.info('exported %d objects gs://%s/%s -> s3://%s', len(names),
+                gs_bucket, prefix, s3_bucket)
+    return len(names)
+
+
 def mirror_bucket_name(s3_bucket: str) -> str:
     """Deterministic GCS mirror name so re-imports are incremental.
 

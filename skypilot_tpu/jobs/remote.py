@@ -87,17 +87,6 @@ def _rpc(cluster_name: str, body: str) -> Any:
     return remote_rpc.rpc(cluster_name, body, operation='jobs-rpc')
 
 
-def query_remote_records(cluster_name: str,
-                         job_id: int) -> List[Dict[str, Any]]:
-    body = (
-        'from skypilot_tpu.jobs import state; '
-        'from skypilot_tpu.utils import common_utils; '
-        f'recs = state.get_task_records({job_id}); '
-        'payload = [dict(r, status=r["status"].value) for r in recs]; '
-        'print(common_utils.encode_payload(payload))')
-    return _rpc(cluster_name, body)
-
-
 def cancel_remote(cluster_name: str, job_id: int) -> None:
     body = ('from skypilot_tpu.jobs import utils; '
             f'utils.send_cancel_signal({job_id}); '
@@ -106,14 +95,37 @@ def cancel_remote(cluster_name: str, job_id: int) -> None:
     _rpc(cluster_name, body)
 
 
+# Consecutive RPC failures per controller cluster — the escalation
+# counter for dead-cluster detection (see sync_down_remote_batch).
+_rpc_failures: Dict[str, int] = {}
+_RPC_FAILURES_BEFORE_PROBE = 3
+
+
+def _mark_controller_gone(cluster_name: str, job_ids: List[int],
+                          why: str) -> None:
+    from skypilot_tpu.jobs import state
+    for job_id in job_ids:
+        status = state.get_status(job_id)
+        if status is not None and not status.is_terminal():
+            logger.warning(
+                'Controller cluster %s for managed job %d is gone (%s); '
+                'marking FAILED_CONTROLLER.', cluster_name, job_id, why)
+            state.set_failed(
+                job_id, None, state.ManagedJobStatus.FAILED_CONTROLLER,
+                f'Controller cluster {cluster_name} is gone ({why}).')
+
+
 def sync_down_remote_batch(cluster_name: str,
                            job_ids: List[int]) -> bool:
     """Refresh the client-side mirror of every given remote job on one
     controller cluster in a SINGLE round-trip. Returns False (and marks
-    the jobs FAILED_CONTROLLER) only when the controller cluster itself
-    is GONE — a transient RPC failure leaves the last-known state
-    untouched (a one-off SSH hiccup must not brand a live job failed
-    forever: FAILED_CONTROLLER is terminal and never re-synced)."""
+    the jobs FAILED_CONTROLLER) when the controller cluster is GONE. A
+    transient RPC failure leaves the last-known state untouched (a
+    one-off SSH hiccup must not brand a live job failed forever —
+    FAILED_CONTROLLER is terminal and never re-synced), but repeated
+    failures escalate to a force-refreshed cloud-truth probe so a
+    cluster deleted out-of-band (stale UP record → CommandError, not
+    ClusterNotUpError) is still detected."""
     from skypilot_tpu.jobs import state
 
     body = (
@@ -126,23 +138,37 @@ def sync_down_remote_batch(cluster_name: str,
     try:
         by_job = _rpc(cluster_name, body)
     except exceptions.ClusterNotUpError as e:
-        for job_id in job_ids:
-            status = state.get_status(job_id)
-            if status is not None and not status.is_terminal():
-                logger.warning(
-                    'Controller cluster %s for managed job %d is gone '
-                    '(%s); marking FAILED_CONTROLLER.', cluster_name,
-                    job_id, e)
-                state.set_failed(
-                    job_id, None,
-                    state.ManagedJobStatus.FAILED_CONTROLLER,
-                    f'Controller cluster {cluster_name} is gone.')
+        _rpc_failures.pop(cluster_name, None)
+        _mark_controller_gone(cluster_name, job_ids, str(e))
         return False
     except exceptions.CommandError as e:
-        logger.warning(
-            'Transient RPC failure to controller cluster %s (%s); '
-            'keeping last-known job states.', cluster_name, e)
-        return True
+        fails = _rpc_failures.get(cluster_name, 0) + 1
+        _rpc_failures[cluster_name] = fails
+        if fails < _RPC_FAILURES_BEFORE_PROBE:
+            logger.warning(
+                'RPC failure %d/%d to controller cluster %s (%s); '
+                'keeping last-known job states.', fails,
+                _RPC_FAILURES_BEFORE_PROBE, cluster_name, e)
+            return True
+        # Escalate: ask the CLOUD whether the cluster still exists.
+        from skypilot_tpu.backends import backend_utils
+        from skypilot_tpu.status_lib import ClusterStatus
+        try:
+            status, _ = backend_utils.refresh_cluster_status_handle(
+                cluster_name, force_refresh=True)
+        except Exception:  # pylint: disable=broad-except
+            status = None
+        if status == ClusterStatus.UP:
+            logger.warning(
+                'Controller cluster %s is UP but RPC keeps failing '
+                '(%s); keeping last-known job states.', cluster_name, e)
+            return True
+        _rpc_failures.pop(cluster_name, None)
+        _mark_controller_gone(cluster_name, job_ids,
+                              f'{fails} consecutive RPC failures and '
+                              f'cloud status {status}')
+        return False
+    _rpc_failures.pop(cluster_name, None)
     for job_id, records in by_job.items():
         if records:
             state.sync_remote_records(int(job_id), records)

@@ -14,7 +14,7 @@ GELU MLP, biases everywhere) — and MoE (Mixtral-style) is switched by
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -28,6 +28,13 @@ class ModelConfig:
     d_mlp: int
     max_seq_len: int = 2048
     rope_theta: float = 500000.0
+    # Llama-3.1-style rope frequency scaling for long context, as
+    # (factor, low_freq_factor, high_freq_factor,
+    #  original_max_position_embeddings) — None ⇒ plain rope. Low
+    # frequencies (long wavelengths vs the original training window)
+    # divide by `factor`, high frequencies pass through, the band
+    # between interpolates smoothly (HF `rope_type: llama3`).
+    rope_scaling: Optional[Tuple[float, float, float, float]] = None
     norm_eps: float = 1e-5
     # --- Architecture-family knobs (compose; Llama-3 is all-defaults) ---
     # Gemma fixes head_dim=256 independent of d_model/num_heads.
@@ -230,6 +237,21 @@ LLAMA3_8B = _register(ModelConfig(
 LLAMA3_70B = _register(ModelConfig(
     name='llama3-70b', vocab_size=128256, d_model=8192, num_layers=80,
     num_heads=64, num_kv_heads=8, d_mlp=28672, max_seq_len=8192))
+
+# --- Llama-3.1: same weights shape as Llama-3, 128k context via llama3
+# rope scaling (factor 8 over the 8192-token original window). The
+# flagship long-context serving/finetune target (BASELINE.json names
+# Llama-3.1-8B); pairs with `attention_impl: ring` for sequence
+# parallelism past one chip's HBM.
+LLAMA31_8B = _register(ModelConfig(
+    name='llama31-8b', vocab_size=128256, d_model=4096, num_layers=32,
+    num_heads=32, num_kv_heads=8, d_mlp=14336, max_seq_len=131072,
+    rope_scaling=(8.0, 1.0, 4.0, 8192)))
+
+LLAMA31_70B = _register(ModelConfig(
+    name='llama31-70b', vocab_size=128256, d_model=8192, num_layers=80,
+    num_heads=64, num_kv_heads=8, d_mlp=28672, max_seq_len=131072,
+    rope_scaling=(8.0, 1.0, 4.0, 8192)))
 
 # --- Llama-2 family (reference recipes: llm/llama-2, llm/vicuna-llama-2,
 # llm/codellama). Plain pre-Llama-3 shape: MHA for 7B/13B (num_kv_heads

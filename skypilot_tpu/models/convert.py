@@ -421,6 +421,16 @@ def hf_config_for(cfg: ModelConfig):
         max_position_embeddings=cfg.max_seq_len,
         rope_theta=cfg.rope_theta, rms_norm_eps=cfg.norm_eps,
         tie_word_embeddings=cfg.tie_embeddings)
+    if cfg.rope_scaling is not None:
+        factor, low_f, high_f, old_len = cfg.rope_scaling
+        # HF `rope_type: llama3` — the Llama-3.1 long-context scaling.
+        common['rope_scaling'] = {
+            'rope_type': 'llama3',
+            'factor': factor,
+            'low_freq_factor': low_f,
+            'high_freq_factor': high_f,
+            'original_max_position_embeddings': int(old_len),
+        }
     if cfg.is_moe and cfg.norm_style == 'layernorm':
         return transformers.DbrxConfig(
             d_model=cfg.d_model, n_heads=cfg.num_heads,

@@ -354,7 +354,8 @@ class ContinuousBatchingEngine:
                  kv_quant: Optional[str] = None,
                  top_k: int = 0,
                  top_p: float = 0.0,
-                 speculative: int = 0) -> None:
+                 speculative: int = 0,
+                 prefix_cache: int = 0) -> None:
         import queue as queue_lib
         import threading
         self.cfg, self.params = _resolve_cfg_and_params(
@@ -375,10 +376,20 @@ class ContinuousBatchingEngine:
         # one token per tick. Takes precedence over decode_chunk.
         self.speculative = max(0, speculative)
         self.spec_stats = {'ticks': 0, 'drafted': 0, 'accepted': 0}
+        # >0 ⇒ keep the last N prompts' prefilled KV (batch-1 caches) in
+        # an LRU; a new prompt sharing a cached PREFIX prefills only the
+        # suffix (chat turns append to history; shared system prompts).
+        # Each entry holds a full-capacity batch-1 cache in device
+        # memory — size N to the HBM you can spare.
+        self.prefix_cache = max(0, prefix_cache)
+        from collections import OrderedDict
+        self._prefix_entries: 'OrderedDict[tuple, Any]' = OrderedDict()
+        self.prefix_stats = {'hits': 0, 'misses': 0, 'tokens_reused': 0}
         self.model = Transformer(self.cfg)
         self._rng = jax.random.PRNGKey(rng_seed)
 
         self._prefill = jax.jit(self._prefill_impl)
+        self._prefill_continue = jax.jit(self._prefill_continue_impl)
         self._insert = jax.jit(self._insert_impl,
                                donate_argnames=('cache',))
         self._decode = jax.jit(self._decode_impl,
@@ -434,6 +445,23 @@ class ContinuousBatchingEngine:
             mutable=['cache'])
         last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
                                             keepdims=False)
+        return last[0], nn.unbox(mutated['cache'])
+
+    def _prefill_continue_impl(self, params, cache1, tokens, start_pos,
+                               suffix_true_len):
+        """Prefix-cache continuation: `cache1` already holds KV for
+        positions [0, start_pos); process the (1, bucket) right-padded
+        suffix at positions [start_pos, start_pos+bucket). Positional
+        masking makes this exactly equivalent to prefilling the whole
+        prompt (same invariants as _prefill_impl's pad region)."""
+        positions = start_pos + jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :],
+            tokens.shape)
+        logits, mutated = self.model.apply(
+            {'params': params, 'cache': cache1}, tokens, positions,
+            mutable=['cache'])
+        last = jax.lax.dynamic_index_in_dim(logits, suffix_true_len - 1,
+                                            axis=1, keepdims=False)
         return last[0], nn.unbox(mutated['cache'])
 
     def _insert_impl(self, cache, cache1, slot):
@@ -631,14 +659,61 @@ class ContinuousBatchingEngine:
             bucket *= 2
         return min(bucket, self.cfg.max_seq_len)
 
+    # Prefixes shorter than this are cheaper to re-prefill than to
+    # match + continue (one extra jit specialization per suffix bucket).
+    _MIN_PREFIX = 16
+
+    def _longest_cached_prefix(self, ids: list):
+        """(prefix_len, cache) of the best LRU entry that is a prefix of
+        `ids`, or (0, None). An exact-length hit reuses all but the last
+        token (the suffix must be non-empty to produce logits)."""
+        best_len, best_cache = 0, None
+        limit = len(ids) - 1
+        for key, cache in self._prefix_entries.items():
+            plen = min(len(key), limit)
+            if plen > best_len and list(key[:plen]) == ids[:plen]:
+                best_len, best_cache = plen, cache
+        return best_len, best_cache
+
+    def _store_prefix(self, ids: list, cache1) -> None:
+        key = tuple(ids)
+        self._prefix_entries[key] = cache1
+        self._prefix_entries.move_to_end(key)
+        while len(self._prefix_entries) > self.prefix_cache:
+            self._prefix_entries.popitem(last=False)
+
     def _admit(self, slot: int, req: '_Request') -> None:
         import time
         true_len = len(req.ids)
-        bucket = self._bucket(true_len)
-        padded = req.ids + [0] * (bucket - true_len)
-        tokens = jnp.asarray([padded], jnp.int32)
-        logits, cache1 = self._prefill(self.params, tokens,
-                                       jnp.asarray(true_len, jnp.int32))
+        plen, pcache = (self._longest_cached_prefix(req.ids)
+                        if self.prefix_cache else (0, None))
+        if plen >= self._MIN_PREFIX and \
+                plen + self._bucket(true_len - plen) <= \
+                self.cfg.max_seq_len:
+            # Continue from the cached prefix: only the suffix prefills.
+            suffix = req.ids[plen:]
+            bucket = self._bucket(len(suffix))
+            tokens = jnp.asarray([suffix + [0] * (bucket - len(suffix))],
+                                 jnp.int32)
+            logits, cache1 = self._prefill_continue(
+                self.params, pcache, tokens,
+                jnp.asarray(plen, jnp.int32),
+                jnp.asarray(len(suffix), jnp.int32))
+            self.prefix_stats['hits'] += 1
+            self.prefix_stats['tokens_reused'] += plen
+        else:
+            bucket = self._bucket(true_len)
+            padded = req.ids + [0] * (bucket - true_len)
+            tokens = jnp.asarray([padded], jnp.int32)
+            logits, cache1 = self._prefill(
+                self.params, tokens, jnp.asarray(true_len, jnp.int32))
+            if self.prefix_cache:
+                self.prefix_stats['misses'] += 1
+        if self.prefix_cache:
+            # The full prompt's KV is the entry future prompts extend
+            # (chat turns append); cache1 is not donated anywhere, so
+            # holding it is safe.
+            self._store_prefix(req.ids, cache1)
         first = self._sample(logits, req.temperature)
         req.first_token_time = time.time()
         req.tokens.append(first)

@@ -244,18 +244,38 @@ class RMSNorm(nn.Module):
         return out.astype(_dtype(cfg))
 
 
+def _llama3_scale_freqs(freqs: jax.Array, scaling) -> jax.Array:
+    """Llama-3.1 long-context rope correction (HF rope_type 'llama3'):
+    frequencies whose wavelength exceeds the ORIGINAL training window
+    divide by `factor`; short wavelengths pass through; the band between
+    interpolates smoothly. scaling = (factor, low_freq_factor,
+    high_freq_factor, original_max_position_embeddings)."""
+    factor, low_f, high_f, old_len = scaling
+    wavelen = 2.0 * jnp.pi / freqs
+    low_wl = old_len / low_f
+    high_wl = old_len / high_f
+    smooth = (old_len / wavelen - low_f) / (high_f - low_f)
+    interpolated = (1.0 - smooth) * freqs / factor + smooth * freqs
+    return jnp.where(wavelen > low_wl, freqs / factor,
+                     jnp.where(wavelen < high_wl, freqs, interpolated))
+
+
 def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
-               rotary_dim: int = 0) -> jax.Array:
+               rotary_dim: int = 0, scaling=None) -> jax.Array:
     """Rotary position embedding. x: (B, S, H, D); positions: (B, S).
     rotary_dim > 0 (Phi/NeoX partial rotary): only the first rotary_dim
-    dims rotate, the rest pass through unchanged."""
+    dims rotate, the rest pass through unchanged. scaling: llama3
+    long-context frequency correction (see _llama3_scale_freqs)."""
     if rotary_dim and rotary_dim < x.shape[-1]:
         rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
         return jnp.concatenate(
-            [apply_rope(rot, positions, theta), rest], axis=-1)
+            [apply_rope(rot, positions, theta, scaling=scaling), rest],
+            axis=-1)
     d = x.shape[-1]
     half = d // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    if scaling is not None:
+        freqs = _llama3_scale_freqs(freqs, scaling)
     angles = positions[..., None].astype(jnp.float32) * freqs  # (B,S,half)
     cos = jnp.cos(angles)[:, :, None, :]                       # (B,S,1,half)
     sin = jnp.sin(angles)[:, :, None, :]
@@ -298,8 +318,10 @@ class Attention(nn.Module):
                 # Even (rope pairs dims) and nonzero: int() truncation
                 # to 0 would silently mean FULL rotary (the sentinel).
                 rot = max(2, int(cfg.head_dim * cfg.rotary_pct) // 2 * 2)
-            q = apply_rope(q, positions, cfg.rope_theta, rotary_dim=rot)
-            k = apply_rope(k, positions, cfg.rope_theta, rotary_dim=rot)
+            q = apply_rope(q, positions, cfg.rope_theta, rotary_dim=rot,
+                           scaling=cfg.rope_scaling)
+            k = apply_rope(k, positions, cfg.rope_theta, rotary_dim=rot,
+                           scaling=cfg.rope_scaling)
         if cfg.decode:
             out = self._decode_attention(q, k, v, positions)
         else:

@@ -170,8 +170,9 @@ def _solve(
 
     Chains: exact DP (reference `_optimize_by_dp`, optimizer.py:400).
     General DAGs: exhaustive search when the assignment space is small,
-    else coordinate descent from the per-node-greedy start (replacing the
-    reference's CBC ILP, optimizer.py:461).
+    else an EXACT MILP via scipy/HiGHS (_solve_ilp — the reference uses
+    pulp/CBC, optimizer.py:461), with coordinate descent only as the
+    no-solver fallback.
     """
     tasks = dag.topological_order()
     node_costs: Dict['Task', List[Tuple[float, float, float]]] = {

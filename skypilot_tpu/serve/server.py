@@ -61,7 +61,8 @@ class InferenceServer:
                  kv_quant: Optional[str] = None,
                  top_k: int = 0,
                  top_p: float = 0.0,
-                 speculative: int = 0) -> None:
+                 speculative: int = 0,
+                 prefix_cache: int = 0) -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
@@ -93,7 +94,8 @@ class InferenceServer:
                                                decode_chunk=decode_chunk,
                                                kv_quant=kv_quant,
                                                top_k=top_k, top_p=top_p,
-                                               speculative=speculative)
+                                               speculative=speculative,
+                                               prefix_cache=prefix_cache)
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
         if tokenizer.startswith('hf:'):
@@ -402,6 +404,13 @@ def main(argv=None) -> int:
                              'request awaits admission (>1 cuts host '
                              'round trips; admission latency bounded by '
                              'one chunk)')
+    parser.add_argument('--prefix-cache', type=int, default=0,
+                        help='keep the last N prompts\' prefilled KV; a '
+                             'new prompt sharing a cached prefix (chat '
+                             'history, shared system prompt) prefills '
+                             'only the suffix. Each entry holds a full '
+                             'batch-1 KV cache in HBM — size to spare '
+                             'memory.')
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -416,7 +425,8 @@ def main(argv=None) -> int:
                              decode_chunk=args.decode_chunk,
                              kv_quant=args.kv_quant,
                              top_k=args.top_k, top_p=args.top_p,
-                             speculative=args.speculative)
+                             speculative=args.speculative,
+                             prefix_cache=args.prefix_cache)
     logger.info('sampling filters: top_k=%s top_p=%s (0 = off)',
                 args.top_k, args.top_p)
     server.warmup()

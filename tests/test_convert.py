@@ -74,6 +74,47 @@ class TestLlamaFamily:
         model = transformers.LlamaForCausalLM(hf_cfg)
         _logit_parity(model, _base_cfg(num_kv_heads=4))
 
+    def test_llama31_rope_scaling_logits_match(self):
+        """Llama-3.1 shape: llama3 long-context rope scaling (factor 8
+        over a short original window so EVERY frequency band — scaled,
+        pass-through, interpolated — is exercised at seq 12). Parity
+        against transformers' rope_type='llama3' implementation."""
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            rope_theta=10000.0, rms_norm_eps=1e-6,
+            rope_scaling={'rope_type': 'llama3', 'factor': 8.0,
+                          'low_freq_factor': 1.0,
+                          'high_freq_factor': 4.0,
+                          'original_max_position_embeddings': 8},
+            attn_implementation='eager')
+        model = transformers.LlamaForCausalLM(hf_cfg)
+        _logit_parity(model,
+                      _base_cfg(rope_scaling=(8.0, 1.0, 4.0, 8)))
+
+    def test_llama31_scaling_changes_logits(self):
+        """The scaling must actually DO something: same weights with and
+        without rope_scaling disagree beyond tolerance."""
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            rope_theta=10000.0, rms_norm_eps=1e-6,
+            attn_implementation='eager')
+        model = transformers.LlamaForCausalLM(hf_cfg)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 256, size=(1, 12)),
+                             jnp.int32)
+        plain_cfg = _base_cfg()
+        scaled_cfg = _base_cfg(rope_scaling=(8.0, 1.0, 4.0, 8))
+        params = load_hf_model(model, plain_cfg)
+        plain = np.asarray(Transformer(plain_cfg).apply(
+            {'params': params}, tokens))
+        scaled = np.asarray(Transformer(scaled_cfg).apply(
+            {'params': params}, tokens))
+        assert np.abs(plain - scaled).max() > 1e-3
+
     def test_codellama_padded_vocab_logits_match(self):
         """CodeLlama shape: HF vocab 260 (≅32016: not MXU-aligned) into
         a padded-vocab config; pad rows must be masked, real rows exact."""

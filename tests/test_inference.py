@@ -467,6 +467,60 @@ class TestContinuousBatching:
         for toks, _ in results:
             assert toks == want, (toks, want)
 
+    def test_prefix_cache_exact_and_reuses(self):
+        """Prefix caching: a prompt extending a previous one prefills
+        only the suffix, with greedy output IDENTICAL to the uncached
+        engine (the correctness bar: continuation-from-cached-KV is the
+        same math as full prefill)."""
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        base = list(range(2, 26))           # 24 tokens ≥ _MIN_PREFIX
+        extended = base + [3, 9, 27]        # a chat turn appended
+        ref = ContinuousBatchingEngine(_cfg(), num_slots=1)
+        try:
+            want_base, _ = ref.generate(base, max_new_tokens=6)
+            want_ext, _ = ref.generate(extended, max_new_tokens=6)
+        finally:
+            ref.stop()
+        engine = ContinuousBatchingEngine(_cfg(), num_slots=1,
+                                          prefix_cache=4)
+        try:
+            got_base, _ = engine.generate(base, max_new_tokens=6)
+            assert engine.prefix_stats['misses'] == 1
+            got_ext, _ = engine.generate(extended, max_new_tokens=6)
+            assert engine.prefix_stats['hits'] == 1
+            assert engine.prefix_stats['tokens_reused'] == len(base)
+            # Exact repeat: reuses all but the final token.
+            got_rep, _ = engine.generate(extended, max_new_tokens=6)
+            assert engine.prefix_stats['hits'] == 2
+        finally:
+            engine.stop()
+        assert got_base == want_base
+        assert got_ext == want_ext
+        assert got_rep == want_ext
+
+    def test_prefix_cache_lru_evicts(self):
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        engine = ContinuousBatchingEngine(_cfg(), num_slots=1,
+                                          prefix_cache=2)
+        try:
+            p1 = list(range(2, 22))
+            p2 = list(range(30, 50))
+            p3 = list(range(60, 80))
+            for p in (p1, p2, p3):
+                engine.generate(p, max_new_tokens=2)
+            assert len(engine._prefix_entries) == 2
+            # p1 evicted: extending it is a miss; p3 still hits.
+            engine.generate(p1 + [1, 2], max_new_tokens=2)
+            assert engine.prefix_stats['hits'] == 0
+            engine.generate(p3 + [1, 2], max_new_tokens=2)
+            assert engine.prefix_stats['hits'] == 1
+        finally:
+            engine.stop()
+
+    def test_prefix_cache_off_by_default(self, cb_engine):
+        assert cb_engine.prefix_cache == 0
+        assert not cb_engine._prefix_entries
+
     def test_concurrent_requests_interleave(self, cb_engine):
         """More requests than slots: all finish, and the step log shows
         decode ticks serving >1 slot (real interleaving, not queueing)."""
