@@ -235,8 +235,11 @@ def _s3_request(method: str, url: str, headers: Dict[str, str],
 
 def _sigv4_headers(method: str, host: str, path: str, region: str,
                    body: bytes, access_key: str, secret_key: str,
-                   now=None) -> Dict[str, str]:
-    """AWS Signature Version 4 for one S3 request (stdlib only)."""
+                   now=None, payload_hash: Optional[str] = None
+                   ) -> Dict[str, str]:
+    """AWS Signature Version 4 for one S3 request (stdlib only).
+    `payload_hash` lets the caller pre-hash a streamed body instead of
+    materializing it."""
     import datetime
     import hashlib
     import hmac
@@ -245,7 +248,8 @@ def _sigv4_headers(method: str, host: str, path: str, region: str,
         now = datetime.datetime.now(datetime.timezone.utc)
     amz_date = now.strftime('%Y%m%dT%H%M%SZ')
     datestamp = now.strftime('%Y%m%d')
-    payload_hash = hashlib.sha256(body).hexdigest()
+    if payload_hash is None:
+        payload_hash = hashlib.sha256(body).hexdigest()
     canonical_headers = (f'host:{host}\n'
                          f'x-amz-content-sha256:{payload_hash}\n'
                          f'x-amz-date:{amz_date}\n')
@@ -295,15 +299,51 @@ def _gcs_list_objects(gs_bucket: str, prefix: str) -> list:
 
 
 def _gcs_read_object(gs_bucket: str, name: str) -> bytes:
+    """Test-transport object read (the dict transport wraps media as
+    base64). The REAL path streams to a file — see
+    _gcs_stream_object_to_file; the production JSON transport cannot
+    carry raw media (it json-decodes every response)."""
     import base64
     import urllib.parse
     url = (f'{STORAGE_ROOT}/b/{gs_bucket}/o/'
            f'{urllib.parse.quote(name, safe="")}?alt=media')
     payload = _call('GET', url)
-    # Through the dict transport, media comes back base64-wrapped.
     if isinstance(payload, dict):
         return base64.b64decode(payload.get('data_b64', ''))
     return payload
+
+
+def _gcs_stream_object_to_file(gs_bucket: str, name: str, f) -> Tuple[
+        int, str]:
+    """Real-path media download, streamed (bounded memory for
+    checkpoint-sized objects): writes into file object `f`; returns
+    (size_bytes, sha256_hex) — the hash SigV4 needs."""
+    import hashlib
+    import urllib.parse
+    import google.auth
+    import google.auth.transport.requests
+    url = (f'{STORAGE_ROOT}/b/{gs_bucket}/o/'
+           f'{urllib.parse.quote(name, safe="")}?alt=media')
+    creds, _ = google.auth.default(
+        scopes=['https://www.googleapis.com/auth/devstorage.read_only'])
+    session = google.auth.transport.requests.AuthorizedSession(creds)
+    digest = hashlib.sha256()
+    size = 0
+    with session.get(url, stream=True) as resp:
+        if resp.status_code >= 300:
+            raise exceptions.StorageError(
+                f'GCS read gs://{gs_bucket}/{name} failed '
+                f'({resp.status_code}): {resp.text[:300]}')
+        for chunk in resp.iter_content(chunk_size=8 * 1024 * 1024):
+            f.write(chunk)
+            digest.update(chunk)
+            size += len(chunk)
+    return size, digest.hexdigest()
+
+
+# S3 rejects single PUTs above 5 GB; larger objects need multipart,
+# which this stdlib exporter deliberately does not implement.
+_S3_SINGLE_PUT_LIMIT = 5 * 1024**3
 
 
 def gcs_to_s3(gs_bucket: str, s3_bucket: str, *, prefix: str = '',
@@ -311,25 +351,64 @@ def gcs_to_s3(gs_bucket: str, s3_bucket: str, *, prefix: str = '',
     """Copy every object under gs://{gs_bucket}/{prefix} to
     s3://{s3_bucket}/ (same keys). Returns the object count.
 
-    Client-streamed (see module note); both endpoints are injectable so
-    the whole direction is hermetically testable.
+    Client-streamed (see module note) with bounded memory: each object
+    spools through a temp file, hashed on the way in, and is PUT with a
+    pre-computed payload hash. Objects over S3's 5 GB single-PUT limit
+    are refused with a pointer at multipart-capable tooling. Both
+    endpoints are injectable so the direction is hermetically testable.
     """
+    import tempfile
+    import urllib.parse
+
     access_key, secret_key = aws_credentials()
     names = _gcs_list_objects(gs_bucket, prefix)
     host = f'{s3_bucket}.s3.{region}.amazonaws.com'
-    import urllib.parse
     for name in names:
-        body = _gcs_read_object(gs_bucket, name)
         path = '/' + urllib.parse.quote(name)
-        headers = _sigv4_headers('PUT', host, path, region, body,
-                                 access_key, secret_key)
-        headers['host'] = host
-        status, resp = _s3_request('PUT', f'https://{host}{path}',
-                                   headers, body)
-        if status >= 300:
-            raise exceptions.StorageError(
-                f'S3 PUT s3://{s3_bucket}{path} failed ({status}): '
-                f'{resp[:300]!r}')
+        if _s3_transport_override is not None or \
+                _transport_override is not None:
+            # Hermetic mode: small in-memory bodies via the fakes.
+            body = _gcs_read_object(gs_bucket, name)
+            headers = _sigv4_headers('PUT', host, path, region, body,
+                                     access_key, secret_key)
+            headers['host'] = host
+            status, resp = _s3_request('PUT', f'https://{host}{path}',
+                                       headers, body)
+            if status >= 300:
+                raise exceptions.StorageError(
+                    f'S3 PUT s3://{s3_bucket}{path} failed ({status}): '
+                    f'{resp[:300]!r}')
+            continue
+        with tempfile.TemporaryFile() as spool:
+            size, sha_hex = _gcs_stream_object_to_file(gs_bucket, name,
+                                                       spool)
+            if size > _S3_SINGLE_PUT_LIMIT:
+                raise exceptions.StorageError(
+                    f'gs://{gs_bucket}/{name} is {size} bytes — above '
+                    f"S3's 5 GB single-PUT limit. Export it with "
+                    f'multipart-capable tooling (aws s3 cp / rclone) '
+                    f'or shard the checkpoint.')
+            spool.seek(0)
+            headers = _sigv4_headers('PUT', host, path, region, b'',
+                                     access_key, secret_key,
+                                     payload_hash=sha_hex)
+            headers['host'] = host
+            headers['Content-Length'] = str(size)
+            import urllib.request
+            req = urllib.request.Request(
+                f'https://{host}{path}', data=spool, method='PUT',
+                headers=headers)
+            import urllib.error
+            try:
+                with urllib.request.urlopen(req, timeout=600) as resp:
+                    status = resp.status
+                    detail = b''
+            except urllib.error.HTTPError as e:
+                status, detail = e.code, e.read()
+            if status >= 300:
+                raise exceptions.StorageError(
+                    f'S3 PUT s3://{s3_bucket}{path} failed ({status}): '
+                    f'{detail[:300]!r}')
     logger.info('exported %d objects gs://%s/%s -> s3://%s', len(names),
                 gs_bucket, prefix, s3_bucket)
     return len(names)

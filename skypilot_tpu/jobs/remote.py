@@ -156,8 +156,16 @@ def sync_down_remote_batch(cluster_name: str,
         try:
             status, _ = backend_utils.refresh_cluster_status_handle(
                 cluster_name, force_refresh=True)
-        except Exception:  # pylint: disable=broad-except
-            status = None
+        except Exception as probe_err:  # pylint: disable=broad-except
+            # The probe itself failed (client offline, expired creds):
+            # that is INCONCLUSIVE, not proof the cluster is gone —
+            # branding live jobs with a terminal FAILED_CONTROLLER on a
+            # client-side outage would be unrecoverable.
+            logger.warning(
+                'Cloud probe of controller cluster %s inconclusive '
+                '(%s) after %d RPC failures; keeping last-known job '
+                'states.', cluster_name, probe_err, fails)
+            return True
         if status == ClusterStatus.UP:
             logger.warning(
                 'Controller cluster %s is UP but RPC keeps failing '

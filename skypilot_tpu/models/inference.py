@@ -312,9 +312,10 @@ class _Request:
 
     __slots__ = ('ids', 'max_new_tokens', 'temperature', 'eos_id',
                  'future', 'submit_time', 'first_token_time', 'tokens',
-                 'next_pos')
+                 'next_pos', 'on_token')
 
-    def __init__(self, ids, max_new_tokens, temperature, eos_id, future):
+    def __init__(self, ids, max_new_tokens, temperature, eos_id, future,
+                 on_token=None):
         import time
         self.ids = list(ids)
         self.max_new_tokens = max_new_tokens
@@ -325,6 +326,9 @@ class _Request:
         self.first_token_time: Optional[float] = None
         self.tokens: list = []
         self.next_pos = 0  # cache position the NEXT input token writes to
+        # Streaming hook: called from the ENGINE thread with each token
+        # as it lands, then once with None after the future resolves.
+        self.on_token = on_token
 
 
 class ContinuousBatchingEngine:
@@ -717,10 +721,23 @@ class ContinuousBatchingEngine:
         first = self._sample(logits, req.temperature)
         req.first_token_time = time.time()
         req.tokens.append(first)
+        self._notify(req, first)
         req.next_pos = true_len
         self._cache = self._insert(self._cache, cache1,
                                    jnp.asarray(slot, jnp.int32))
         self._slots[slot] = req
+
+    @staticmethod
+    def _notify(req: '_Request', token) -> None:
+        """Streaming callback, guarded: a consumer error (closed HTTP
+        connection) must not kill the engine loop."""
+        if req.on_token is None:
+            return
+        try:
+            req.on_token(token)
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('on_token callback failed')
+            req.on_token = None
 
     def _finish(self, slot: int) -> None:
         import time
@@ -733,6 +750,7 @@ class ContinuousBatchingEngine:
             'prompt_tokens': len(req.ids),
         }
         req.future.set_result((list(req.tokens), stats))
+        self._notify(req, None)  # stream end (after the future resolves)
 
     def _loop(self) -> None:
         import contextlib
@@ -753,10 +771,12 @@ class ContinuousBatchingEngine:
                         if req is not None:
                             self._slots[slot] = None
                             req.future.set_exception(e)
+                            self._notify(req, None)
                     while not self._queue.empty():
                         try:
-                            self._queue.get_nowait().future.set_exception(
-                                e)
+                            qreq = self._queue.get_nowait()
+                            qreq.future.set_exception(e)
+                            self._notify(qreq, None)
                         except Exception:  # pylint: disable=broad-except
                             break
                     self._cache = self._init_slot_cache()
@@ -845,6 +865,7 @@ class ContinuousBatchingEngine:
                 req.next_pos += 1
                 token = int(out_cols[slot, c])
                 req.tokens.append(token)
+                self._notify(req, token)
                 done = (len(req.tokens) >= req.max_new_tokens or
                         (req.eos_id is not None
                          and token == req.eos_id) or
@@ -861,9 +882,12 @@ class ContinuousBatchingEngine:
 
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                temperature: float = 0.0,
-               eos_id: Optional[int] = None):
+               eos_id: Optional[int] = None,
+               on_token=None):
         """Enqueue one request; returns a concurrent.futures.Future that
-        resolves to (token_ids, stats)."""
+        resolves to (token_ids, stats). `on_token` (optional) is called
+        from the engine thread with each token as it lands and once with
+        None when the request finishes — the streaming hook."""
         import concurrent.futures
         ids = [int(t) for t in prompt_ids]
         if not ids:
@@ -873,7 +897,8 @@ class ContinuousBatchingEngine:
                 f'{len(ids)}+{max_new_tokens} exceeds max_seq_len '
                 f'{self.cfg.max_seq_len}')
         future: 'concurrent.futures.Future' = concurrent.futures.Future()
-        req = _Request(ids, max_new_tokens, temperature, eos_id, future)
+        req = _Request(ids, max_new_tokens, temperature, eos_id, future,
+                       on_token=on_token)
         self._queue.put(req)
         self._ensure_thread()
         self._wake.set()

@@ -13,7 +13,7 @@ Endpoints:
   POST /v1/completions      → OpenAI-compatible text completions
   POST /v1/chat/completions → OpenAI-compatible chat (generic template)
   GET  /v1/models           → the served model id
-(OpenAI scope: non-streaming, n=1, stop strings, usage accounting —
+(OpenAI scope: streaming SSE + non-streaming, n=1, stop strings, usage accounting —
 existing OpenAI-client code points base_url here unchanged.)
 
 Tokenization: accepts raw token ids (any external tokenizer), or text via
@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import logging
 import sys
 import time
@@ -138,6 +139,29 @@ class InferenceServer:
         max_new = int(data.get('max_new_tokens', 32))
         temperature = float(data.get('temperature', 0.0))
 
+        if data.get('stream'):
+            if len(prompts) != 1:
+                return web.json_response(
+                    {'error': 'stream=true takes exactly one prompt'},
+                    status=400)
+            tokens, future = self._token_stream(prompts[0], max_new,
+                                                temperature)
+            resp = await self._sse_prepare(request)
+            push, flush = self._delta_decoder()
+            async for tok in tokens:
+                await self._sse_send(resp, {'token_id': tok,
+                                            'text_delta': push(tok)})
+            exc = future.exception()
+            if exc is not None:
+                await self._sse_send(resp, {'error': str(exc)})
+            else:
+                _, stats = future.result()
+                await self._sse_send(resp, {'done': True,
+                                            'text_delta': flush(),
+                                            'stats': stats})
+            await resp.write_eof()
+            return resp
+
         # All prompts go straight into the engine queue; awaiting the
         # futures concurrently lets this request's prompts AND other
         # in-flight HTTP requests share decode ticks.
@@ -154,12 +178,87 @@ class InferenceServer:
         })
 
     def _submit_one(self, ids: List[int], max_new: int,
-                    temperature: float):
+                    temperature: float, on_token=None):
         max_seq = self.engine.cfg.max_seq_len
         if len(ids) + max_new > max_seq:
             ids = ids[-(max_seq - max_new):]
         return self.engine.submit(ids, max_new_tokens=max_new,
-                                  temperature=temperature)
+                                  temperature=temperature,
+                                  on_token=on_token)
+
+    # -- streaming plumbing --
+
+    def _token_stream(self, ids: List[int], max_new: int,
+                      temperature: float):
+        """(async-iterable of tokens, future): engine-thread tokens
+        bridged onto this event loop; the iterable ends at the engine's
+        None sentinel (sent after the future resolves)."""
+        loop = asyncio.get_event_loop()
+        queue: 'asyncio.Queue' = asyncio.Queue()
+
+        def on_token(tok):
+            loop.call_soon_threadsafe(queue.put_nowait, tok)
+
+        future = self._submit_one(ids, max_new, temperature,
+                                  on_token=on_token)
+
+        async def tokens():
+            while True:
+                tok = await queue.get()
+                if tok is None:
+                    return
+                yield tok
+
+        return tokens(), future
+
+    def _delta_decoder(self):
+        """Incremental text decoding: feed tokens one at a time via
+        `push` for the NEW text since the last call; `flush` at stream
+        end for whatever was held back. Cumulative decode with a
+        trailing-replacement-char holdback: an in-progress multi-byte
+        sequence decodes as U+FFFD and would CHANGE retroactively when
+        its continuation bytes arrive, so it is withheld until complete
+        (or until flush, where a genuine U+FFFD is emitted as-is)."""
+        toks: List[int] = []
+        sent = {'text': ''}
+
+        def _stable(full: str) -> str:
+            return full[:-1] if full.endswith('�') else full
+
+        def push(tok: int) -> str:
+            toks.append(tok)
+            full = _stable(self.decode(toks))
+            if not full.startswith(sent['text']):
+                # Retroactive change despite holdback (pathological
+                # byte soup): resync without re-emitting.
+                sent['text'] = full
+                return ''
+            delta = full[len(sent['text']):]
+            if delta:
+                sent['text'] = full
+            return delta
+
+        def flush() -> str:
+            full = self.decode(toks)
+            if full.startswith(sent['text']):
+                return full[len(sent['text']):]
+            return ''
+
+        return push, flush
+
+    @staticmethod
+    async def _sse_prepare(request: web.Request) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            headers={'Content-Type': 'text/event-stream',
+                     'Cache-Control': 'no-cache'})
+        await resp.prepare(request)
+        return resp
+
+    @staticmethod
+    async def _sse_send(resp: web.StreamResponse, payload) -> None:
+        data = payload if isinstance(payload, str) else json.dumps(
+            payload)
+        await resp.write(f'data: {data}\n\n'.encode())
 
     def _generate_one(self, ids: List[int], max_new: int,
                       temperature: float):
@@ -177,10 +276,11 @@ class InferenceServer:
     #
     # The reference's serving recipes expose the OpenAI API via vLLM;
     # existing OpenAI-client code points its base_url here unchanged.
-    # Scope: non-streaming text + chat completions (`stream: true` is
-    # rejected with 400 — the engine returns whole completions),
-    # temperature, max_tokens, stop strings (post-hoc truncation), and
-    # usage accounting. One choice per request (`n` > 1 → 400).
+    # Scope: text + chat completions with `stream: true` SSE (chunk
+    # objects + [DONE], deltas from the engine's per-token callback),
+    # temperature, max_tokens, stop strings (post-hoc truncation;
+    # stop+stream rejected — partial-match holdback is out of scope),
+    # and usage accounting. One choice per request (`n` > 1 → 400).
     # top_k/top_p are ENGINE-level (--top-k/--top-p: jit-static, one
     # compile); a request's own top_p is rejected with 400 unless it is
     # the no-op client default (top_p=1) — silently sampling from a
@@ -205,10 +305,13 @@ class InferenceServer:
             status=status)
 
     def _validate_openai(self, data: dict):
-        if data.get('stream'):
+        if data.get('stream') and data.get('stop'):
+            # Streaming + stop strings would need partial-match
+            # holdback to avoid emitting text past the stop; refusing
+            # beats silently streaming wrong output.
             return self._openai_error(
-                'streaming is not supported by this server; set '
-                'stream=false')
+                'stream=true with stop strings is not supported; '
+                'drop stop or stream=false')
         if int(data.get('n') or 1) != 1:
             return self._openai_error('only n=1 is supported')
         req_top_p = data.get('top_p')
@@ -255,6 +358,12 @@ class InferenceServer:
                           [int(t) for t in p] for p in prompts]
             max_new = int(data.get('max_tokens') or 16)
             temperature = float(data.get('temperature') or 0.0)
+            if data.get('stream'):
+                if len(prompt_ids) != 1:
+                    return self._openai_error(
+                        'stream=true takes exactly one prompt')
+                return await self._stream_completions(
+                    request, data, prompt_ids[0], max_new, temperature)
             futures = [self._submit_one(ids, max_new, temperature)
                        for ids in prompt_ids]
         except (TypeError, ValueError) as e:
@@ -282,6 +391,76 @@ class InferenceServer:
                       'completion_tokens': completion_tokens,
                       'total_tokens': prompt_tokens + completion_tokens},
         })
+
+    async def _stream_completions(self, request, data, ids, max_new,
+                                  temperature) -> web.StreamResponse:
+        """OpenAI text-completion SSE chunks, closed by `data: [DONE]`."""
+        cmpl_id = f'cmpl-{int(time.time() * 1e3):x}'
+        created = int(time.time())
+        model = data.get('model') or self.engine.cfg.name
+
+        def chunk(text, finish=None):
+            return {'id': cmpl_id, 'object': 'text_completion',
+                    'created': created, 'model': model,
+                    'choices': [{'index': 0, 'text': text,
+                                 'logprobs': None,
+                                 'finish_reason': finish}]}
+
+        tokens, future = self._token_stream(ids, max_new, temperature)
+        resp = await self._sse_prepare(request)
+        push, flush = self._delta_decoder()
+        async for tok in tokens:
+            delta = push(tok)
+            if delta:
+                await self._sse_send(resp, chunk(delta))
+        exc = future.exception()
+        if exc is not None:
+            # Mid-stream engine failure: an error event and NO [DONE] —
+            # a truncated stream must not parse as a clean completion.
+            await self._sse_send(resp, {'error': {
+                'message': str(exc), 'type': 'server_error'}})
+            await resp.write_eof()
+            return resp
+        await self._sse_send(resp, chunk(flush(), finish='length'))
+        await self._sse_send(resp, '[DONE]')
+        await resp.write_eof()
+        return resp
+
+    async def _stream_chat(self, request, data, ids, max_new,
+                           temperature) -> web.StreamResponse:
+        """OpenAI chat-completion SSE chunks (delta objects), closed by
+        `data: [DONE]`."""
+        chat_id = f'chatcmpl-{int(time.time() * 1e3):x}'
+        created = int(time.time())
+        model = data.get('model') or self.engine.cfg.name
+
+        def chunk(delta, finish=None):
+            return {'id': chat_id, 'object': 'chat.completion.chunk',
+                    'created': created, 'model': model,
+                    'choices': [{'index': 0, 'delta': delta,
+                                 'finish_reason': finish}]}
+
+        tokens, future = self._token_stream(ids, max_new, temperature)
+        resp = await self._sse_prepare(request)
+        await self._sse_send(resp, chunk({'role': 'assistant'}))
+        push, flush = self._delta_decoder()
+        async for tok in tokens:
+            delta = push(tok)
+            if delta:
+                await self._sse_send(resp, chunk({'content': delta}))
+        exc = future.exception()
+        if exc is not None:
+            await self._sse_send(resp, {'error': {
+                'message': str(exc), 'type': 'server_error'}})
+            await resp.write_eof()
+            return resp
+        tail = flush()
+        if tail:
+            await self._sse_send(resp, chunk({'content': tail}))
+        await self._sse_send(resp, chunk({}, finish='length'))
+        await self._sse_send(resp, '[DONE]')
+        await resp.write_eof()
+        return resp
 
     async def handle_v1_chat(self, request: web.Request) -> web.Response:
         try:
@@ -311,6 +490,9 @@ class InferenceServer:
                 ids = self.encode('\n'.join(parts) + '\nassistant:')
             max_new = int(data.get('max_tokens') or 16)
             temperature = float(data.get('temperature') or 0.0)
+            if data.get('stream'):
+                return await self._stream_chat(request, data, ids,
+                                               max_new, temperature)
             future = self._submit_one(ids, max_new, temperature)
         except (TypeError, ValueError, AttributeError) as e:
             return self._openai_error(str(e))

@@ -679,11 +679,72 @@ class TestInferenceServer:
                          ['world', 'hello']) == ('', 'stop')
         assert srv_trunc('abc', ['zz']) == ('abc', 'length')
 
-        # Unsupported shapes are rejected in OpenAI error format.
+        # --- streaming (SSE) ---
+        import json as json_lib
+
+        def sse_events(response):
+            events = []
+            for line in response.iter_lines():
+                if line and line.startswith(b'data: '):
+                    events.append(line[len(b'data: '):].decode())
+            return events
+
+        # Plain /generate streaming: per-token events + final stats.
+        resp = req.post(f'http://127.0.0.1:{port}/generate',
+                        json={'prompt': 'hi', 'max_new_tokens': 4,
+                              'stream': True}, stream=True, timeout=60)
+        assert resp.status_code == 200
+        assert resp.headers['Content-Type'].startswith(
+            'text/event-stream')
+        events = [json_lib.loads(e) for e in sse_events(resp)]
+        token_events = [e for e in events if 'token_id' in e]
+        assert len(token_events) == 4
+        assert events[-1]['done'] is True
+        assert events[-1]['stats']['new_tokens'] == 4
+        # Streamed tokens equal the non-streamed result (same prompt,
+        # greedy).
+        resp = req.post(f'http://127.0.0.1:{port}/generate',
+                        json={'prompt': 'hi', 'max_new_tokens': 4},
+                        timeout=60)
+        assert [e['token_id'] for e in token_events] == \
+            resp.json()['token_ids'][0]
+
+        # OpenAI completions streaming: chunk objects then [DONE].
         resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
-                        json={'prompt': 'hi', 'stream': True}, timeout=5)
+                        json={'prompt': 'hi', 'max_tokens': 4,
+                              'stream': True}, stream=True, timeout=60)
+        assert resp.status_code == 200
+        events = sse_events(resp)
+        assert events[-1] == '[DONE]'
+        chunks = [json_lib.loads(e) for e in events[:-1]]
+        assert all(c['object'] == 'text_completion' for c in chunks)
+        assert chunks[-1]['choices'][0]['finish_reason'] == 'length'
+        streamed = ''.join(c['choices'][0]['text'] for c in chunks)
+        assert streamed  # non-empty concatenated text
+
+        # OpenAI chat streaming: role delta first, then content deltas.
+        resp = req.post(f'http://127.0.0.1:{port}/v1/chat/completions',
+                        json={'messages': [{'role': 'user',
+                                            'content': 'hi'}],
+                              'max_tokens': 4, 'stream': True},
+                        stream=True, timeout=60)
+        assert resp.status_code == 200
+        events = sse_events(resp)
+        assert events[-1] == '[DONE]'
+        chat_chunks = [json_lib.loads(e) for e in events[:-1]]
+        assert chat_chunks[0]['choices'][0]['delta'] == {
+            'role': 'assistant'}
+        assert all(c['object'] == 'chat.completion.chunk'
+                   for c in chat_chunks)
+
+        # stream + stop strings is refused (no partial-match holdback).
+        resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
+                        json={'prompt': 'hi', 'stream': True,
+                              'stop': ['x']}, timeout=5)
         assert resp.status_code == 400
         assert resp.json()['error']['type'] == 'invalid_request_error'
+
+        # Unsupported shapes are rejected in OpenAI error format.
         resp = req.post(f'http://127.0.0.1:{port}/v1/completions',
                         json={'prompt': 'hi', 'n': 2}, timeout=5)
         assert resp.status_code == 400
