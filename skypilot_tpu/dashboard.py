@@ -3,8 +3,11 @@
 Reference parity: sky/jobs/dashboard/dashboard.py (a small Flask app
 tunneled over SSH, sky/cli.py:3803). Here it is aiohttp (the framework's
 HTTP stack), serves all three state tables instead of jobs only, and runs
-locally against the client state db — the controllers in this framework
-are local processes, so no SSH tunnel is needed.
+locally against the client state db. Controllers may be local processes
+OR dedicated controller clusters (`jobs launch --remote`,
+`serve.up(remote=True)`); remote jobs and services appear through their
+client-side mirror rows, refreshed by every `jobs queue` / `serve
+status` round-trip — no SSH tunnel is needed either way.
 
 Entry: `skytpu jobs dashboard` (cli.py) or
 `python -m skypilot_tpu.dashboard`.

@@ -28,6 +28,7 @@ import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.utils import fault_injection
 
 logger = logging.getLogger(__name__)
 
@@ -307,6 +308,12 @@ def _gcs_read_object(gs_bucket: str, name: str) -> bytes:
     import urllib.parse
     url = (f'{STORAGE_ROOT}/b/{gs_bucket}/o/'
            f'{urllib.parse.quote(name, safe="")}?alt=media')
+    try:
+        fault_injection.point('storage.chunk')
+    except fault_injection.InjectedFault as e:
+        raise exceptions.StorageError(
+            f'GCS read gs://{gs_bucket}/{name} failed: injected fault '
+            f'({e})') from e
     payload = _call('GET', url)
     if isinstance(payload, dict):
         return base64.b64decode(payload.get('data_b64', ''))
@@ -335,6 +342,12 @@ def _gcs_stream_object_to_file(gs_bucket: str, name: str, f) -> Tuple[
                 f'GCS read gs://{gs_bucket}/{name} failed '
                 f'({resp.status_code}): {resp.text[:300]}')
         for chunk in resp.iter_content(chunk_size=8 * 1024 * 1024):
+            try:
+                fault_injection.point('storage.chunk')
+            except fault_injection.InjectedFault as e:
+                raise exceptions.StorageError(
+                    f'GCS read gs://{gs_bucket}/{name} failed at byte '
+                    f'{size}: injected fault ({e})') from e
             f.write(chunk)
             digest.update(chunk)
             size += len(chunk)

@@ -166,3 +166,26 @@ class UserRequestRejectedByPolicy(SkyTpuError):
 
 class NoCloudAccessError(SkyTpuError):
     """No cloud is enabled/configured (run `check`)."""
+
+
+# ---------------- serving-engine resilience ----------------
+
+
+class EngineOverloadedError(SkyTpuError):
+    """The inference engine's admission queue is full; the server maps
+    this to 429/503 with Retry-After instead of piling onto the batch
+    queue."""
+
+
+class EngineDrainingError(EngineOverloadedError):
+    """The engine is draining for shutdown: in-flight requests finish,
+    new ones are refused."""
+
+
+class EngineWedgedError(SkyTpuError):
+    """The engine watchdog declared the decode thread wedged or dead and
+    failed this in-flight request cleanly."""
+
+
+class RequestDeadlineExceededError(SkyTpuError, TimeoutError):
+    """A per-request deadline expired before the request finished."""

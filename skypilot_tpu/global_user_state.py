@@ -106,6 +106,43 @@ def set_enabled_clouds(clouds: List[str]) -> None:
     _set_config('enabled_clouds', json.dumps(clouds))
 
 
+# -------- consecutive-failure counters (utils/retry.py) --------
+#
+# Stored in the config kv so escalation thresholds (e.g. "3 consecutive
+# controller-RPC failures force a cloud probe") survive CLI restarts —
+# an in-process dict restarts the count with every fresh process.
+
+_FAILURE_COUNT_PREFIX = 'failure_count:'
+
+
+def get_failure_count(key: str) -> int:
+    raw = _get_config(_FAILURE_COUNT_PREFIX + key)
+    try:
+        return int(raw) if raw is not None else 0
+    except ValueError:
+        return 0
+
+
+def bump_failure_count(key: str) -> int:
+    """Atomically increment and return the counter."""
+    full_key = _FAILURE_COUNT_PREFIX + key
+    with _get_db().cursor() as cur:
+        cur.execute(
+            "INSERT INTO config (key, value) VALUES (?, '1') "
+            'ON CONFLICT(key) DO UPDATE SET '
+            "value = CAST(CAST(value AS INTEGER) + 1 AS TEXT)",
+            (full_key,))
+        row = cur.execute('SELECT value FROM config WHERE key = ?',
+                          (full_key,)).fetchone()
+    return int(row[0]) if row else 0
+
+
+def reset_failure_count(key: str) -> None:
+    with _get_db().cursor() as cur:
+        cur.execute('DELETE FROM config WHERE key = ?',
+                    (_FAILURE_COUNT_PREFIX + key,))
+
+
 def get_owner_identity() -> Optional[List[str]]:
     raw = _get_config('owner_identity')
     return json.loads(raw) if raw else None

@@ -17,11 +17,12 @@ from __future__ import annotations
 import logging
 import shlex
 import typing
-from typing import Any, Dict, List, Optional
+from typing import Any, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.jobs import constants
+from skypilot_tpu.utils import retry as retry_lib
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu import dag as dag_lib
@@ -95,10 +96,11 @@ def cancel_remote(cluster_name: str, job_id: int) -> None:
     _rpc(cluster_name, body)
 
 
-# Consecutive RPC failures per controller cluster — the escalation
-# counter for dead-cluster detection (see sync_down_remote_batch).
-_rpc_failures: Dict[str, int] = {}
-_RPC_FAILURES_BEFORE_PROBE = 3
+# Consecutive-RPC-failure escalation is shared with serve and persisted
+# in the state db (utils/retry.py): 3 failures force a cloud-truth
+# probe, whether they happened in one long-lived process or across
+# three CLI invocations.
+_RPC_FAILURES_BEFORE_PROBE = retry_lib.RPC_FAILURES_BEFORE_PROBE
 
 
 def _mark_controller_gone(cluster_name: str, job_ids: List[int],
@@ -138,45 +140,34 @@ def sync_down_remote_batch(cluster_name: str,
     try:
         by_job = _rpc(cluster_name, body)
     except exceptions.ClusterNotUpError as e:
-        _rpc_failures.pop(cluster_name, None)
+        retry_lib.reset_rpc_failures(cluster_name)
         _mark_controller_gone(cluster_name, job_ids, str(e))
         return False
     except exceptions.CommandError as e:
-        fails = _rpc_failures.get(cluster_name, 0) + 1
-        _rpc_failures[cluster_name] = fails
-        if fails < _RPC_FAILURES_BEFORE_PROBE:
+        verdict, fails = retry_lib.record_rpc_failure_and_probe(
+            cluster_name, threshold=_RPC_FAILURES_BEFORE_PROBE)
+        if verdict == 'transient':
             logger.warning(
                 'RPC failure %d/%d to controller cluster %s (%s); '
                 'keeping last-known job states.', fails,
                 _RPC_FAILURES_BEFORE_PROBE, cluster_name, e)
             return True
-        # Escalate: ask the CLOUD whether the cluster still exists.
-        from skypilot_tpu.backends import backend_utils
-        from skypilot_tpu.status_lib import ClusterStatus
-        try:
-            status, _ = backend_utils.refresh_cluster_status_handle(
-                cluster_name, force_refresh=True)
-        except Exception as probe_err:  # pylint: disable=broad-except
-            # The probe itself failed (client offline, expired creds):
-            # that is INCONCLUSIVE, not proof the cluster is gone —
-            # branding live jobs with a terminal FAILED_CONTROLLER on a
-            # client-side outage would be unrecoverable.
-            logger.warning(
-                'Cloud probe of controller cluster %s inconclusive '
-                '(%s) after %d RPC failures; keeping last-known job '
-                'states.', cluster_name, probe_err, fails)
-            return True
-        if status == ClusterStatus.UP:
+        if verdict == 'up':
             logger.warning(
                 'Controller cluster %s is UP but RPC keeps failing '
                 '(%s); keeping last-known job states.', cluster_name, e)
             return True
-        _rpc_failures.pop(cluster_name, None)
+        if verdict == 'inconclusive':
+            # The probe itself failed (client offline, expired creds):
+            # NOT proof the cluster is gone — branding live jobs with a
+            # terminal FAILED_CONTROLLER on a client-side outage would
+            # be unrecoverable. (Logged by the shared helper.)
+            return True
         _mark_controller_gone(cluster_name, job_ids,
                               f'{fails} consecutive RPC failures and '
-                              f'cloud status {status}')
+                              'cloud probe says not UP')
         return False
-    _rpc_failures.pop(cluster_name, None)
+    retry_lib.reset_rpc_failures(cluster_name)
     for job_id, records in by_job.items():
         if records:
             state.sync_remote_records(int(job_id), records)

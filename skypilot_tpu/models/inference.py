@@ -21,10 +21,18 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from skypilot_tpu import exceptions
 from skypilot_tpu.models.configs import ModelConfig, get_config
 from skypilot_tpu.models.transformer import Transformer
+from skypilot_tpu.utils import fault_injection
 
 logger = logging.getLogger(__name__)
+
+
+class _StaleEngineError(Exception):
+    """Raised inside a tick when the watchdog has abandoned this engine
+    thread (generation bumped): the thread must exit WITHOUT touching
+    the (already replaced) slots/queue/cache of its successor."""
 
 
 def greedy_sample(logits: jax.Array, rng: jax.Array,
@@ -312,10 +320,10 @@ class _Request:
 
     __slots__ = ('ids', 'max_new_tokens', 'temperature', 'eos_id',
                  'future', 'submit_time', 'first_token_time', 'tokens',
-                 'next_pos', 'on_token')
+                 'next_pos', 'on_token', 'deadline')
 
     def __init__(self, ids, max_new_tokens, temperature, eos_id, future,
-                 on_token=None):
+                 on_token=None, deadline=None):
         import time
         self.ids = list(ids)
         self.max_new_tokens = max_new_tokens
@@ -329,6 +337,10 @@ class _Request:
         # Streaming hook: called from the ENGINE thread with each token
         # as it lands, then once with None after the future resolves.
         self.on_token = on_token
+        # Absolute epoch deadline (time.time()); None = no deadline.
+        # Checked at admission and per tick — an expired request fails
+        # with RequestDeadlineExceededError instead of occupying a slot.
+        self.deadline = deadline
 
 
 class ContinuousBatchingEngine:
@@ -359,9 +371,12 @@ class ContinuousBatchingEngine:
                  top_k: int = 0,
                  top_p: float = 0.0,
                  speculative: int = 0,
-                 prefix_cache: int = 0) -> None:
+                 prefix_cache: int = 0,
+                 max_queue_depth: int = 0,
+                 watchdog_timeout: Optional[float] = None) -> None:
         import queue as queue_lib
         import threading
+        import time as time_lib
         self.cfg, self.params = _resolve_cfg_and_params(
             cfg, params, max_seq_len, rng_seed, quantize, kv_quant)
         self.num_slots = num_slots
@@ -410,6 +425,30 @@ class ContinuousBatchingEngine:
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._thread_lock = threading.Lock()
+        # -------- resilience (see docs/resilience.md) --------
+        # Admission control: >0 caps queued-not-yet-admitted requests;
+        # beyond it submit() raises EngineOverloadedError and the server
+        # sheds load with 429/503 + Retry-After instead of letting the
+        # queue (and every request's latency) grow without bound.
+        self.max_queue_depth = max(0, max_queue_depth)
+        # Watchdog: with a timeout set, a monitor thread fails in-flight
+        # futures cleanly when the engine thread wedges (a hung device
+        # dispatch) or dies, then lets a fresh engine thread take over.
+        self.watchdog_timeout = watchdog_timeout
+        self._watchdog: Optional[threading.Thread] = None
+        self._heartbeat = time_lib.monotonic()
+        # Bumped by watchdog recovery; an abandoned engine thread
+        # notices the mismatch and exits without touching shared state.
+        self._generation = 0
+        self._draining = False
+        # False until the current engine thread completes its first
+        # tick: that tick JIT-compiles the decode program, which can
+        # legitimately take far longer than a steady-state tick, so the
+        # watchdog widens its allowance until then. _admitting_tick
+        # extends the same allowance to any tick that admitted a
+        # request: a new prompt-length bucket prefill also compiles.
+        self._warm_tick = False
+        self._admitting_tick = False
         # (decode_step, frozenset(active slot ids)) history — lets tests
         # assert that requests really interleaved.
         self.step_log: list = []
@@ -583,20 +622,20 @@ class ContinuousBatchingEngine:
                     return follow + [0] * (k - len(follow))
         return None
 
-    def _spec_tick(self, active) -> 'Optional[Any]':
+    def _spec_tick(self, slots, active, gen: int) -> 'Optional[Any]':
         """One speculative tick: draft K per slot, verify in one
         forward. Returns the (num_slots, <=K+1) emit columns + per-slot
         valid counts, or None when the tick must fall back (a slot too
         close to the cache window)."""
         k = self.speculative
         for i in active:
-            req = self._slots[i]
+            req = slots[i]
             if self.cfg.max_seq_len - req.next_pos <= k:
                 return None
         tokens, positions = [], []
         real_draft_slots = set()
         for slot in range(self.num_slots):
-            req = self._slots[slot]
+            req = slots[slot]
             if req is None:
                 tokens.append([0] * (k + 1))
                 positions.append([0] * (k + 1))
@@ -615,15 +654,16 @@ class ContinuousBatchingEngine:
             # emit 1 token/slot at (K+1)x forward cost — let the
             # plain/chunked path take this round instead.
             return None
-        temps = [(self._slots[i].temperature
-                  if self._slots[i] is not None else 0.0)
+        temps = [(slots[i].temperature
+                  if slots[i] is not None else 0.0)
                  for i in range(self.num_slots)]
         self._rng, rng = jax.random.split(self._rng)
-        out, accepted, self._cache = self._verify(
+        out, accepted, cache = self._verify(
             self.params, self._cache,
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(positions, jnp.int32),
             jnp.asarray(temps, jnp.float32), rng)
+        self._commit_gen(gen, lambda: setattr(self, '_cache', cache))
         import numpy as np
         out = np.asarray(out)
         accepted = np.asarray(accepted)
@@ -640,13 +680,113 @@ class ContinuousBatchingEngine:
 
     def _ensure_thread(self) -> None:
         import threading
+        import time as time_lib
         with self._thread_lock:
             if self._thread is None or not self._thread.is_alive():
                 self._stop.clear()
+                self._heartbeat = time_lib.monotonic()
+                self._warm_tick = False
                 self._thread = threading.Thread(target=self._loop,
                                                 daemon=True,
                                                 name='cbatch-engine')
                 self._thread.start()
+            if self.watchdog_timeout and (
+                    self._watchdog is None or
+                    not self._watchdog.is_alive()):
+                self._watchdog = threading.Thread(
+                    target=self._watchdog_loop, daemon=True,
+                    name='cbatch-watchdog')
+                self._watchdog.start()
+
+    # ---------------- watchdog ----------------
+
+    def _busy(self) -> bool:
+        return any(r is not None for r in self._slots) or \
+            not self._queue.empty()
+
+    def _watchdog_loop(self) -> None:
+        """Detects a wedged (no completed tick while work is pending)
+        or dead engine thread and recovers: in-flight futures fail with
+        a clean EngineWedgedError and the next submit starts a fresh
+        engine thread over fresh state."""
+        import time as time_lib
+        interval = max(0.01, min(self.watchdog_timeout / 4, 1.0))
+        while not self._stop.is_set():
+            self._stop.wait(interval)
+            if self._stop.is_set():
+                return
+            if not self._busy():
+                continue
+            thread = self._thread
+            if thread is None:
+                # Not started yet (fresh engine, or a submit raced a
+                # recovery): the cure is the spawn submit() is about
+                # to do, not another recovery.
+                continue
+            dead = not thread.is_alive()
+            # 10x allowance while ticks can legitimately be slow:
+            # the thread's first tick JIT-compiles the decode program,
+            # and any admitting tick may compile a new prompt-bucket
+            # prefill. Exotic first-use paths (decode_chunk, spec
+            # verify) fall under the first-tick/admitting cases in
+            # practice; size watchdog_timeout above worst-case compile
+            # regardless.
+            slow_ok = (not self._warm_tick) or self._admitting_tick
+            allowed = self.watchdog_timeout * (10 if slow_ok else 1)
+            stalled = (time_lib.monotonic() - self._heartbeat > allowed)
+            if dead or stalled:
+                self._recover_from_wedge(
+                    'engine thread died' if dead else
+                    f'engine thread made no progress in '
+                    f'{allowed}s')
+
+    def _recover_from_wedge(self, why: str) -> None:
+        import queue as queue_lib
+        import time as time_lib
+        with self._thread_lock:
+            self._generation += 1
+            old_slots = self._slots
+            old_queue = self._queue
+            self._slots = [None] * self.num_slots
+            self._queue = queue_lib.Queue()
+            # The wedged thread may hold (or have donated) the old
+            # cache mid-dispatch; the successor re-initializes its own.
+            self._cache = None
+            self._thread = None
+            self._heartbeat = time_lib.monotonic()
+        logger.error('engine watchdog: %s; failing in-flight requests '
+                     'and resetting engine state (generation %d)', why,
+                     self._generation)
+        err = exceptions.EngineWedgedError(
+            f'{why}; request aborted by the engine watchdog')
+        for req in old_slots:
+            if req is not None:
+                self._fail_request(req, err)
+        while True:
+            try:
+                req = old_queue.get_nowait()
+            except queue_lib.Empty:
+                break
+            self._fail_request(req, err)
+
+    def _fail_request(self, req: '_Request', exc: BaseException) -> None:
+        if not req.future.done():
+            req.future.set_exception(exc)
+        self._notify(req, None)
+
+    def _check_gen(self, gen: int) -> None:
+        if self._generation != gen:
+            raise _StaleEngineError()
+
+    def _commit_gen(self, gen: int, fn) -> None:
+        """Run a shared-state write (cache/slot commit) atomically with
+        the generation check: _recover_from_wedge swaps state under the
+        same lock, so a stale thread can never interleave a commit
+        between the successor's check and write — it raises
+        _StaleEngineError and exits instead."""
+        with self._thread_lock:
+            self._check_gen(gen)
+            fn()
 
     def _sample(self, logits_row, temperature: float) -> int:
         if temperature <= 0:
@@ -686,7 +826,7 @@ class ContinuousBatchingEngine:
         while len(self._prefix_entries) > self.prefix_cache:
             self._prefix_entries.popitem(last=False)
 
-    def _admit(self, slot: int, req: '_Request') -> None:
+    def _admit(self, slot: int, req: '_Request', gen: int = -1) -> None:
         import time
         true_len = len(req.ids)
         plen, pcache = (self._longest_cached_prefix(req.ids)
@@ -713,6 +853,8 @@ class ContinuousBatchingEngine:
                 self.params, tokens, jnp.asarray(true_len, jnp.int32))
             if self.prefix_cache:
                 self.prefix_stats['misses'] += 1
+        if gen >= 0:
+            self._check_gen(gen)
         if self.prefix_cache:
             # The full prompt's KV is the entry future prompts extend
             # (chat turns append); cache1 is not donated anywhere, so
@@ -723,9 +865,17 @@ class ContinuousBatchingEngine:
         req.tokens.append(first)
         self._notify(req, first)
         req.next_pos = true_len
-        self._cache = self._insert(self._cache, cache1,
-                                   jnp.asarray(slot, jnp.int32))
-        self._slots[slot] = req
+        cache = self._insert(self._cache, cache1,
+                             jnp.asarray(slot, jnp.int32))
+
+        def _commit():
+            self._cache = cache
+            self._slots[slot] = req
+
+        if gen >= 0:
+            self._commit_gen(gen, _commit)
+        else:
+            _commit()
 
     @staticmethod
     def _notify(req: '_Request', token) -> None:
@@ -739,75 +889,194 @@ class ContinuousBatchingEngine:
             logger.exception('on_token callback failed')
             req.on_token = None
 
-    def _finish(self, slot: int) -> None:
+    def _finish(self, slots, slot: int) -> None:
         import time
-        req = self._slots[slot]
-        self._slots[slot] = None
+        req = slots[slot]
+        slots[slot] = None
         stats = {
             'ttft_s': req.first_token_time - req.submit_time,
             'total_s': time.time() - req.submit_time,
             'new_tokens': len(req.tokens),
             'prompt_tokens': len(req.ids),
         }
-        req.future.set_result((list(req.tokens), stats))
+        if not req.future.done():
+            # done() here means the caller cancelled (shed a partially
+            # submitted batch) — the result has no reader.
+            req.future.set_result((list(req.tokens), stats))
         self._notify(req, None)  # stream end (after the future resolves)
 
     def _loop(self) -> None:
         import contextlib
+        import time as time_lib
+        gen = self._generation
         ctx = self.mesh if self.mesh is not None else \
             contextlib.nullcontext()
         with ctx:
             if self._cache is None:
                 self._cache = self._init_slot_cache()
             while not self._stop.is_set():
+                if self._generation != gen:
+                    return  # abandoned by the watchdog: a successor owns
+                            # the slots/queue/cache now
                 try:
-                    self._tick()
+                    self._tick(gen)
+                except _StaleEngineError:
+                    return
                 except Exception as e:  # pylint: disable=broad-except
                     # Fail every in-flight/queued request rather than
-                    # hang their futures, then keep serving.
+                    # hang their futures, then keep serving. The
+                    # slot/queue extraction runs under _thread_lock
+                    # with a generation check so a concurrent watchdog
+                    # recovery can never be interleaved — a stale
+                    # thread must not drain its SUCCESSOR's requests.
                     logger.exception('decode tick failed: %s', e)
-                    for slot in range(self.num_slots):
-                        req = self._slots[slot]
-                        if req is not None:
-                            self._slots[slot] = None
-                            req.future.set_exception(e)
-                            self._notify(req, None)
-                    while not self._queue.empty():
-                        try:
-                            qreq = self._queue.get_nowait()
-                            qreq.future.set_exception(e)
-                            self._notify(qreq, None)
-                        except Exception:  # pylint: disable=broad-except
-                            break
-                    self._cache = self._init_slot_cache()
+                    failed = []
+                    with self._thread_lock:
+                        if self._generation != gen:
+                            return
+                        for slot in range(self.num_slots):
+                            req = self._slots[slot]
+                            if req is not None:
+                                self._slots[slot] = None
+                                failed.append(req)
+                        while not self._queue.empty():
+                            try:
+                                failed.append(self._queue.get_nowait())
+                            except Exception:  # pylint: disable=broad-except
+                                break
+                    for req in failed:
+                        self._fail_request(req, e)
+                    fresh_cache = self._init_slot_cache()
+                    try:
+                        self._commit_gen(
+                            gen,
+                            lambda: setattr(self, '_cache', fresh_cache))
+                    except _StaleEngineError:
+                        return
+                if self._generation == gen:
+                    self._heartbeat = time_lib.monotonic()
+                    self._warm_tick = True
 
-    def _tick(self) -> None:
-        # Admit new requests into free slots (between ticks — this is
-        # the "continuous" in continuous batching).
+    def _tick(self, gen: int) -> None:
+        import time as time_lib
+        self._check_gen(gen)
+        # Snapshot the slot table AND the queue: every read/write in
+        # this tick goes to THESE objects. If the watchdog abandons the
+        # thread mid-tick it swaps both for fresh ones, so a stale
+        # thread resuming here mutates only its own abandoned state —
+        # it can neither corrupt the successor's slots nor steal
+        # requests from the successor's queue.
+        slots = self._slots
+        queue = self._queue
+        now = time_lib.time()
+        # Per-request deadlines: an expired (or caller-cancelled)
+        # in-flight request frees its slot with a clean error instead
+        # of burning decode steps.
         for slot in range(self.num_slots):
-            if self._slots[slot] is None and not self._queue.empty():
+            req = slots[slot]
+            if req is None:
+                continue
+            if req.future.cancelled():
+                slots[slot] = None
+                self._notify(req, None)
+            elif req.deadline is not None and now > req.deadline:
+                slots[slot] = None
+                self._fail_request(
+                    req,
+                    exceptions.RequestDeadlineExceededError(
+                        f'request exceeded its deadline after '
+                        f'{now - req.submit_time:.1f}s '
+                        f'({len(req.tokens)} tokens generated)'))
+        # Expired/cancelled entries must leave the QUEUE every tick
+        # too, even when no slot frees for minutes — submit()'s
+        # contract is that a deadline fires whether the request is
+        # queued or mid-decode, and a dead entry must not hold
+        # admission-queue capacity.
+        if not queue.empty():
+            dead = []
+            with queue.mutex:
+                for req in list(queue.queue):
+                    if req.future.cancelled() or (
+                            req.deadline is not None and
+                            now > req.deadline):
+                        queue.queue.remove(req)
+                        dead.append(req)
+            for req in dead:
+                if req.future.cancelled():
+                    self._notify(req, None)
+                else:
+                    self._fail_request(
+                        req,
+                        exceptions.RequestDeadlineExceededError(
+                            f'request expired in the admission queue '
+                            f'after {now - req.submit_time:.1f}s'))
+        # Admit new requests into free slots (between ticks — this is
+        # the "continuous" in continuous batching). Requests that
+        # expired or were cancelled while queued are dropped, not
+        # admitted.
+        for slot in range(self.num_slots):
+            while slots[slot] is None and not queue.empty():
                 try:
-                    req = self._queue.get_nowait()
+                    req = queue.get_nowait()
                 except Exception:  # pylint: disable=broad-except
                     break
-                self._admit(slot, req)
-        active = [i for i, r in enumerate(self._slots) if r is not None]
+                if req.future.cancelled():
+                    self._notify(req, None)
+                    continue
+                if req.deadline is not None and now > req.deadline:
+                    self._fail_request(
+                        req,
+                        exceptions.RequestDeadlineExceededError(
+                            f'request expired in the admission queue '
+                            f'after {now - req.submit_time:.1f}s'))
+                    continue
+                # Prefill of a fresh prompt bucket may JIT-compile:
+                # widen the watchdog allowance for the dispatch.
+                self._admitting_tick = True
+                try:
+                    self._admit(slot, req, gen)
+                except BaseException as e:
+                    # The request is "in hand" — in neither the queue
+                    # nor a slot — so no recovery/cleanup path would
+                    # ever resolve its future: fail it here before
+                    # propagating.
+                    self._fail_request(
+                        req,
+                        exceptions.EngineWedgedError(
+                            'engine recovery interrupted admission; '
+                            'request aborted')
+                        if isinstance(e, _StaleEngineError) else e)
+                    raise
+        # Admission (and its possible compile) is over; refresh the
+        # heartbeat BEFORE dropping the widened allowance, or a
+        # longer-than-timeout (but legitimate) admission would read as
+        # stalled the instant the flag clears. Steady-state decode then
+        # gets the normal allowance. Gen-guarded: a stale thread must
+        # not freshen the heartbeat and mask a successor's wedge.
+        if self._generation == gen:
+            self._heartbeat = time_lib.monotonic()
+        self._admitting_tick = False
+        active = [i for i, r in enumerate(slots) if r is not None]
         if not active:
             self._wake.wait(timeout=0.05)
             self._wake.clear()
             return
+        # Chaos harness: tests/SKYTPU_FAULTS can fail or wedge the
+        # decode step here; disarmed this is a single boolean check.
+        fault_injection.point('engine.decode')
+        self._check_gen(gen)
         # Speculation only pays when a greedy slot can accept drafts;
         # an all-sampling active set would pay (K+1)x forward cost to
         # emit one token per slot — use the plain/chunked path instead.
-        any_greedy = any(self._slots[i].temperature <= 0 for i in active)
+        any_greedy = any(slots[i].temperature <= 0 for i in active)
         if self.speculative > 0 and any_greedy:
-            spec = self._spec_tick(active)
+            spec = self._spec_tick(slots, active, gen)
             if spec is not None:
                 out, valid = spec
                 self._decode_steps += 1
                 self.step_log.append((self._decode_steps,
                                       frozenset(active)))
-                self._emit(active, out, valid)
+                self._emit(slots, active, out, valid)
                 return
             # else: a slot is near the cache window — single-step tick.
         # All-slots decode: K scanned steps per dispatch when nothing is
@@ -820,23 +1089,23 @@ class ContinuousBatchingEngine:
             # cache window can't absorb a full chunk finish on single
             # steps.
             window_ok = all(
-                self.cfg.max_seq_len - self._slots[i].next_pos
+                self.cfg.max_seq_len - slots[i].next_pos
                 >= self.decode_chunk for i in active)
             if window_ok:
                 k = self.decode_chunk
-        tokens = [(self._slots[i].tokens[-1]
-                   if self._slots[i] is not None else 0)
+        tokens = [(slots[i].tokens[-1]
+                   if slots[i] is not None else 0)
                   for i in range(self.num_slots)]
-        positions = [(self._slots[i].next_pos
-                      if self._slots[i] is not None else 0)
+        positions = [(slots[i].next_pos
+                      if slots[i] is not None else 0)
                      for i in range(self.num_slots)]
-        temps = [(self._slots[i].temperature
-                  if self._slots[i] is not None else 0.0)
+        temps = [(slots[i].temperature
+                  if slots[i] is not None else 0.0)
                  for i in range(self.num_slots)]
         self._rng, rng = jax.random.split(self._rng)
         import numpy as np
         if k == 1:
-            out_tokens, self._cache = self._decode(
+            out_tokens, cache = self._decode(
                 self.params, self._cache,
                 jnp.asarray(tokens, jnp.int32)[:, None],
                 jnp.asarray(positions, jnp.int32)[:, None],
@@ -844,21 +1113,23 @@ class ContinuousBatchingEngine:
             out_cols = np.asarray(out_tokens)[:, None]
         else:
             rngs = jax.random.split(rng, k)
-            out_tokens, self._cache = self._decode_multi(
+            out_tokens, cache = self._decode_multi(
                 self.params, self._cache,
                 jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(positions, jnp.int32),
                 jnp.asarray(temps, jnp.float32), rngs)
             out_cols = np.asarray(out_tokens)     # (num_slots, k)
+        self._commit_gen(gen, lambda: setattr(self, '_cache', cache))
         self._decode_steps += k
         self.step_log.append((self._decode_steps, frozenset(active)))
-        self._emit(active, out_cols, None)
+        self._emit(slots, active, out_cols, None)
 
-    def _emit(self, active, out_cols, valid) -> None:
+    def _emit(self, slots, active, out_cols, valid) -> None:
         """Append per-slot output columns (up to valid[slot] of them —
-        None ⇒ all) with EOS/max/window termination."""
+        None ⇒ all) with EOS/max/window termination. `slots` is the
+        emitting tick's snapshot (see _tick)."""
         for slot in active:
-            req = self._slots[slot]
+            req = slots[slot]
             limit = (out_cols.shape[1] if valid is None
                      else int(valid[slot]))
             for c in range(limit):
@@ -875,7 +1146,7 @@ class ContinuousBatchingEngine:
                     # stale cache entries sit beyond every future query
                     # position (causal-masked) or get overwritten by the
                     # next admitted request's _insert.
-                    self._finish(slot)
+                    self._finish(slots, slot)
                     break
 
     # ---------------- public api ----------------
@@ -883,12 +1154,35 @@ class ContinuousBatchingEngine:
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                temperature: float = 0.0,
                eos_id: Optional[int] = None,
-               on_token=None):
+               on_token=None,
+               deadline: Optional[float] = None):
         """Enqueue one request; returns a concurrent.futures.Future that
         resolves to (token_ids, stats). `on_token` (optional) is called
         from the engine thread with each token as it lands and once with
-        None when the request finishes — the streaming hook."""
+        None when the request finishes — the streaming hook. `deadline`
+        (absolute time.time() seconds) fails the request with
+        RequestDeadlineExceededError once passed, whether it is still
+        queued or mid-decode.
+
+        Admission control: while draining, or with max_queue_depth
+        exceeded, raises EngineDrainingError/EngineOverloadedError
+        instead of queueing — callers shed load at the edge."""
         import concurrent.futures
+        if self._draining:
+            raise exceptions.EngineDrainingError(
+                'engine is draining for shutdown; not accepting new '
+                'requests')
+        if self.max_queue_depth:
+            # Backlog = queued beyond what free slots will absorb at
+            # the next tick: an idle engine must accept a burst of
+            # num_slots + cap, not shed at cap while slots sit empty.
+            free = sum(1 for r in self._slots if r is None)
+            backlog = self._queue.qsize() - free
+            if backlog >= self.max_queue_depth:
+                raise exceptions.EngineOverloadedError(
+                    f'engine admission queue is full ({backlog} '
+                    f'queued beyond free capacity, cap '
+                    f'{self.max_queue_depth})')
         ids = [int(t) for t in prompt_ids]
         if not ids:
             raise ValueError('empty prompt')
@@ -898,8 +1192,21 @@ class ContinuousBatchingEngine:
                 f'{self.cfg.max_seq_len}')
         future: 'concurrent.futures.Future' = concurrent.futures.Future()
         req = _Request(ids, max_new_tokens, temperature, eos_id, future,
-                       on_token=on_token)
-        self._queue.put(req)
+                       on_token=on_token, deadline=deadline)
+        # Enqueue under _thread_lock: watchdog recovery swaps the queue
+        # object under the same lock, so this put lands either in the
+        # old queue BEFORE the swap (and is failed by the recovery
+        # drain) or in the successor queue — never in an abandoned
+        # queue nobody will ever read (a future that hangs forever).
+        # Re-checking _draining under the same lock closes the
+        # drain/submit race the same way: either this request is
+        # visible to drain's wait loop, or it is refused here.
+        with self._thread_lock:
+            if self._draining:
+                raise exceptions.EngineDrainingError(
+                    'engine is draining for shutdown; not accepting '
+                    'new requests')
+            self._queue.put(req)
         self._ensure_thread()
         self._wake.set()
         return future
@@ -923,6 +1230,49 @@ class ContinuousBatchingEngine:
         if return_stats:
             return stats
         return [st['ttft_s'] for st in stats]
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop admitting (submit raises
+        EngineDrainingError), let in-flight AND already-queued requests
+        finish, then stop the engine thread. Returns True when
+        everything finished before `timeout` (None = wait forever).
+        Requests still pending when the drain gives up are FAILED with
+        EngineDrainingError — a drain must never leave a caller blocked
+        on a future nobody will resolve."""
+        import queue as queue_lib
+        import time as time_lib
+        with self._thread_lock:
+            self._draining = True
+        deadline = (time_lib.monotonic() + timeout
+                    if timeout is not None else None)
+        while self._busy():
+            thread = self._thread
+            if thread is None or not thread.is_alive():
+                break  # no engine thread will ever finish them
+            if deadline is not None and time_lib.monotonic() > deadline:
+                break
+            time_lib.sleep(0.02)
+        finished = not self._busy()
+        self.stop()
+        if not finished:
+            leftovers = []
+            with self._thread_lock:
+                for slot in range(self.num_slots):
+                    req = self._slots[slot]
+                    if req is not None:
+                        self._slots[slot] = None
+                        leftovers.append(req)
+                while True:
+                    try:
+                        leftovers.append(self._queue.get_nowait())
+                    except queue_lib.Empty:
+                        break
+            err = exceptions.EngineDrainingError(
+                'engine drain timed out; request aborted during '
+                'shutdown')
+            for req in leftovers:
+                self._fail_request(req, err)
+        return finished
 
     def stop(self) -> None:
         self._stop.set()

@@ -56,6 +56,27 @@ def probe_interval_seconds() -> float:
     return _env_float('SKYTPU_SERVE_PROBE_INTERVAL', 10.0)
 
 
+# ---- LB circuit breaker (serve/load_balancer.py) ----
+
+
+def lb_eject_threshold() -> int:
+    """Consecutive transport errors before a replica is ejected from
+    the LB's rotation."""
+    return int(_env_float('SKYTPU_SERVE_LB_EJECT_THRESHOLD', 3))
+
+
+def lb_eject_cooldown_seconds() -> float:
+    """How long an ejected replica sits out before a half-open probe
+    request is allowed through."""
+    return _env_float('SKYTPU_SERVE_LB_EJECT_COOLDOWN', 15.0)
+
+
+def lb_retry_attempts() -> int:
+    """Upstream attempts (across DIFFERENT replicas) for idempotent
+    requests; non-idempotent requests always get exactly one."""
+    return max(1, int(_env_float('SKYTPU_SERVE_LB_RETRIES', 2)))
+
+
 def probe_timeout_seconds() -> float:
     return _env_float('SKYTPU_SERVE_PROBE_TIMEOUT', 15.0)
 

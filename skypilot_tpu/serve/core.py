@@ -8,6 +8,7 @@ local process (see serve/service.py).
 """
 from __future__ import annotations
 
+import logging
 import os
 import socket
 import subprocess
@@ -25,6 +26,8 @@ from skypilot_tpu.utils import timeline
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu import task as task_lib
+
+logger = logging.getLogger(__name__)
 
 
 def _pick_port() -> int:
@@ -151,11 +154,19 @@ def _up_remote(task: 'task_lib.Task', service_name: str, task_yaml: str,
 
 def _sync_remote_service(record: Dict[str, Any]) -> Dict[str, Any]:
     """Refresh one remote service's client-side row from the controller
-    cluster; marks CONTROLLER_FAILED when the cluster is unreachable."""
+    cluster. A single transient RPC failure keeps the last-known state
+    (CONTROLLER_FAILED is sticky — flapping there on one SSH hiccup
+    would brand a live fleet dead); repeated failures escalate through
+    the shared persistent tracker (utils/retry.py) to a cloud-truth
+    probe, mirroring the managed-jobs path. Only a definitive answer —
+    ClusterNotUpError from the state db, or the cloud probe saying the
+    cluster is not UP — marks CONTROLLER_FAILED."""
     from skypilot_tpu.serve.serve_state import ServiceStatus
     from skypilot_tpu.utils import remote_rpc
+    from skypilot_tpu.utils import retry as retry_lib
 
     name = record['name']
+    cluster_name = record['remote_cluster']
     body = (
         'from skypilot_tpu.serve import serve_state; '
         'from skypilot_tpu.utils import common_utils; '
@@ -168,15 +179,31 @@ def _sync_remote_service(record: Dict[str, Any]) -> Dict[str, Any]:
         '"lb_port": rec["lb_port"], '
         '"replica_info": [r.to_info_dict() for r in infos]}); '
         'print(common_utils.encode_payload(payload))')
-    try:
-        remote = remote_rpc.rpc(record['remote_cluster'], body,
-                                operation='serve-rpc')
-    except (exceptions.ClusterNotUpError, exceptions.CommandError):
+    def _mark_controller_failed() -> Dict[str, Any]:
         serve_state.set_service_status(name,
                                        ServiceStatus.CONTROLLER_FAILED)
         record['status'] = ServiceStatus.CONTROLLER_FAILED
         record['replica_info'] = []
         return record
+
+    try:
+        remote = remote_rpc.rpc(cluster_name, body,
+                                operation='serve-rpc')
+    except exceptions.ClusterNotUpError:
+        retry_lib.reset_rpc_failures(cluster_name)
+        return _mark_controller_failed()
+    except exceptions.CommandError as e:
+        verdict, fails = retry_lib.record_rpc_failure_and_probe(
+            cluster_name)
+        if verdict == 'gone':
+            return _mark_controller_failed()
+        logger.warning(
+            'RPC failure %d to serve controller cluster %s (%s, '
+            'verdict %s); keeping last-known state of service %s.',
+            fails, cluster_name, e, verdict, name)
+        record.setdefault('replica_info', [])
+        return record
+    retry_lib.reset_rpc_failures(cluster_name)
     if remote is None:
         # Runner finished host-side (downed out-of-band): reflect that.
         record['replica_info'] = []
@@ -198,10 +225,13 @@ def _sync_remote_service(record: Dict[str, Any]) -> Dict[str, Any]:
     return record
 
 
-def _down_remote(record: Dict[str, Any]) -> None:
+def _down_remote(record: Dict[str, Any], purge: bool = False) -> None:
     """`down` for a remote service: run the ordinary down() ON the
     controller host (it owns the runner pid + replica fleet), then drop
-    the client-side row."""
+    the client-side row. With purge=True an unreachable controller
+    cluster is not fatal: leftover replica clusters recorded client-side
+    are torn down best-effort and the service row is removed — the
+    escape hatch for a controller cluster deleted out-of-band."""
     from skypilot_tpu.utils import remote_rpc
 
     name = record['name']
@@ -213,12 +243,38 @@ def _down_remote(record: Dict[str, Any]) -> None:
         remote_rpc.rpc(record['remote_cluster'], body,
                        operation='serve-down', timeout=600.0)
     except (exceptions.ClusterNotUpError, exceptions.CommandError) as e:
-        raise exceptions.ServeUserTerminatedError(
-            f'Could not reach controller cluster '
-            f'{record["remote_cluster"]!r} to tear down '
-            f'{name!r}: {e}. If the cluster is gone, rerun with '
-            f'purge=True after `skytpu down` of any leftover replicas.'
-        ) from e
+        if not purge:
+            raise exceptions.ServeUserTerminatedError(
+                f'Could not reach controller cluster '
+                f'{record["remote_cluster"]!r} to tear down '
+                f'{name!r}: {e}. If the cluster is gone, rerun with '
+                f'purge=True after `skytpu down` of any leftover '
+                f'replicas.') from e
+        # Best-effort cleanup: tear down any replica cluster the CLIENT
+        # knows about. For a fully remote service the replica fleet was
+        # launched from the controller host against its own state db,
+        # so the client typically has nothing to act on — the warning
+        # names the clusters that may live on.
+        from skypilot_tpu import core as sky_core
+        from skypilot_tpu import global_user_state
+        leftovers = []
+        for replica in serve_state.get_replica_infos(name):
+            if global_user_state.get_cluster_from_name(
+                    replica.cluster_name) is None:
+                continue
+            try:
+                sky_core.down(replica.cluster_name, purge=True)
+            except Exception:  # pylint: disable=broad-except
+                leftovers.append(replica.cluster_name)
+        logger.warning(
+            'Controller cluster %s unreachable during purge-down of '
+            'service %s (%s); removed client-side state. Replica '
+            'clusters launched BY that controller are not recorded '
+            'client-side — check the cloud for `%s-replica-*` clusters '
+            'and `skytpu down` any leftovers%s.',
+            record['remote_cluster'], name, e, name,
+            f' (client-side teardown failed for: {leftovers})'
+            if leftovers else '')
     serve_state.remove_service(name)
 
 
@@ -300,7 +356,7 @@ def down(service_name: str, purge: bool = False) -> None:
         raise exceptions.ServeUserTerminatedError(
             f'Service {service_name!r} does not exist.')
     if record.get('remote_cluster'):
-        _down_remote(record)
+        _down_remote(record, purge=purge)
         return
     pid = record['controller_pid']
     from skypilot_tpu.utils import subprocess_utils

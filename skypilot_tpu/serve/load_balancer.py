@@ -12,7 +12,7 @@ import asyncio
 import logging
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional, Set
 
 import aiohttp
 from aiohttp import web
@@ -28,9 +28,129 @@ _HOP_HEADERS = {
     'host', 'content-length',
 }
 
+# Methods safe to transparently retry on a DIFFERENT replica: the
+# request can have had no effect worth double-applying. POST /generate
+# is NOT here — a generation may already be burning decode slots.
+_IDEMPOTENT_METHODS = frozenset({'GET', 'HEAD', 'OPTIONS'})
+
+
+class _CommittedStreamError(Exception):
+    """Upstream died AFTER response headers were sent downstream: the
+    response is committed, so the only honest signal left is a hard
+    connection close (a chunked-encoding eof would make the truncation
+    look like a clean completion)."""
+
+
+class ReplicaCircuitBreaker:
+    """Per-replica consecutive-error ejection with half-open probing.
+
+    closed (healthy) --N consecutive transport errors--> open (ejected)
+    open --cooldown elapses--> half-open: the next request through is
+    the probe; success closes the breaker, failure re-opens it and the
+    cooldown restarts. Counts TRANSPORT errors (connect/reset), not HTTP
+    status codes — a replica answering 4xx/5xx is alive and its
+    application errors must flow back to the client unfiltered.
+
+    `clock` is injectable so tests drive the cooldown without sleeping.
+    """
+
+    def __init__(self, threshold: Optional[int] = None,
+                 cooldown: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.threshold = (threshold if threshold is not None else
+                          constants.lb_eject_threshold())
+        self.cooldown = (cooldown if cooldown is not None else
+                         constants.lb_eject_cooldown_seconds())
+        self._clock = clock
+        self._lock = threading.Lock()
+        # url -> {'failures': int, 'opened_at': float}
+        self._state: Dict[str, dict] = {}
+
+    def record_success(self, url: str) -> None:
+        with self._lock:
+            if self._state.pop(url, None) is not None:
+                logger.info('LB circuit breaker: replica %s healthy '
+                            'again (closed)', url)
+
+    def record_failure(self, url: str) -> None:
+        with self._lock:
+            st = self._state.setdefault(
+                url, {'failures': 0, 'opened_at': 0.0,
+                      'probe_started': None})
+            st['failures'] += 1
+            st['probe_started'] = None  # a probe (if any) just failed
+            if st['failures'] >= self.threshold:
+                # Newly ejected, or a failed half-open probe: (re)start
+                # the cooldown.
+                st['opened_at'] = self._clock()
+                logger.warning(
+                    'LB circuit breaker: ejecting replica %s after %d '
+                    'consecutive errors (cooldown %.1fs)', url,
+                    st['failures'], self.cooldown)
+
+    def blocked(self, urls: List[str]) -> Set[str]:
+        """Subset of `urls` that must not be selected right now. An
+        ejected replica whose cooldown has elapsed is NOT blocked — it
+        is a half-open candidate — unless another request already
+        claimed the probe (claim_probe): a still-dead replica must eat
+        ONE probe request per cooldown, not a whole concurrent burst
+        of non-retryable POSTs."""
+        now = self._clock()
+        out: Set[str] = set()
+        with self._lock:
+            for url in urls:
+                st = self._state.get(url)
+                if st is None or st['failures'] < self.threshold:
+                    continue
+                if now - st['opened_at'] < self.cooldown:
+                    out.add(url)
+                elif st['probe_started'] is not None and \
+                        now - st['probe_started'] < self.cooldown:
+                    # Probe in flight (staleness-bounded: a probe whose
+                    # requester died without reporting expires after a
+                    # cooldown rather than wedging the replica out
+                    # forever).
+                    out.add(url)
+        return out
+
+    def claim_probe(self, url: str) -> None:
+        """The caller was routed to `url`; if it is half-open, this
+        request becomes THE probe — concurrent requests see it blocked
+        until the probe reports success/failure (or goes stale)."""
+        now = self._clock()
+        with self._lock:
+            st = self._state.get(url)
+            if st is None or st['failures'] < self.threshold:
+                return
+            stale = (st.get('probe_started') is not None and
+                     now - st['probe_started'] >= self.cooldown)
+            if now - st['opened_at'] >= self.cooldown and \
+                    (st.get('probe_started') is None or stale):
+                # Fresh claim, or re-claim of a probe whose requester
+                # died without reporting — half-open gating resumes
+                # instead of silently lapsing into an open floodgate.
+                st['probe_started'] = now
+
+    def clear_probe(self, url: str) -> None:
+        """Release a probe claim whose outcome is UNDETERMINED (client
+        disconnected, handler cancelled): the replica must not sit out
+        an extra cooldown for a probe that never concluded."""
+        with self._lock:
+            st = self._state.get(url)
+            if st is not None:
+                st['probe_started'] = None
+
+    def is_ejected(self, url: str) -> bool:
+        return bool(self.blocked([url]))
+
 
 class SkyServeLoadBalancer:
-    """(reference: SkyServeLoadBalancer, load_balancer.py:22)"""
+    """(reference: SkyServeLoadBalancer, load_balancer.py:22)
+
+    Health-aware: a per-replica circuit breaker ejects replicas on
+    consecutive transport errors (with half-open re-admission probes),
+    and idempotent requests that hit a dead replica are retried once on
+    a different one instead of surfacing a 502 to the client."""
 
     def __init__(self, controller_url: str, port: int,
                  policy_name: str = 'round_robin') -> None:
@@ -38,6 +158,7 @@ class SkyServeLoadBalancer:
         self.port = port
         self.policy: policies.LoadBalancingPolicy = \
             policies.POLICIES[policy_name]()
+        self.breaker = ReplicaCircuitBreaker()
         self.request_timestamps: List[float] = []
         self._ts_lock = threading.Lock()
         self._stop = asyncio.Event()
@@ -91,39 +212,110 @@ class SkyServeLoadBalancer:
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
         with self._ts_lock:
             self.request_timestamps.append(time.time())
-        replica_url = self.policy.select_replica()
-        if replica_url is None:
-            return web.Response(
-                status=503,
-                text='No ready replicas. The service may be starting or '
-                     'scaled to zero; retry shortly.')
-        target = replica_url + str(request.rel_url)
         headers = {
             k: v for k, v in request.headers.items()
             if k.lower() not in _HOP_HEADERS
         }
+        # The body is fully buffered before the first attempt, so a
+        # retry on a different replica replays the identical request.
         body = await request.read()
-        try:
-            async with self._session().request(
-                    request.method, target, headers=headers,
-                    data=body if body else None,
-                    timeout=aiohttp.ClientTimeout(
-                        total=None, sock_connect=10)) as upstream:
-                response = web.StreamResponse(
-                    status=upstream.status,
-                    headers={
-                        k: v for k, v in upstream.headers.items()
-                        if k.lower() not in _HOP_HEADERS
-                    })
-                await response.prepare(request)
-                # Chunked relay — token streams flow through unbuffered.
-                async for chunk in upstream.content.iter_any():
-                    await response.write(chunk)
-                await response.write_eof()
-                return response
-        except aiohttp.ClientError as e:
+        idempotent = request.method.upper() in _IDEMPOTENT_METHODS
+        attempts = constants.lb_retry_attempts() if idempotent else 1
+        tried: Set[str] = set()
+        last_err: Optional[Exception] = None
+        for _ in range(attempts):
+            blocked = self.breaker.blocked(
+                self.policy.ready_replica_urls) | tried
+            replica_url = self.policy.select_replica(exclude=blocked)
+            if replica_url is None:
+                break
+            # If this replica is half-open, this request is the probe:
+            # concurrent traffic keeps avoiding it until we report.
+            self.breaker.claim_probe(replica_url)
+            try:
+                return await self._proxy_once(request, replica_url,
+                                              headers, body)
+            except _CommittedStreamError:
+                # Closes the downstream connection: no retry is
+                # possible once headers/chunks went out. If this was a
+                # half-open probe whose outcome the replica didn't
+                # determine (downstream disconnect), release the claim.
+                self.breaker.clear_probe(replica_url)
+                raise
+            except aiohttp.ClientError as e:
+                # Transport-level failure: the replica never answered.
+                # Feed the breaker; an idempotent request retries on a
+                # DIFFERENT replica (tried-set), others fail fast.
+                self.breaker.record_failure(replica_url)
+                tried.add(replica_url)
+                last_err = e
+                logger.warning('upstream %s failed (%s)%s', replica_url,
+                               e, '; retrying on another replica'
+                               if idempotent else '')
+            except BaseException:
+                # Handler cancelled (downstream hung up before the
+                # upstream answered): outcome undetermined — release
+                # any probe claim rather than wedging the replica out
+                # for an extra cooldown.
+                self.breaker.clear_probe(replica_url)
+                raise
+        if last_err is not None:
             return web.Response(status=502,
-                                text=f'Upstream replica error: {e}')
+                                text=f'Upstream replica error: {last_err}')
+        if tried or self.policy.ready_replica_urls:
+            # Replicas exist but every one is ejected/tried: shed load
+            # with a hint instead of hammering known-bad backends.
+            return web.Response(
+                status=503, headers={'Retry-After': '1'},
+                text='All replicas are unhealthy (circuit breaker '
+                     'open); retry shortly.')
+        return web.Response(
+            status=503,
+            text='No ready replicas. The service may be starting or '
+                 'scaled to zero; retry shortly.')
+
+    async def _proxy_once(self, request: web.Request, replica_url: str,
+                          headers, body) -> web.StreamResponse:
+        target = replica_url + str(request.rel_url)
+        async with self._session().request(
+                request.method, target, headers=headers,
+                data=body if body else None,
+                timeout=aiohttp.ClientTimeout(
+                    total=None, sock_connect=10)) as upstream:
+            response = web.StreamResponse(
+                status=upstream.status,
+                headers={
+                    k: v for k, v in upstream.headers.items()
+                    if k.lower() not in _HOP_HEADERS
+                })
+            await response.prepare(request)
+            # Chunked relay — token streams flow through unbuffered.
+            # Past this point the response is committed: a mid-stream
+            # failure cannot be retried, only recorded. Upstream read
+            # errors charge the replica's breaker; DOWNSTREAM write
+            # errors are the client hanging up — the replica did
+            # nothing wrong and must not be ejected for it.
+            while True:
+                try:
+                    chunk = await upstream.content.readany()
+                except aiohttp.ClientError as e:
+                    self.breaker.record_failure(replica_url)
+                    raise _CommittedStreamError(str(e)) from e
+                if not chunk:
+                    break
+                try:
+                    await response.write(chunk)
+                except (aiohttp.ClientError, ConnectionResetError) as e:
+                    raise _CommittedStreamError(str(e)) from e
+            await response.write_eof()
+            # Success is recorded only after the FULL body relayed: a
+            # replica that reliably sends headers then dies mid-stream
+            # must accumulate consecutive failures and trip the
+            # breaker, not oscillate its counter via a headers-time
+            # success. (Application 4xx/5xx still count as transport
+            # success — the replica answered.)
+            self.breaker.record_success(replica_url)
+            return response
 
     # ---------------- lifecycle ----------------
 

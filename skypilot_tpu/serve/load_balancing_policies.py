@@ -6,7 +6,7 @@ Reference parity: sky/serve/load_balancing_policies.py (70 LoC) —
 from __future__ import annotations
 
 import threading
-from typing import List, Optional
+from typing import List, Optional, Set
 
 
 class LoadBalancingPolicy:
@@ -18,7 +18,11 @@ class LoadBalancingPolicy:
     def set_ready_replicas(self, urls: List[str]) -> None:
         raise NotImplementedError
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
+        """Pick a replica, skipping `exclude` (circuit-broken or
+        already-tried replicas). None when nothing is selectable."""
         raise NotImplementedError
 
 
@@ -37,14 +41,17 @@ class RoundRobinPolicy(LoadBalancingPolicy):
                 self.index = 0
             self.ready_replica_urls = list(urls)
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
         with self._lock:
-            if not self.ready_replica_urls:
-                return None
-            url = self.ready_replica_urls[self.index %
-                                          len(self.ready_replica_urls)]
-            self.index = (self.index + 1) % len(self.ready_replica_urls)
-            return url
+            n = len(self.ready_replica_urls)
+            for _ in range(n):
+                url = self.ready_replica_urls[self.index % n]
+                self.index = (self.index + 1) % n
+                if exclude is None or url not in exclude:
+                    return url
+            return None
 
 
 POLICIES = {

@@ -35,6 +35,7 @@ from skypilot_tpu.serve import constants
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import fault_injection
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu import task as task_lib
@@ -279,6 +280,12 @@ class SkyPilotReplicaManager:
         Returns readiness."""
         url = info.url
         if url is None:
+            return False
+        try:
+            # Chaos harness: an armed 'replica.probe' fault reads as a
+            # failed probe, driving the NOT_READY/threshold machinery.
+            fault_injection.point('replica.probe')
+        except fault_injection.InjectedFault:
             return False
         probe_url = url + self.spec.readiness_path
         try:

@@ -38,6 +38,8 @@ from typing import List, Optional
 
 from aiohttp import web
 
+from skypilot_tpu import exceptions
+
 logger = logging.getLogger(__name__)
 
 
@@ -52,6 +54,12 @@ def byte_decode(ids: List[int]) -> str:
 
 class InferenceServer:
 
+    # Class-level defaults so a bare instance (tests wrap an existing
+    # engine via __new__) still has sane serving-state flags.
+    ready = False
+    draining = False
+    request_timeout = 0.0
+
     def __init__(self, model: str, max_seq_len: Optional[int] = None,
                  tokenizer: str = 'byte',
                  checkpoint_dir: Optional[str] = None,
@@ -63,7 +71,10 @@ class InferenceServer:
                  top_k: int = 0,
                  top_p: float = 0.0,
                  speculative: int = 0,
-                 prefix_cache: int = 0) -> None:
+                 prefix_cache: int = 0,
+                 max_queue_depth: int = 0,
+                 request_timeout: float = 0.0,
+                 watchdog_timeout: float = 0.0) -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
@@ -96,7 +107,10 @@ class InferenceServer:
                                                kv_quant=kv_quant,
                                                top_k=top_k, top_p=top_p,
                                                speculative=speculative,
-                                               prefix_cache=prefix_cache)
+                                               prefix_cache=prefix_cache,
+                                               max_queue_depth=max_queue_depth,
+                                               watchdog_timeout=(
+                                                   watchdog_timeout or None))
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
         if tokenizer.startswith('hf:'):
@@ -104,6 +118,13 @@ class InferenceServer:
             self._hf_tokenizer = AutoTokenizer.from_pretrained(
                 tokenizer[3:])
         self.ready = False
+        # Server-wide per-request deadline cap (seconds; 0 = none). A
+        # request's own `timeout_s` can only tighten it.
+        self.request_timeout = request_timeout
+        # Graceful drain: once set (SIGTERM), new requests get 503 +
+        # Retry-After while in-flight ones finish; /health flips to 503
+        # so LBs pull this replica from their ready set.
+        self.draining = False
 
     # -- tokenizer --
 
@@ -121,54 +142,157 @@ class InferenceServer:
 
     async def handle_health(self, request: web.Request) -> web.Response:
         del request
+        if self.draining:
+            return web.json_response({'status': 'draining'}, status=503,
+                                     headers={'Retry-After': '5'})
         if not self.ready:
             return web.json_response({'status': 'warming'}, status=503)
         return web.json_response({'status': 'ok'})
 
+    # -- graceful degradation helpers --
+
+    @staticmethod
+    def _unavailable(message: str, status: int = 503,
+                     retry_after: int = 1) -> web.Response:
+        """Load-shedding response: overload/drain return 429/503 WITH
+        Retry-After instead of piling onto the batch queue."""
+        return web.json_response({'error': message}, status=status,
+                                 headers={'Retry-After':
+                                          str(retry_after)})
+
+    def _check_admission(self) -> Optional[web.Response]:
+        if self.draining:
+            return self._unavailable(
+                'server is draining for shutdown', retry_after=5)
+        return None
+
+    def _batch_capacity_error(self, n_prompts: int) -> Optional[str]:
+        """A single batch larger than slots + queue cap can NEVER be
+        admitted: shedding it with a retryable 429/503 would send the
+        client into an infinite backoff loop — it must be a terminal
+        400 instead."""
+        cap = self.engine.max_queue_depth
+        if not cap:
+            return None
+        limit = cap + self.engine.num_slots
+        if n_prompts > limit:
+            return (f'batch of {n_prompts} prompts exceeds this '
+                    f'server\'s capacity ({limit}); split the request')
+        return None
+
+    def _deadline_for(self, data: dict) -> Optional[float]:
+        """Per-request deadline: the request's own timeout_s, capped by
+        the server-wide --request-timeout. None = no deadline."""
+        timeout = data.get('timeout_s')
+        timeout = float(timeout) if timeout is not None else None
+        if timeout is not None and timeout <= 0:
+            raise ValueError('timeout_s must be > 0')
+        if self.request_timeout:
+            timeout = (min(timeout, self.request_timeout)
+                       if timeout is not None else self.request_timeout)
+        return time.time() + timeout if timeout is not None else None
+
     async def handle_generate(self, request: web.Request) -> web.Response:
-        data = await request.json()
+        busy = self._check_admission()
+        if busy is not None:
+            return busy
+        try:
+            data = await request.json()
+        except Exception:  # pylint: disable=broad-except
+            return web.json_response({'error': 'body must be JSON'},
+                                     status=400)
         if 'prompt_ids' in data:
             prompts = data['prompt_ids']
+            if not isinstance(prompts, (list, tuple)):
+                return web.json_response(
+                    {'error': 'prompt_ids must be a list of token '
+                              'lists'}, status=400)
         elif 'prompt' in data:
             prompt = data['prompt']
-            prompts = [self.encode(p) for p in
-                       (prompt if isinstance(prompt, list) else [prompt])]
+            try:
+                prompts = [self.encode(p) for p in
+                           (prompt if isinstance(prompt, list)
+                            else [prompt])]
+            except (TypeError, AttributeError) as e:
+                return web.json_response(
+                    {'error': f'prompt must be text: {e}'}, status=400)
         else:
             return web.json_response(
                 {'error': 'need prompt or prompt_ids'}, status=400)
-        max_new = int(data.get('max_new_tokens', 32))
-        temperature = float(data.get('temperature', 0.0))
 
         if data.get('stream'):
             if len(prompts) != 1:
                 return web.json_response(
                     {'error': 'stream=true takes exactly one prompt'},
                     status=400)
-            tokens, future = self._token_stream(prompts[0], max_new,
-                                                temperature)
-            resp = await self._sse_prepare(request)
+            # Invalid input must fail as a 400 BEFORE the stream opens,
+            # exactly like the non-streaming path — not as an aiohttp
+            # 500 after submit exploded.
+            try:
+                max_new = int(data.get('max_new_tokens', 32))
+                temperature = float(data.get('temperature', 0.0))
+                deadline = self._deadline_for(data)
+                tokens, future = self._token_stream(prompts[0], max_new,
+                                                    temperature,
+                                                    deadline=deadline)
+            except (TypeError, ValueError) as e:
+                return web.json_response({'error': str(e)}, status=400)
+            except exceptions.EngineOverloadedError as e:
+                return self._unavailable(str(e))
             push, flush = self._delta_decoder()
-            async for tok in tokens:
-                await self._sse_send(resp, {'token_id': tok,
-                                            'text_delta': push(tok)})
-            exc = future.exception()
-            if exc is not None:
-                await self._sse_send(resp, {'error': str(exc)})
-            else:
-                _, stats = future.result()
-                await self._sse_send(resp, {'done': True,
-                                            'text_delta': flush(),
-                                            'stats': stats})
-            await resp.write_eof()
+            try:
+                resp = await self._sse_prepare(request)
+                async for tok in tokens:
+                    await self._sse_send(resp, {'token_id': tok,
+                                                'text_delta': push(tok)})
+                exc = future.exception()
+                if exc is not None:
+                    await self._sse_send(resp, {'error': str(exc)})
+                else:
+                    _, stats = future.result()
+                    await self._sse_send(resp, {'done': True,
+                                                'text_delta': flush(),
+                                                'stats': stats})
+                await resp.write_eof()
+            finally:
+                # A disconnected client cancels this handler mid-relay;
+                # without cancelling the engine future the generation
+                # keeps burning a decode slot for no reader (no-op if
+                # the future already resolved).
+                future.cancel()
             return resp
 
         # All prompts go straight into the engine queue; awaiting the
         # futures concurrently lets this request's prompts AND other
         # in-flight HTTP requests share decode ticks.
-        futures = [self._submit_one(ids, max_new, temperature)
-                   for ids in prompts]
-        gathered = await asyncio.gather(
-            *[asyncio.wrap_future(f) for f in futures])
+        too_big = self._batch_capacity_error(len(prompts))
+        if too_big is not None:
+            return web.json_response({'error': too_big}, status=400)
+        futures = []
+        try:
+            max_new = int(data.get('max_new_tokens', 32))
+            temperature = float(data.get('temperature', 0.0))
+            deadline = self._deadline_for(data)
+            for ids in prompts:
+                futures.append(self._submit_one(ids, max_new,
+                                                temperature,
+                                                deadline=deadline))
+        except (TypeError, ValueError) as e:
+            self._cancel_all(futures)
+            return web.json_response({'error': str(e)}, status=400)
+        except exceptions.EngineOverloadedError as e:
+            # Shedding a PARTIALLY submitted batch must release the
+            # queue slots its head already took, or the orphans keep
+            # decoding for no reader and deepen the overload.
+            self._cancel_all(futures)
+            return self._unavailable(str(e))
+        try:
+            gathered = await asyncio.gather(
+                *[asyncio.wrap_future(f) for f in futures])
+        except exceptions.RequestDeadlineExceededError as e:
+            return web.json_response({'error': str(e)}, status=504)
+        except exceptions.EngineWedgedError as e:
+            return self._unavailable(str(e), retry_after=2)
         results = [out for out, _ in gathered]
         stats = [st for _, st in gathered]
         return web.json_response({
@@ -177,19 +301,30 @@ class InferenceServer:
             'stats': stats,
         })
 
+    @staticmethod
+    def _cancel_all(futures) -> None:
+        """Release engine work for a batch the handler is abandoning
+        (queued entries are dropped at admission; a request already in
+        a slot is swept at the next tick)."""
+        for future in futures:
+            future.cancel()
+
     def _submit_one(self, ids: List[int], max_new: int,
-                    temperature: float, on_token=None):
+                    temperature: float, on_token=None,
+                    deadline: Optional[float] = None):
         max_seq = self.engine.cfg.max_seq_len
         if len(ids) + max_new > max_seq:
             ids = ids[-(max_seq - max_new):]
         return self.engine.submit(ids, max_new_tokens=max_new,
                                   temperature=temperature,
-                                  on_token=on_token)
+                                  on_token=on_token,
+                                  deadline=deadline)
 
     # -- streaming plumbing --
 
     def _token_stream(self, ids: List[int], max_new: int,
-                      temperature: float):
+                      temperature: float,
+                      deadline: Optional[float] = None):
         """(async-iterable of tokens, future): engine-thread tokens
         bridged onto this event loop; the iterable ends at the engine's
         None sentinel (sent after the future resolves)."""
@@ -200,7 +335,7 @@ class InferenceServer:
             loop.call_soon_threadsafe(queue.put_nowait, tok)
 
         future = self._submit_one(ids, max_new, temperature,
-                                  on_token=on_token)
+                                  on_token=on_token, deadline=deadline)
 
         async def tokens():
             while True:
@@ -218,7 +353,17 @@ class InferenceServer:
         trailing-replacement-char holdback: an in-progress multi-byte
         sequence decodes as U+FFFD and would CHANGE retroactively when
         its continuation bytes arrive, so it is withheld until complete
-        (or until flush, where a genuine U+FFFD is emitted as-is)."""
+        (or until flush, where a genuine U+FFFD is emitted as-is).
+
+        `sent['text']` tracks what the CLIENT actually received. On a
+        retroactive prefix change (pathological byte soup, tokenizer
+        cleanup), push withholds output — it must NOT adopt the new
+        decode as its baseline, or every later delta would be computed
+        against text the client never saw (dropping or duplicating the
+        corrected span). flush() then emits the corrected tail — the
+        diff against what was actually sent — so the client's
+        accumulated stream equals the canonical decode whenever the
+        final decode extends it."""
         toks: List[int] = []
         sent = {'text': ''}
 
@@ -229,9 +374,9 @@ class InferenceServer:
             toks.append(tok)
             full = _stable(self.decode(toks))
             if not full.startswith(sent['text']):
-                # Retroactive change despite holdback (pathological
-                # byte soup): resync without re-emitting.
-                sent['text'] = full
+                # Retroactive change despite holdback: withhold until
+                # the decode re-extends what was already emitted (the
+                # corrected tail lands in a later push or in flush).
                 return ''
             delta = full[len(sent['text']):]
             if delta:
@@ -242,6 +387,13 @@ class InferenceServer:
             full = self.decode(toks)
             if full.startswith(sent['text']):
                 return full[len(sent['text']):]
+            # The canonical decode retroactively changed text that was
+            # already on the wire; emitted bytes cannot be retracted —
+            # log loudly rather than silently diverge.
+            logger.warning(
+                'streamed text diverged from canonical decode '
+                '(sent %r... vs canonical %r...)', sent['text'][:40],
+                full[:40])
             return ''
 
         return push, flush
@@ -299,10 +451,15 @@ class InferenceServer:
         return text, 'length'
 
     @staticmethod
-    def _openai_error(message: str, status: int = 400) -> web.Response:
+    def _openai_error(message: str, status: int = 400,
+                      retry_after: Optional[int] = None) -> web.Response:
+        err_type = ('invalid_request_error' if status == 400 else
+                    'server_error')
+        headers = ({'Retry-After': str(retry_after)}
+                   if retry_after is not None else None)
         return web.json_response(
-            {'error': {'message': message, 'type': 'invalid_request_error'}},
-            status=status)
+            {'error': {'message': message, 'type': err_type}},
+            status=status, headers=headers)
 
     def _validate_openai(self, data: dict):
         if data.get('stream') and data.get('stop'):
@@ -342,6 +499,9 @@ class InferenceServer:
 
     async def handle_v1_completions(self,
                                     request: web.Request) -> web.Response:
+        if self.draining:
+            return self._openai_error('server is draining for shutdown',
+                                      status=503, retry_after=5)
         try:
             data = await request.json()
         except Exception:  # pylint: disable=broad-except
@@ -352,26 +512,46 @@ class InferenceServer:
         prompt = data.get('prompt')
         if prompt is None:
             return self._openai_error('prompt is required')
+        futures = []
         try:
             prompts = self._prompts_to_lists(prompt)
             prompt_ids = [self.encode(p) if isinstance(p, str) else
                           [int(t) for t in p] for p in prompts]
             max_new = int(data.get('max_tokens') or 16)
             temperature = float(data.get('temperature') or 0.0)
+            deadline = self._deadline_for(data)
             if data.get('stream'):
                 if len(prompt_ids) != 1:
                     return self._openai_error(
                         'stream=true takes exactly one prompt')
                 return await self._stream_completions(
-                    request, data, prompt_ids[0], max_new, temperature)
-            futures = [self._submit_one(ids, max_new, temperature)
-                       for ids in prompt_ids]
+                    request, data, prompt_ids[0], max_new, temperature,
+                    deadline=deadline)
+            too_big = self._batch_capacity_error(len(prompt_ids))
+            if too_big is not None:
+                return self._openai_error(too_big)
+            for ids in prompt_ids:
+                futures.append(self._submit_one(ids, max_new,
+                                                temperature,
+                                                deadline=deadline))
         except (TypeError, ValueError) as e:
             # Bad shapes/values (empty prompt, non-numeric fields, ...)
             # surface as OpenAI-format 400s, not aiohttp 500s.
+            self._cancel_all(futures)
             return self._openai_error(str(e))
-        gathered = await asyncio.gather(
-            *[asyncio.wrap_future(f) for f in futures])
+        except exceptions.EngineOverloadedError as e:
+            # OpenAI clients back off on 429 (rate limit semantics);
+            # cancel the already-submitted head of the batch so shed
+            # work does not keep consuming queue depth.
+            self._cancel_all(futures)
+            return self._openai_error(str(e), status=429, retry_after=1)
+        try:
+            gathered = await asyncio.gather(
+                *[asyncio.wrap_future(f) for f in futures])
+        except exceptions.RequestDeadlineExceededError as e:
+            return self._openai_error(str(e), status=504)
+        except exceptions.EngineWedgedError as e:
+            return self._openai_error(str(e), status=503, retry_after=2)
         choices = []
         completion_tokens = 0
         for i, (out, _st) in enumerate(gathered):
@@ -393,7 +573,8 @@ class InferenceServer:
         })
 
     async def _stream_completions(self, request, data, ids, max_new,
-                                  temperature) -> web.StreamResponse:
+                                  temperature,
+                                  deadline=None) -> web.StreamResponse:
         """OpenAI text-completion SSE chunks, closed by `data: [DONE]`."""
         cmpl_id = f'cmpl-{int(time.time() * 1e3):x}'
         created = int(time.time())
@@ -406,28 +587,36 @@ class InferenceServer:
                                  'logprobs': None,
                                  'finish_reason': finish}]}
 
-        tokens, future = self._token_stream(ids, max_new, temperature)
-        resp = await self._sse_prepare(request)
+        tokens, future = self._token_stream(ids, max_new, temperature,
+                                            deadline=deadline)
         push, flush = self._delta_decoder()
-        async for tok in tokens:
-            delta = push(tok)
-            if delta:
-                await self._sse_send(resp, chunk(delta))
-        exc = future.exception()
-        if exc is not None:
-            # Mid-stream engine failure: an error event and NO [DONE] —
-            # a truncated stream must not parse as a clean completion.
-            await self._sse_send(resp, {'error': {
-                'message': str(exc), 'type': 'server_error'}})
+        try:
+            # Inside the try: a client that disconnects during prepare
+            # must still cancel the already-submitted generation.
+            resp = await self._sse_prepare(request)
+            async for tok in tokens:
+                delta = push(tok)
+                if delta:
+                    await self._sse_send(resp, chunk(delta))
+            exc = future.exception()
+            if exc is not None:
+                # Mid-stream engine failure: an error event and NO
+                # [DONE] — a truncated stream must not parse as a clean
+                # completion.
+                await self._sse_send(resp, {'error': {
+                    'message': str(exc), 'type': 'server_error'}})
+                await resp.write_eof()
+                return resp
+            await self._sse_send(resp, chunk(flush(), finish='length'))
+            await self._sse_send(resp, '[DONE]')
             await resp.write_eof()
-            return resp
-        await self._sse_send(resp, chunk(flush(), finish='length'))
-        await self._sse_send(resp, '[DONE]')
-        await resp.write_eof()
+        finally:
+            future.cancel()  # free the decode slot if the client left
         return resp
 
     async def _stream_chat(self, request, data, ids, max_new,
-                           temperature) -> web.StreamResponse:
+                           temperature,
+                           deadline=None) -> web.StreamResponse:
         """OpenAI chat-completion SSE chunks (delta objects), closed by
         `data: [DONE]`."""
         chat_id = f'chatcmpl-{int(time.time() * 1e3):x}'
@@ -440,29 +629,36 @@ class InferenceServer:
                     'choices': [{'index': 0, 'delta': delta,
                                  'finish_reason': finish}]}
 
-        tokens, future = self._token_stream(ids, max_new, temperature)
-        resp = await self._sse_prepare(request)
-        await self._sse_send(resp, chunk({'role': 'assistant'}))
-        push, flush = self._delta_decoder()
-        async for tok in tokens:
-            delta = push(tok)
-            if delta:
-                await self._sse_send(resp, chunk({'content': delta}))
-        exc = future.exception()
-        if exc is not None:
-            await self._sse_send(resp, {'error': {
-                'message': str(exc), 'type': 'server_error'}})
+        tokens, future = self._token_stream(ids, max_new, temperature,
+                                            deadline=deadline)
+        try:
+            resp = await self._sse_prepare(request)
+            await self._sse_send(resp, chunk({'role': 'assistant'}))
+            push, flush = self._delta_decoder()
+            async for tok in tokens:
+                delta = push(tok)
+                if delta:
+                    await self._sse_send(resp, chunk({'content': delta}))
+            exc = future.exception()
+            if exc is not None:
+                await self._sse_send(resp, {'error': {
+                    'message': str(exc), 'type': 'server_error'}})
+                await resp.write_eof()
+                return resp
+            tail = flush()
+            if tail:
+                await self._sse_send(resp, chunk({'content': tail}))
+            await self._sse_send(resp, chunk({}, finish='length'))
+            await self._sse_send(resp, '[DONE]')
             await resp.write_eof()
-            return resp
-        tail = flush()
-        if tail:
-            await self._sse_send(resp, chunk({'content': tail}))
-        await self._sse_send(resp, chunk({}, finish='length'))
-        await self._sse_send(resp, '[DONE]')
-        await resp.write_eof()
+        finally:
+            future.cancel()  # free the decode slot if the client left
         return resp
 
     async def handle_v1_chat(self, request: web.Request) -> web.Response:
+        if self.draining:
+            return self._openai_error('server is draining for shutdown',
+                                      status=503, retry_after=5)
         try:
             data = await request.json()
         except Exception:  # pylint: disable=broad-except
@@ -490,13 +686,23 @@ class InferenceServer:
                 ids = self.encode('\n'.join(parts) + '\nassistant:')
             max_new = int(data.get('max_tokens') or 16)
             temperature = float(data.get('temperature') or 0.0)
+            deadline = self._deadline_for(data)
             if data.get('stream'):
                 return await self._stream_chat(request, data, ids,
-                                               max_new, temperature)
-            future = self._submit_one(ids, max_new, temperature)
+                                               max_new, temperature,
+                                               deadline=deadline)
+            future = self._submit_one(ids, max_new, temperature,
+                                      deadline=deadline)
         except (TypeError, ValueError, AttributeError) as e:
             return self._openai_error(str(e))
-        out, _st = await asyncio.wrap_future(future)
+        except exceptions.EngineOverloadedError as e:
+            return self._openai_error(str(e), status=429, retry_after=1)
+        try:
+            out, _st = await asyncio.wrap_future(future)
+        except exceptions.RequestDeadlineExceededError as e:
+            return self._openai_error(str(e), status=504)
+        except exceptions.EngineWedgedError as e:
+            return self._openai_error(str(e), status=503, retry_after=2)
         text, finish = self._truncate_at_stop(self.decode(out),
                                               data.get('stop'))
         prompt_tokens, completion_tokens = len(ids), len(out)
@@ -593,6 +799,24 @@ def main(argv=None) -> int:
                              'only the suffix. Each entry holds a full '
                              'batch-1 KV cache in HBM — size to spare '
                              'memory.')
+    parser.add_argument('--max-queue', type=int, default=64,
+                        help='admission control: queued-request cap; '
+                             'beyond it requests are shed with 429/503 '
+                             '+ Retry-After instead of growing the '
+                             'batch queue unboundedly (0 = unbounded)')
+    parser.add_argument('--request-timeout', type=float, default=0.0,
+                        help='per-request deadline cap in seconds '
+                             '(0 = none); a request\'s own timeout_s '
+                             'can only tighten it')
+    parser.add_argument('--watchdog-timeout', type=float, default=120.0,
+                        help='engine watchdog: fail in-flight requests '
+                             'cleanly when the decode thread makes no '
+                             'progress for this long (0 = off); must '
+                             'exceed the worst-case decode tick')
+    parser.add_argument('--drain-timeout', type=float, default=30.0,
+                        help='graceful shutdown (SIGTERM): stop '
+                             'admitting, wait up to this long for '
+                             'in-flight requests, then exit')
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -608,12 +832,44 @@ def main(argv=None) -> int:
                              kv_quant=args.kv_quant,
                              top_k=args.top_k, top_p=args.top_p,
                              speculative=args.speculative,
-                             prefix_cache=args.prefix_cache)
+                             prefix_cache=args.prefix_cache,
+                             max_queue_depth=args.max_queue,
+                             request_timeout=args.request_timeout,
+                             watchdog_timeout=args.watchdog_timeout)
     logger.info('sampling filters: top_k=%s top_p=%s (0 = off)',
                 args.top_k, args.top_p)
     server.warmup()
+
+    # Graceful drain on SIGTERM: stop admitting (health flips to 503 so
+    # the LB pulls this replica), finish in-flight requests, then exit.
+    import signal
+    import threading
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+
+    def _graceful_exit():
+        raise web.GracefulExit()
+
+    def _drain_and_exit():
+        logger.info('SIGTERM: draining (finishing in-flight requests, '
+                    'timeout %.0fs)...', args.drain_timeout)
+        finished = server.engine.drain(timeout=args.drain_timeout)
+        logger.info('drain %s; shutting down.',
+                    'complete' if finished else 'timed out')
+        loop.call_soon_threadsafe(_graceful_exit)
+
+    def _on_sigterm(signum, frame):
+        del signum, frame
+        if server.draining:
+            return
+        server.draining = True
+        threading.Thread(target=_drain_and_exit, daemon=True,
+                         name='drain').start()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     web.run_app(server.make_app(), host='0.0.0.0', port=args.port,
-                handle_signals=False)
+                handle_signals=False, loop=loop)
     return 0
 
 

@@ -12,10 +12,8 @@ import getpass
 import hashlib
 import json
 import os
-import random
 import re
 import socket
-import time
 import uuid
 from typing import Any, Callable, Optional
 
@@ -97,21 +95,27 @@ def get_global_job_id(run_timestamp: str, cluster_name: str,
 def retry(fn: Optional[Callable] = None, *, max_retries: int = 3,
           initial_backoff: float = 1.0, max_backoff: float = 30.0,
           exceptions_to_retry=(Exception,)) -> Callable:
-    """Exponential backoff with jitter."""
+    """Exponential backoff with jitter — thin decorator over the shared
+    retry policy (utils/retry.py), so backoff tuning lives in ONE
+    place."""
 
     def decorator(func: Callable) -> Callable:
 
+        # A bare exception class is as valid here as a tuple (it was
+        # passed straight to an `except` clause before).
+        retry_on = (exceptions_to_retry
+                    if isinstance(exceptions_to_retry, tuple)
+                    else (exceptions_to_retry,)
+                    if isinstance(exceptions_to_retry, type)
+                    else tuple(exceptions_to_retry))
+
         @functools.wraps(func)
         def wrapper(*args, **kwargs):
-            backoff = initial_backoff
-            for attempt in range(max_retries + 1):
-                try:
-                    return func(*args, **kwargs)
-                except exceptions_to_retry:
-                    if attempt == max_retries:
-                        raise
-                    time.sleep(backoff * (1 + random.random() * 0.3))
-                    backoff = min(backoff * 2, max_backoff)
+            from skypilot_tpu.utils import retry as retry_lib
+            return retry_lib.call_with_retry(
+                lambda: func(*args, **kwargs),
+                attempts=max_retries + 1, retry_on=retry_on,
+                base=initial_backoff, cap=max_backoff)
 
         return wrapper
 

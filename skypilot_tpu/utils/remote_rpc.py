@@ -7,12 +7,15 @@ serve/core.py's remote paths.
 """
 from __future__ import annotations
 
+import os
 import shlex
-from typing import Any
+from typing import Any, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import fault_injection
+from skypilot_tpu.utils import retry as retry_lib
 
 
 def merge_enabled_clouds(comma_list: str) -> None:
@@ -46,12 +49,45 @@ def head_runner(cluster_name: str, operation: str = 'controller-rpc'):
 
 
 def rpc(cluster_name: str, body: str, operation: str = 'controller-rpc',
-        timeout: float = 300.0) -> Any:
-    runner = head_runner(cluster_name, operation)
-    cmd = (f'{agent_constants.RUNTIME_PY_RESOLVER}'
-           f'"$_SKYPY" -u -c {shlex.quote(body)}')
-    rc, stdout, stderr = runner.run(cmd, require_outputs=True,
-                                    stream_logs=False, timeout=timeout)
-    if rc != 0:
-        raise exceptions.CommandError(rc, operation, stderr)
-    return common_utils.decode_payload(stdout)
+        timeout: float = 300.0, attempts: Optional[int] = None) -> Any:
+    """One codegen-RPC round-trip, with the shared retry/backoff policy
+    (utils/retry.py): a transient SSH hiccup is retried in-process with
+    jittered backoff under the call's own deadline; only an exhausted
+    call surfaces a CommandError for the caller's consecutive-failure
+    escalation. ClusterNotUpError (a definitive state-db answer) is
+    never retried."""
+    import time as time_lib
+    if attempts is None:
+        attempts = int(os.environ.get('SKYTPU_RPC_ATTEMPTS', '2'))
+    start = time_lib.monotonic()
+
+    def _once() -> Any:
+        try:
+            fault_injection.point('rpc.send')
+        except fault_injection.InjectedFault as e:
+            raise exceptions.CommandError(255, operation,
+                                          f'injected fault: {e}')
+        runner = head_runner(cluster_name, operation)
+        cmd = (f'{agent_constants.RUNTIME_PY_RESOLVER}'
+               f'"$_SKYPY" -u -c {shlex.quote(body)}')
+        # Each attempt gets only the REMAINING deadline (floor 5s), so
+        # rpc(timeout=T) is a hard ~T wall-clock bound for the whole
+        # call, retries included — not attempts x T.
+        remaining = max(5.0, timeout - (time_lib.monotonic() - start))
+        rc, stdout, stderr = runner.run(cmd, require_outputs=True,
+                                        stream_logs=False,
+                                        timeout=remaining)
+        if rc != 0:
+            raise exceptions.CommandError(rc, operation, stderr)
+        return common_utils.decode_payload(stdout)
+
+    # Retry only TRANSPORT-level failures (ssh exits 255 when it never
+    # reached the remote command): a deterministic remote-script error
+    # would just re-execute a possibly non-idempotent body and double
+    # the latency to the user's error message.
+    return retry_lib.call_with_retry(
+        _once, attempts=max(1, attempts),
+        retry_on=(exceptions.CommandError,),
+        retry_if=lambda e: getattr(e, 'returncode', None) == 255,
+        base=float(os.environ.get('SKYTPU_RPC_BACKOFF', '0.2')),
+        deadline=timeout)

@@ -1,0 +1,175 @@
+"""Unified retry/backoff + persistent consecutive-failure tracking.
+
+One policy for every transient-failure path (codegen RPC, LB upstream
+requests, storage transfers) instead of per-module ad-hoc counters:
+
+- ``Backoff`` / ``call_with_retry``: jittered exponential backoff with a
+  per-call deadline. The rng and sleep are injectable so tests pin exact
+  schedules without wall-clock sleeps.
+- ``ConsecutiveFailureTracker``: a failure counter persisted in the
+  client state db, keyed by cluster. The jobs and serve remote-sync
+  paths share it, so "3 consecutive RPC failures escalate to a cloud
+  probe" means 3 failures ACROSS CLI invocations — a fresh process
+  continues the count instead of starting over (tests/test_chaos.py
+  pins the cross-process round trip).
+- ``record_rpc_failure_and_probe``: the shared escalation ladder for
+  controller-cluster RPC failures (keep last-known state below the
+  threshold; at the threshold ask the CLOUD whether the cluster still
+  exists; only a conclusive "not UP" answer declares the controller
+  gone).
+"""
+from __future__ import annotations
+
+import logging
+import random
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+logger = logging.getLogger(__name__)
+
+# Consecutive failed RPC calls to one controller cluster before the
+# client escalates to a force-refreshed cloud-truth probe.
+RPC_FAILURES_BEFORE_PROBE = 3
+
+
+class Backoff:
+    """Jittered exponential backoff: delay_k = min(cap, base * factor^k),
+    scaled by a uniform jitter in [1-jitter, 1]. Full determinism via an
+    injected seeded rng."""
+
+    def __init__(self, base: float = 0.2, factor: float = 2.0,
+                 cap: float = 30.0, jitter: float = 0.5,
+                 rng: Optional[random.Random] = None) -> None:
+        if base < 0 or factor < 1 or not 0 <= jitter <= 1:
+            raise ValueError('need base>=0, factor>=1, 0<=jitter<=1')
+        self.base = base
+        self.factor = factor
+        self.cap = cap
+        self.jitter = jitter
+        self._rng = rng if rng is not None else random.Random()
+        self._attempt = 0
+
+    def next_delay(self) -> float:
+        raw = min(self.cap, self.base * (self.factor ** self._attempt))
+        self._attempt += 1
+        if self.jitter <= 0:
+            return raw
+        return raw * (1 - self.jitter * self._rng.random())
+
+
+def call_with_retry(fn: Callable[[], Any], *,
+                    attempts: int = 3,
+                    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+                    retry_if: Optional[Callable[[BaseException],
+                                                bool]] = None,
+                    base: float = 0.2,
+                    cap: float = 30.0,
+                    deadline: Optional[float] = None,
+                    sleep: Callable[[float], None] = time.sleep,
+                    clock: Callable[[], float] = time.monotonic,
+                    rng: Optional[random.Random] = None) -> Any:
+    """Call `fn` with up to `attempts` tries and jittered exponential
+    backoff between them. `deadline` (seconds, relative to the first
+    attempt) bounds RETRYING: no new attempt starts once it has passed
+    (or once the next backoff sleep would cross it) — the last error is
+    re-raised instead. An attempt already in flight runs to its own
+    timeout, so callers needing a hard wall-clock bound must also
+    shrink each attempt's internal timeout to the remaining deadline
+    (see utils/remote_rpc.rpc). Exceptions not in `retry_on` — or for
+    which `retry_if` returns False (e.g. a deterministic remote error
+    dressed as a transport one) — propagate immediately."""
+    if attempts < 1:
+        raise ValueError('attempts must be >= 1')
+    backoff = Backoff(base=base, cap=cap, rng=rng)
+    start = clock()
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:  # pylint: disable=catching-non-exception
+            if retry_if is not None and not retry_if(e):
+                raise
+            if attempt + 1 >= attempts:
+                raise
+            delay = backoff.next_delay()
+            if deadline is not None and \
+                    clock() - start + delay >= deadline:
+                raise  # the next attempt would start past the deadline
+            logger.debug('retry %d/%d after %.2fs: %s', attempt + 1,
+                         attempts, delay, e)
+            sleep(delay)
+    raise AssertionError('unreachable')
+
+
+class ConsecutiveFailureTracker:
+    """Per-key consecutive-failure counter persisted in the client state
+    db (global_user_state), so escalation thresholds survive CLI
+    restarts. Keys are namespaced by `scope`."""
+
+    def __init__(self, scope: str) -> None:
+        self.scope = scope
+
+    def _key(self, key: str) -> str:
+        return f'{self.scope}:{key}'
+
+    def record_failure(self, key: str) -> int:
+        """Increment and return the new consecutive-failure count."""
+        from skypilot_tpu import global_user_state
+        return global_user_state.bump_failure_count(self._key(key))
+
+    def count(self, key: str) -> int:
+        from skypilot_tpu import global_user_state
+        return global_user_state.get_failure_count(self._key(key))
+
+    def reset(self, key: str) -> None:
+        from skypilot_tpu import global_user_state
+        global_user_state.reset_failure_count(self._key(key))
+
+
+# The one tracker both remote-controller paths (managed jobs and serve)
+# share: a cluster's RPC health is a property of the CLUSTER, not of
+# which subsystem happened to call it.
+rpc_failure_tracker = ConsecutiveFailureTracker('rpc-failures')
+
+
+def record_rpc_failure_and_probe(
+        cluster_name: str,
+        threshold: int = RPC_FAILURES_BEFORE_PROBE) -> Tuple[str, int]:
+    """Shared escalation ladder for a failed controller-cluster RPC.
+
+    Returns (verdict, consecutive_failures) with verdict one of:
+      'transient'     below the threshold — keep last-known state
+      'up'            threshold reached but the cloud says the cluster
+                      is UP — RPC-level trouble, keep last-known state
+      'inconclusive'  the cloud probe itself failed (client offline,
+                      expired creds) — NOT proof the cluster is gone
+      'gone'          threshold reached and the cloud says the cluster
+                      is not UP — callers mark controller-failed
+
+    The counter persists in the state db (see ConsecutiveFailureTracker)
+    and resets only on 'gone' (callers reset on RPC success via
+    ``reset_rpc_failures``): a cluster that stays UP while RPC keeps
+    failing re-probes on every further failure rather than waiting
+    another full threshold.
+    """
+    fails = rpc_failure_tracker.record_failure(cluster_name)
+    if fails < threshold:
+        return 'transient', fails
+    from skypilot_tpu.backends import backend_utils
+    from skypilot_tpu.status_lib import ClusterStatus
+    try:
+        status, _ = backend_utils.refresh_cluster_status_handle(
+            cluster_name, force_refresh=True)
+    except Exception as probe_err:  # pylint: disable=broad-except
+        logger.warning(
+            'Cloud probe of controller cluster %s inconclusive (%s) '
+            'after %d RPC failures; keeping last-known state.',
+            cluster_name, probe_err, fails)
+        return 'inconclusive', fails
+    if status == ClusterStatus.UP:
+        return 'up', fails
+    rpc_failure_tracker.reset(cluster_name)
+    return 'gone', fails
+
+
+def reset_rpc_failures(cluster_name: str) -> None:
+    rpc_failure_tracker.reset(cluster_name)

@@ -41,6 +41,9 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         'markers', 'slow: full end-to-end loops on the fake cloud')
+    config.addinivalue_line(
+        'markers', 'chaos: fault-injection resilience tests '
+        '(deterministic, tier-1 — NOT slow)')
 
 
 @pytest.fixture(autouse=True)
@@ -55,6 +58,10 @@ def _isolate_state(tmp_path, monkeypatch):
     import skypilot_tpu.global_user_state as gus
     gus._db = None  # pylint: disable=protected-access
     yield
+    # A chaos test that failed mid-flight must not leave faults armed
+    # for every later test (and must not leave threads wedged on them).
+    from skypilot_tpu.utils import fault_injection
+    fault_injection.disarm_all()
     _reap_test_processes(str(tmp_path))
 
 

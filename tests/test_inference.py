@@ -787,3 +787,76 @@ class TestCombinedFilters:
         want = np.asarray(filter_top_p(filter_top_k(logits, 8), 0.8))
         np.testing.assert_array_equal(np.isneginf(got),
                                       np.isneginf(want))
+
+
+class TestDeltaDecoder:
+    """The streaming delta decoder must never silently diverge from the
+    canonical decode: what the client accumulates (push deltas + flush)
+    equals decode(all_tokens), including through retroactive-prefix
+    resyncs (satellite fix: flush diffs against what was ACTUALLY
+    sent)."""
+
+    @staticmethod
+    def _decoder(decode_fn=None):
+        from skypilot_tpu.serve.server import InferenceServer
+        server = InferenceServer.__new__(InferenceServer)
+        server._hf_tokenizer = None  # pylint: disable=protected-access
+        server.tokenizer_kind = 'byte'
+        if decode_fn is not None:
+            server.decode = decode_fn
+        return server._delta_decoder()  # pylint: disable=protected-access
+
+    def test_resync_emits_corrected_tail_not_duplicate(self):
+        """Pathological tokenizer whose cumulative decode SHRINKS one
+        step (hf-style cleanup jitter) then re-extends: before the fix,
+        push resynced its baseline to the shrunken text and the next
+        delta duplicated the overlap ('helloo world')."""
+        by_len = {1: 'hello', 2: 'hell', 3: 'hello world'}
+        push, flush = self._decoder(lambda ids: by_len[len(ids)])
+        received = push(101)
+        assert received == 'hello'
+        received += push(102)          # retroactive shrink: withheld
+        received += push(103) + flush()
+        assert received == 'hello world'
+
+    def test_flush_emits_corrected_tail_after_resync(self):
+        """After a mid-stream resync, the final held-back span comes
+        out of flush — the diff against actually-sent text, not
+        against the resync baseline (the pre-fix behavior dropped
+        it)."""
+        by_len = {1: 'hello', 2: 'hell', 3: 'hello w�'}
+        push, flush = self._decoder(lambda ids: by_len[len(ids)])
+        received = push(1)             # 'hello'
+        received += push(2)            # shrink → withheld
+        received += push(3)            # stable part → ' w'
+        received += flush()            # held-back '�'
+        assert received == 'hello w�'
+
+    def test_multibyte_utf8_split_across_tokens(self):
+        """Bytes of a multi-byte char arrive one per token: the U+FFFD
+        holdback keeps every emitted delta final."""
+        text = 'héllo … 😀!'
+        toks = list(text.encode('utf-8'))
+        push, flush = self._decoder()
+        received = ''
+        for tok in toks:
+            delta = push(tok)
+            # Emitted deltas are FINAL: always a prefix of the result.
+            received += delta
+            assert text.startswith(received) or '�' in received
+        received += flush()
+        assert received == text
+
+    def test_byte_soup_stream_equals_canonical(self):
+        """Seeded random byte soup (including invalid UTF-8 and out-of-
+        range ids the byte decoder drops): accumulated stream == the
+        canonical decode."""
+        import random as random_lib
+        from skypilot_tpu.serve.server import byte_decode
+        rng = random_lib.Random(1234)
+        for _ in range(100):
+            toks = [rng.randrange(0, 300)
+                    for _ in range(rng.randrange(1, 24))]
+            push, flush = self._decoder()
+            received = ''.join(push(t) for t in toks) + flush()
+            assert received == byte_decode(toks), toks
