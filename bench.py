@@ -345,8 +345,12 @@ def _measure_train(cfg, mesh, n, batch, seq, steps, warmup) -> dict:
             loss = float(m['loss'])  # sync: forces the step to finish
             timer.stop()
     step_time = timer.mean_step_time()
-    tps = metrics_lib.tokens_per_sec(batch, seq, step_time) / n
-    mfu = metrics_lib.mfu(cfg, batch, seq, step_time, num_chips=n)
+    # publish_throughput lands the same numbers in the metrics registry
+    # (skytpu_train_tokens_per_sec / skytpu_train_mfu) so a scraper
+    # sees exactly what this table prints.
+    tps_all, mfu = metrics_lib.publish_throughput(cfg, batch, seq,
+                                                  step_time, num_chips=n)
+    tps = tps_all / n
     print(f'model={cfg.name} chips={n} batch={batch} seq={seq} '
           f'steps={steps} step_time={step_time*1e3:.1f}ms '
           f'loss={loss:.3f} MFU={mfu*100:.1f}%', file=sys.stderr)

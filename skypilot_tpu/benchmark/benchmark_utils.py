@@ -156,8 +156,9 @@ def wait_and_terminate_losers(
     import time
 
     assert by in ('cost', 'time'), by
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    # monotonic: a wall-clock step must not stretch/cut the wait.
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         results = update_benchmark_results(benchmark)
         measured = [r for r in results
                     if r['num_steps'] and

@@ -5,6 +5,8 @@ Same command surface, TPU-native semantics: `launch, exec, status, queue,
 logs, cancel, stop, start, down, autostop, cost-report, check, show-tpus,
 storage ls/delete, jobs launch/queue/cancel/logs, serve up/status/down/
 logs`. Entry: `python -m skypilot_tpu.cli` (or the `skytpu` script).
+TPU-native additions include `metrics` (scrape/print a Prometheus
+/metrics endpoint — docs/observability.md).
 
 YAML-or-inline entrypoint parsing and resource override flags mirror
 cli.py:690,463; interactive confirm mirrors :532.
@@ -409,6 +411,65 @@ def cost_report():
             f"${r['total_cost']:.2f}"
         ])
     _print_table(rows, ['NAME', 'STATUS', 'RESOURCES', 'DURATION', 'COST'])
+
+
+@cli.command()
+@click.option('--url', default=None,
+              help='Scrape a /metrics endpoint (serve replica, load '
+                   'balancer, or dashboard), e.g. '
+                   'http://127.0.0.1:8080/metrics. Default: this '
+                   'process\'s own registry.')
+@click.option('--raw', is_flag=True, default=False,
+              help='Print the raw Prometheus text instead of a table.')
+@click.option('--grep', 'pattern', default=None,
+              help='Only show metric families whose name contains this '
+                   'substring.')
+def metrics(url, raw, pattern):
+    """Show metrics: scrape a /metrics endpoint, or dump this process.
+
+    The serving metric catalog (engine TTFT/TPOT, shed counters,
+    circuit-breaker state, retry ladder) lives in
+    docs/observability.md.
+    """
+    from skypilot_tpu.observability import exposition
+    from skypilot_tpu.observability import metrics as obs
+    if url is not None:
+        if '://' not in url:
+            url = 'http://' + url
+        if not url.rstrip('/').endswith('/metrics'):
+            url = url.rstrip('/') + '/metrics'
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                text = resp.read().decode('utf-8', errors='replace')
+        except (urllib.error.URLError, OSError) as e:
+            _fail(f'scrape of {url} failed: {e}')
+    else:
+        obs.enable()  # dumping IS exporting; record from here on
+        text = exposition.generate_latest()
+    if raw:
+        click.echo(text, nl=False)
+        return
+    try:
+        families = exposition.parse_prometheus_text(text)
+    except ValueError as e:
+        _fail(f'invalid Prometheus exposition from {url or "registry"}: '
+              f'{e}')
+    rows = []
+    for name in sorted(families):
+        if pattern and pattern not in name:
+            continue
+        fam = families[name]
+        for (sample, labels), value in sorted(fam['samples'].items()):
+            labels_str = ', '.join(f'{n}={v}' for n, v in labels) or '-'
+            rows.append([sample, labels_str, fam['kind'] or 'untyped',
+                         f'{value:g}'])
+    if not rows:
+        click.echo('no metrics recorded' + (
+            f' matching {pattern!r}' if pattern else '') + '.')
+        return
+    _print_table(rows, ['METRIC', 'LABELS', 'TYPE', 'VALUE'])
 
 
 @cli.command()

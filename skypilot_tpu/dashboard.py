@@ -51,6 +51,8 @@ _PAGE = """<!DOCTYPE html>
 {services}
 <h2>Clusters</h2>
 {clusters}
+<h2>Metrics</h2>
+{metrics}
 <footer>refreshed {now} &middot; auto-refresh 5s</footer>
 </body>
 </html>
@@ -128,6 +130,28 @@ class Dashboard:
         except Exception:  # pylint: disable=broad-except
             return []
 
+    def _metrics_rows(self) -> List[List[Any]]:
+        """This process's metrics registry as table rows (counters and
+        gauges verbatim; histograms as count/sum/mean). The serving
+        metrics live in the server/LB processes — scrape their /metrics
+        for those; this table shows the client-side view (retry ladder,
+        escalations) per service/engine label."""
+        from skypilot_tpu.observability import metrics as obs
+        rows: List[List[Any]] = []
+        for metric in obs.REGISTRY.collect():
+            for labelvalues, child in metric.samples():
+                labels = ', '.join(
+                    f'{n}={v}' for n, v in zip(metric.labelnames,
+                                               labelvalues)) or '-'
+                if metric.kind == 'histogram':
+                    _, total, count = child.value
+                    mean = total / count if count else 0.0
+                    value = f'n={count} mean={mean:.4g}s'
+                else:
+                    value = f'{child.value:g}'
+                rows.append([metric.name, labels, metric.kind, value])
+        return rows
+
     # -- handlers --
 
     async def index(self, request: web.Request) -> web.Response:
@@ -166,6 +190,8 @@ class Dashboard:
                              'VERSION'], svc_rows, status_col=1),
             clusters=_table(['NAME', 'STATUS', 'RESOURCES', 'LAUNCHED'],
                             cl_rows, status_col=1),
+            metrics=_table(['METRIC', 'LABELS', 'TYPE', 'VALUE'],
+                           self._metrics_rows()),
             now=datetime.datetime.now().strftime('%H:%M:%S'))
         return web.Response(text=page, content_type='text/html')
 
@@ -235,10 +261,18 @@ class Dashboard:
                 v = str(i.get('status'))
                 replicas[v] = replicas.get(v, 0) + 1
         gauge('skytpu_replicas', 'Serve replicas by status', replicas)
-        return web.Response(text='\n'.join(lines) + '\n',
+        # Append the process-wide registry (retry ladder, escalation
+        # verdicts, any engine running in-process): one scrape, one
+        # Perfetto-bridgeable view. Names are disjoint from the state
+        # gauges above by the skytpu_<subsystem>_ convention.
+        from skypilot_tpu.observability import exposition
+        return web.Response(text='\n'.join(lines) + '\n' +
+                            exposition.generate_latest(),
                             content_type='text/plain')
 
     def make_app(self) -> web.Application:
+        from skypilot_tpu.observability import metrics as obs
+        obs.enable()  # the /metrics route below is an exporter
         app = web.Application()
         app.router.add_get('/', self.index)
         app.router.add_get('/api/jobs', self.api_jobs)

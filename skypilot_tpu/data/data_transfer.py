@@ -182,8 +182,9 @@ def _find_existing_job(project_id: str, s3_bucket: str,
 
 
 def _wait_operation(op_name: str) -> None:
-    deadline = time.time() + _POLL_TIMEOUT_S
-    while time.time() < deadline:
+    # monotonic: a wall-clock step must not stretch/cut the wait.
+    deadline = time.monotonic() + _POLL_TIMEOUT_S
+    while time.monotonic() < deadline:
         op = _call('GET', f'{STS_ROOT}/{op_name}')
         if op.get('done'):
             if 'error' in op:

@@ -24,9 +24,58 @@ from flax import linen as nn
 from skypilot_tpu import exceptions
 from skypilot_tpu.models.configs import ModelConfig, get_config
 from skypilot_tpu.models.transformer import Transformer
+from skypilot_tpu.observability import metrics as obs
 from skypilot_tpu.utils import fault_injection
 
 logger = logging.getLogger(__name__)
+
+# Engine metrics (docs/observability.md). Label children are pre-bound
+# here so the hot paths never build a labels dict per event; with no
+# exporter attached every recording below is a single enabled-check
+# (pinned by tests/test_observability.py, same pattern as fault
+# injection's disarmed path).
+_TTFT_HIST = obs.histogram(
+    'skytpu_engine_ttft_seconds',
+    'Time from submit to first emitted token')
+_TPOT_HIST = obs.histogram(
+    'skytpu_engine_tpot_seconds',
+    'Per-request mean inter-token latency (decode span / tokens-1)',
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0))
+_QUEUE_DEPTH = obs.gauge(
+    'skytpu_engine_queue_depth',
+    'Requests queued for admission (not yet in a decode slot)')
+_ACTIVE_SLOTS = obs.gauge(
+    'skytpu_engine_active_slots', 'Decode slots currently occupied')
+_TOKENS_TOTAL = obs.counter(
+    'skytpu_engine_tokens_generated_total', 'Decode tokens emitted')
+_REQUESTS_TOTAL = obs.counter(
+    'skytpu_engine_requests_finished_total',
+    'Requests that resolved their future', ('outcome',))
+_REQ_OK = _REQUESTS_TOTAL.labels(outcome='ok')
+_REQ_FAILED = _REQUESTS_TOTAL.labels(outcome='failed')
+_REJECTS = obs.counter(
+    'skytpu_engine_admission_rejects_total',
+    'Requests refused at admission', ('reason',))
+_REJECT_OVERLOADED = _REJECTS.labels(reason='overloaded')
+_REJECT_DRAINING = _REJECTS.labels(reason='draining')
+_PREFIX = obs.counter(
+    'skytpu_engine_prefix_cache_total',
+    'Prefix-cache lookups at admission', ('result',))
+_PREFIX_HIT = _PREFIX.labels(result='hit')
+_PREFIX_MISS = _PREFIX.labels(result='miss')
+_PREFIX_TOKENS = obs.counter(
+    'skytpu_engine_prefix_tokens_reused_total',
+    'Prompt tokens whose prefill was skipped via the prefix cache')
+_SPEC_DRAFTED = obs.counter(
+    'skytpu_engine_spec_drafted_total',
+    'Speculative tokens drafted by prompt-lookup')
+_SPEC_ACCEPTED = obs.counter(
+    'skytpu_engine_spec_accepted_total',
+    'Speculative drafts accepted by verification')
+_WEDGE_RECOVERIES = obs.counter(
+    'skytpu_engine_wedge_recoveries_total',
+    'Watchdog recoveries (engine thread wedged or died)')
 
 
 class _StaleEngineError(Exception):
@@ -244,14 +293,15 @@ class InferenceEngine:
                    if temperature <= 0 else self._sampler)
 
         cache = self.init_cache()
-        t0 = time.time()
+        # monotonic: latencies must not go negative on wall-clock steps.
+        t0 = time.monotonic()
         logits, cache = self._prefill(self.params, cache,
                                       prompt.astype(jnp.int32),
                                       prompt_len=prompt_len)
         self._rng, rng = jax.random.split(self._rng)
         token = sampler(logits, rng, temperature)
         token.block_until_ready()
-        ttft = time.time() - t0
+        ttft = time.monotonic() - t0
 
         if self.decode_chunk > 1:
             # Chunked: K tokens per dispatch. EOS honored at chunk
@@ -302,7 +352,7 @@ class InferenceEngine:
                     break
             generated = jnp.stack(out, axis=1)
         generated.block_until_ready()
-        total = time.time() - t0
+        total = time.monotonic() - t0
         num_tokens = int(generated.shape[1])
         stats = {
             'ttft_s': ttft,
@@ -330,7 +380,10 @@ class _Request:
         self.temperature = temperature
         self.eos_id = eos_id
         self.future = future
-        self.submit_time = time.time()
+        # monotonic: feeds ttft_s/total_s durations (and the TTFT/TPOT
+        # histograms), which must not go negative on wall-clock steps.
+        # The `deadline` below stays wall-clock by API contract.
+        self.submit_time = time.monotonic()
         self.first_token_time: Optional[float] = None
         self.tokens: list = []
         self.next_pos = 0  # cache position the NEXT input token writes to
@@ -675,6 +728,8 @@ class ContinuousBatchingEngine:
         self.spec_stats['ticks'] += 1
         self.spec_stats['drafted'] += k * len(drafted_active)
         self.spec_stats['accepted'] += int(accepted[drafted_active].sum())
+        _SPEC_DRAFTED.inc(k * len(drafted_active))
+        _SPEC_ACCEPTED.inc(int(accepted[drafted_active].sum()))
         valid = accepted + 1          # emit accepted drafts + 1 bonus
         return out, valid
 
@@ -757,6 +812,7 @@ class ContinuousBatchingEngine:
         logger.error('engine watchdog: %s; failing in-flight requests '
                      'and resetting engine state (generation %d)', why,
                      self._generation)
+        _WEDGE_RECOVERIES.inc()
         err = exceptions.EngineWedgedError(
             f'{why}; request aborted by the engine watchdog')
         for req in old_slots:
@@ -770,6 +826,7 @@ class ContinuousBatchingEngine:
             self._fail_request(req, err)
 
     def _fail_request(self, req: '_Request', exc: BaseException) -> None:
+        _REQ_FAILED.inc()
         if not req.future.done():
             req.future.set_exception(exc)
         self._notify(req, None)
@@ -845,6 +902,8 @@ class ContinuousBatchingEngine:
                 jnp.asarray(len(suffix), jnp.int32))
             self.prefix_stats['hits'] += 1
             self.prefix_stats['tokens_reused'] += plen
+            _PREFIX_HIT.inc()
+            _PREFIX_TOKENS.inc(plen)
         else:
             bucket = self._bucket(true_len)
             padded = req.ids + [0] * (bucket - true_len)
@@ -853,6 +912,7 @@ class ContinuousBatchingEngine:
                 self.params, tokens, jnp.asarray(true_len, jnp.int32))
             if self.prefix_cache:
                 self.prefix_stats['misses'] += 1
+                _PREFIX_MISS.inc()
         if gen >= 0:
             self._check_gen(gen)
         if self.prefix_cache:
@@ -861,8 +921,10 @@ class ContinuousBatchingEngine:
             # holding it is safe.
             self._store_prefix(req.ids, cache1)
         first = self._sample(logits, req.temperature)
-        req.first_token_time = time.time()
+        req.first_token_time = time.monotonic()
+        _TTFT_HIST.observe(req.first_token_time - req.submit_time)
         req.tokens.append(first)
+        _TOKENS_TOTAL.inc()  # the first token lands here, not in _emit
         self._notify(req, first)
         req.next_pos = true_len
         cache = self._insert(self._cache, cache1,
@@ -893,15 +955,26 @@ class ContinuousBatchingEngine:
         import time
         req = slots[slot]
         slots[slot] = None
+        now = time.monotonic()
         stats = {
             'ttft_s': req.first_token_time - req.submit_time,
-            'total_s': time.time() - req.submit_time,
+            'total_s': now - req.submit_time,
             'new_tokens': len(req.tokens),
             'prompt_tokens': len(req.ids),
         }
         if not req.future.done():
             # done() here means the caller cancelled (shed a partially
-            # submitted batch) — the result has no reader.
+            # submitted batch) — the result has no reader, so it must
+            # not count as a delivered 'ok' either.
+            _REQ_OK.inc()
+            if len(req.tokens) > 1:
+                # Per-request mean inter-token latency: decode span
+                # over tokens after the first (chunked/speculative
+                # ticks emit several tokens per dispatch, so per-token
+                # deltas within a tick would read as ~0 and distort
+                # the histogram).
+                _TPOT_HIST.observe((now - req.first_token_time) /
+                                   (len(req.tokens) - 1))
             req.future.set_result((list(req.tokens), stats))
         self._notify(req, None)  # stream end (after the future resolves)
 
@@ -968,7 +1041,8 @@ class ContinuousBatchingEngine:
         # requests from the successor's queue.
         slots = self._slots
         queue = self._queue
-        now = time_lib.time()
+        now = time_lib.time()        # wall: deadlines are absolute epoch
+        mono_now = time_lib.monotonic()  # durations in error messages
         # Per-request deadlines: an expired (or caller-cancelled)
         # in-flight request frees its slot with a clean error instead
         # of burning decode steps.
@@ -985,7 +1059,7 @@ class ContinuousBatchingEngine:
                     req,
                     exceptions.RequestDeadlineExceededError(
                         f'request exceeded its deadline after '
-                        f'{now - req.submit_time:.1f}s '
+                        f'{mono_now - req.submit_time:.1f}s '
                         f'({len(req.tokens)} tokens generated)'))
         # Expired/cancelled entries must leave the QUEUE every tick
         # too, even when no slot frees for minutes — submit()'s
@@ -1009,7 +1083,7 @@ class ContinuousBatchingEngine:
                         req,
                         exceptions.RequestDeadlineExceededError(
                             f'request expired in the admission queue '
-                            f'after {now - req.submit_time:.1f}s'))
+                            f'after {mono_now - req.submit_time:.1f}s'))
         # Admit new requests into free slots (between ticks — this is
         # the "continuous" in continuous batching). Requests that
         # expired or were cancelled while queued are dropped, not
@@ -1028,7 +1102,7 @@ class ContinuousBatchingEngine:
                         req,
                         exceptions.RequestDeadlineExceededError(
                             f'request expired in the admission queue '
-                            f'after {now - req.submit_time:.1f}s'))
+                            f'after {mono_now - req.submit_time:.1f}s'))
                     continue
                 # Prefill of a fresh prompt bucket may JIT-compile:
                 # widen the watchdog allowance for the dispatch.
@@ -1057,6 +1131,10 @@ class ContinuousBatchingEngine:
             self._heartbeat = time_lib.monotonic()
         self._admitting_tick = False
         active = [i for i, r in enumerate(slots) if r is not None]
+        # Saturation signals, refreshed once per tick (cheap: gauge sets
+        # behind the enabled-check).
+        _ACTIVE_SLOTS.set(len(active))
+        _QUEUE_DEPTH.set(queue.qsize())
         if not active:
             self._wake.wait(timeout=0.05)
             self._wake.clear()
@@ -1136,6 +1214,9 @@ class ContinuousBatchingEngine:
                 req.next_pos += 1
                 token = int(out_cols[slot, c])
                 req.tokens.append(token)
+                # Per-token counter: with no exporter attached this is
+                # one boolean check, nothing more (acceptance-pinned).
+                _TOKENS_TOTAL.inc()
                 self._notify(req, token)
                 done = (len(req.tokens) >= req.max_new_tokens or
                         (req.eos_id is not None
@@ -1169,6 +1250,7 @@ class ContinuousBatchingEngine:
         instead of queueing — callers shed load at the edge."""
         import concurrent.futures
         if self._draining:
+            _REJECT_DRAINING.inc()
             raise exceptions.EngineDrainingError(
                 'engine is draining for shutdown; not accepting new '
                 'requests')
@@ -1179,6 +1261,7 @@ class ContinuousBatchingEngine:
             free = sum(1 for r in self._slots if r is None)
             backlog = self._queue.qsize() - free
             if backlog >= self.max_queue_depth:
+                _REJECT_OVERLOADED.inc()
                 raise exceptions.EngineOverloadedError(
                     f'engine admission queue is full ({backlog} '
                     f'queued beyond free capacity, cap '
@@ -1203,10 +1286,12 @@ class ContinuousBatchingEngine:
         # visible to drain's wait loop, or it is refused here.
         with self._thread_lock:
             if self._draining:
+                _REJECT_DRAINING.inc()
                 raise exceptions.EngineDrainingError(
                     'engine is draining for shutdown; not accepting '
                     'new requests')
             self._queue.put(req)
+        _QUEUE_DEPTH.set(self._queue.qsize())
         self._ensure_thread()
         self._wake.set()
         return future

@@ -1,0 +1,50 @@
+"""Observability: process-wide metrics registry + Prometheus exposition.
+
+The reference leans on external systems for visibility (Ray dashboard,
+cloud consoles — SURVEY §5); TPU-native there is none of that, so the
+framework carries its own metrics substrate:
+
+- ``metrics``: Counter / Gauge / Histogram with label support in a
+  process-wide registry. Recording is DISABLED by default and costs one
+  module-level boolean check per call (the same disarmed-check pattern
+  as utils/fault_injection) — the per-token decode path pays no locks
+  and no allocations until an exporter attaches.
+- ``exposition``: Prometheus text-format rendering (``generate_latest``)
+  and a small strict parser (``parse_prometheus_text``) used by
+  ``skytpu metrics`` and the round-trip tier-1 test.
+- A timeline bridge (``timeline_snapshot``) that lands registry
+  snapshots in the Chrome-trace timeline as 'C' counter events, so
+  spans and counters share one Perfetto view.
+
+Recording turns on when an exporter attaches (``/metrics`` route
+setup on the serve server / load balancer / dashboard calls
+``metrics.enable()``), programmatically, or via ``SKYTPU_METRICS=1``.
+Importing this package never starts threads, sockets, or exporters —
+pinned by tests/test_observability.py.
+
+Metric catalog and label conventions: docs/observability.md.
+"""
+from skypilot_tpu.observability.exposition import (generate_latest,
+                                                   parse_prometheus_text,
+                                                   timeline_snapshot)
+from skypilot_tpu.observability.metrics import (REGISTRY, Counter, Gauge,
+                                                Histogram, Registry,
+                                                counter, disable, enable,
+                                                enabled, gauge, histogram)
+
+__all__ = [
+    'REGISTRY',
+    'Counter',
+    'Gauge',
+    'Histogram',
+    'Registry',
+    'counter',
+    'disable',
+    'enable',
+    'enabled',
+    'gauge',
+    'histogram',
+    'generate_latest',
+    'parse_prometheus_text',
+    'timeline_snapshot',
+]

@@ -305,8 +305,8 @@ class SkyServeController:
 
     def wait_port_ready(self, timeout: float = 10.0) -> bool:
         import socket
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             with socket.socket() as sock:
                 sock.settimeout(0.5)
                 try:

@@ -366,8 +366,8 @@ def down(service_name: str, purge: bool = False) -> None:
         except (OSError, ProcessLookupError):
             pass
         # The runner tears down replicas then removes the service row.
-        deadline = time.time() + 120
-        while time.time() < deadline:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
             if serve_state.get_service(service_name) is None:
                 return
             time.sleep(0.2)

@@ -17,10 +17,31 @@ from typing import Callable, Dict, List, Optional, Set
 import aiohttp
 from aiohttp import web
 
+from skypilot_tpu.observability import exposition
+from skypilot_tpu.observability import metrics as obs
 from skypilot_tpu.serve import constants
 from skypilot_tpu.serve import load_balancing_policies as policies
 
 logger = logging.getLogger(__name__)
+
+# Load-balancer metrics (docs/observability.md).
+_LB_REQUESTS = obs.counter(
+    'skytpu_lb_requests_total',
+    'Requests proxied, by replica attempted', ('replica',))
+_LB_RETRIES = obs.counter(
+    'skytpu_lb_retries_total',
+    'Idempotent requests retried on another replica after an upstream '
+    'transport failure')
+_LB_NO_REPLICA = obs.counter(
+    'skytpu_lb_no_replica_total',
+    'Requests answered 502/503 with no (healthy) replica')
+_BREAKER_STATE = obs.gauge(
+    'skytpu_lb_breaker_open',
+    '1 while the replica circuit breaker is open/ejected, else 0',
+    ('replica',))
+_BREAKER_TRANSITIONS = obs.counter(
+    'skytpu_lb_breaker_transitions_total',
+    'Circuit-breaker state transitions', ('replica', 'transition'))
 
 _HOP_HEADERS = {
     'connection', 'keep-alive', 'proxy-authenticate',
@@ -68,11 +89,20 @@ class ReplicaCircuitBreaker:
 
     def record_success(self, url: str) -> None:
         with self._lock:
-            if self._state.pop(url, None) is not None:
+            st = self._state.pop(url, None)
+            was_open = st is not None and \
+                st['failures'] >= self.threshold
+            if st is not None:
                 logger.info('LB circuit breaker: replica %s healthy '
                             'again (closed)', url)
+        if st is not None:
+            _BREAKER_STATE.labels(replica=url).set(0)
+            if was_open:
+                _BREAKER_TRANSITIONS.labels(replica=url,
+                                            transition='closed').inc()
 
     def record_failure(self, url: str) -> None:
+        opened = False
         with self._lock:
             st = self._state.setdefault(
                 url, {'failures': 0, 'opened_at': 0.0,
@@ -83,10 +113,17 @@ class ReplicaCircuitBreaker:
                 # Newly ejected, or a failed half-open probe: (re)start
                 # the cooldown.
                 st['opened_at'] = self._clock()
+                opened = True
                 logger.warning(
                     'LB circuit breaker: ejecting replica %s after %d '
                     'consecutive errors (cooldown %.1fs)', url,
                     st['failures'], self.cooldown)
+        if opened:
+            # Every (re-)ejection counts: a flapping replica shows up
+            # as a climbing 'opened' rate, not a constant gauge.
+            _BREAKER_STATE.labels(replica=url).set(1)
+            _BREAKER_TRANSITIONS.labels(replica=url,
+                                        transition='opened').inc()
 
     def blocked(self, urls: List[str]) -> Set[str]:
         """Subset of `urls` that must not be selected right now. An
@@ -186,8 +223,17 @@ class SkyServeLoadBalancer:
                     json={'request_timestamps': timestamps},
                     timeout=aiohttp.ClientTimeout(total=5)) as resp:
                 data = await resp.json()
-                self.policy.set_ready_replicas(
-                    data.get('ready_replica_urls', []))
+                urls = data.get('ready_replica_urls', [])
+                self.policy.set_ready_replicas(urls)
+                # Torn-down replicas must not leak metric series (or
+                # advertise a stale open-breaker gauge) forever on a
+                # long-lived LB: drop per-replica children the
+                # controller no longer knows about.
+                known = set(urls)
+                for metric in (_LB_REQUESTS, _BREAKER_STATE,
+                               _BREAKER_TRANSITIONS):
+                    metric.prune(
+                        lambda labels: labels.get('replica') in known)
         except Exception as e:  # pylint: disable=broad-except
             # Keep serving with the last-known replica list; re-queue the
             # timestamps so the QPS signal is not lost.
@@ -229,6 +275,11 @@ class SkyServeLoadBalancer:
             replica_url = self.policy.select_replica(exclude=blocked)
             if replica_url is None:
                 break
+            _LB_REQUESTS.labels(replica=replica_url).inc()
+            if tried:
+                # Second (or later) attempt: this IS the
+                # retry-on-another-replica path.
+                _LB_RETRIES.inc()
             # If this replica is half-open, this request is the probe:
             # concurrent traffic keeps avoiding it until we report.
             self.breaker.claim_probe(replica_url)
@@ -260,8 +311,12 @@ class SkyServeLoadBalancer:
                 self.breaker.clear_probe(replica_url)
                 raise
         if last_err is not None:
+            # A replica existed and answered the wire with a transport
+            # error — NOT a no-replica condition; counting it here
+            # would make the pool look empty on every upstream blip.
             return web.Response(status=502,
                                 text=f'Upstream replica error: {last_err}')
+        _LB_NO_REPLICA.inc()
         if tried or self.policy.ready_replica_urls:
             # Replicas exist but every one is ejected/tried: shed load
             # with a hint instead of hammering known-bad backends.
@@ -319,8 +374,21 @@ class SkyServeLoadBalancer:
 
     # ---------------- lifecycle ----------------
 
+    async def _metrics(self, request: web.Request) -> web.Response:
+        """The LB's OWN Prometheus exposition (per-replica request
+        counts, breaker state/transitions, retry counts). Registered
+        before the catch-all proxy route, so `/metrics` is answered
+        here rather than forwarded to a replica — scrape replicas
+        directly for engine metrics."""
+        del request
+        return web.Response(text=exposition.generate_latest(),
+                            content_type='text/plain', charset='utf-8')
+
     def _make_app(self) -> web.Application:
+        # Exposing /metrics attaches an exporter: recording on.
+        obs.enable()
         app = web.Application()
+        app.router.add_get('/metrics', self._metrics)
         app.router.add_route('*', '/{path:.*}', self._proxy)
         return app
 

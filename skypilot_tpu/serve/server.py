@@ -6,6 +6,9 @@ models/inference.InferenceEngine in aiohttp.
 
 Endpoints:
   GET  /health              → 200 once the engine is warm
+  GET  /metrics             → Prometheus text exposition (engine
+                              TTFT/TPOT histograms, queue depth, shed
+                              counters — docs/observability.md)
   POST /generate            → {"prompt_ids": [[...]] | "prompt": "text",
                               "max_new_tokens": N, "temperature": T}
                               ⇒ {"token_ids": [[...]], "text": [...],
@@ -39,8 +42,51 @@ from typing import List, Optional
 from aiohttp import web
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.observability import exposition
+from skypilot_tpu.observability import metrics as obs
 
 logger = logging.getLogger(__name__)
+
+# Server metrics (docs/observability.md). Request latency/status are
+# recorded by a middleware so every route (including /metrics itself)
+# is covered without per-handler boilerplate.
+_REQ_LATENCY = obs.histogram(
+    'skytpu_server_request_seconds',
+    'HTTP request latency by route', ('route',))
+_REQ_TOTAL = obs.counter(
+    'skytpu_server_requests_total',
+    'HTTP requests by route and status', ('route', 'status'))
+_SHED_TOTAL = obs.counter(
+    'skytpu_server_shed_total',
+    'Requests shed with 429/503 + Retry-After', ('reason',))
+_DRAINING_GAUGE = obs.gauge(
+    'skytpu_server_draining',
+    '1 while the server drains for shutdown, else 0')
+
+
+@web.middleware
+async def _metrics_middleware(request: web.Request, handler):
+    """Times every request and counts (route, status) — including
+    exceptions mapped to HTTP errors by aiohttp."""
+    start = time.monotonic()
+    status = 500
+    try:
+        response = await handler(request)
+        status = response.status
+        return response
+    except web.HTTPException as e:
+        status = e.status
+        raise
+    finally:
+        resource = request.match_info.route.resource
+        # Unmatched requests (404s) share ONE bucket: using the raw
+        # path would let a scanner mint unbounded label cardinality in
+        # the process-wide registry.
+        route = (resource.canonical if resource is not None
+                 else 'unmatched')
+        _REQ_LATENCY.labels(route=route).observe(
+            time.monotonic() - start)
+        _REQ_TOTAL.labels(route=route, status=str(status)).inc()
 
 
 def byte_encode(text: str) -> List[int]:
@@ -153,9 +199,11 @@ class InferenceServer:
 
     @staticmethod
     def _unavailable(message: str, status: int = 503,
-                     retry_after: int = 1) -> web.Response:
+                     retry_after: int = 1,
+                     reason: str = 'overloaded') -> web.Response:
         """Load-shedding response: overload/drain return 429/503 WITH
         Retry-After instead of piling onto the batch queue."""
+        _SHED_TOTAL.labels(reason=reason).inc()
         return web.json_response({'error': message}, status=status,
                                  headers={'Retry-After':
                                           str(retry_after)})
@@ -163,7 +211,8 @@ class InferenceServer:
     def _check_admission(self) -> Optional[web.Response]:
         if self.draining:
             return self._unavailable(
-                'server is draining for shutdown', retry_after=5)
+                'server is draining for shutdown', retry_after=5,
+                reason='draining')
         return None
 
     def _batch_capacity_error(self, n_prompts: int) -> Optional[str]:
@@ -292,7 +341,8 @@ class InferenceServer:
         except exceptions.RequestDeadlineExceededError as e:
             return web.json_response({'error': str(e)}, status=504)
         except exceptions.EngineWedgedError as e:
-            return self._unavailable(str(e), retry_after=2)
+            return self._unavailable(str(e), retry_after=2,
+                                     reason='wedged')
         results = [out for out, _ in gathered]
         stats = [st for _, st in gathered]
         return web.json_response({
@@ -419,10 +469,20 @@ class InferenceServer:
         return out, st
 
     def warmup(self) -> None:
-        t0 = time.time()
+        t0 = time.monotonic()
         self._generate_one([1, 2, 3], 4, 0.0)
         self.ready = True
-        logger.info('engine warm in %.1fs', time.time() - t0)
+        logger.info('engine warm in %.1fs', time.monotonic() - t0)
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition of the process-wide registry:
+        engine TTFT/TPOT histograms, queue depth, shed counters, and
+        whatever else this process recorded (docs/observability.md)."""
+        del request
+        _DRAINING_GAUGE.set(1 if self.draining else 0)
+        return web.Response(text=exposition.generate_latest(),
+                            content_type='text/plain',
+                            charset='utf-8')
 
     # -- OpenAI-compatible surface --
     #
@@ -452,11 +512,17 @@ class InferenceServer:
 
     @staticmethod
     def _openai_error(message: str, status: int = 400,
-                      retry_after: Optional[int] = None) -> web.Response:
+                      retry_after: Optional[int] = None,
+                      shed_reason: Optional[str] = None) -> web.Response:
+        """`shed_reason` (overloaded/draining/wedged) feeds the same
+        shed counter as /generate — passed explicitly by the call site
+        that caught the exception, never inferred from message text."""
         err_type = ('invalid_request_error' if status == 400 else
                     'server_error')
         headers = ({'Retry-After': str(retry_after)}
                    if retry_after is not None else None)
+        if shed_reason is not None:
+            _SHED_TOTAL.labels(reason=shed_reason).inc()
         return web.json_response(
             {'error': {'message': message, 'type': err_type}},
             status=status, headers=headers)
@@ -501,7 +567,8 @@ class InferenceServer:
                                     request: web.Request) -> web.Response:
         if self.draining:
             return self._openai_error('server is draining for shutdown',
-                                      status=503, retry_after=5)
+                                      status=503, retry_after=5,
+                                      shed_reason='draining')
         try:
             data = await request.json()
         except Exception:  # pylint: disable=broad-except
@@ -544,14 +611,16 @@ class InferenceServer:
             # cancel the already-submitted head of the batch so shed
             # work does not keep consuming queue depth.
             self._cancel_all(futures)
-            return self._openai_error(str(e), status=429, retry_after=1)
+            return self._openai_error(str(e), status=429, retry_after=1,
+                                      shed_reason='overloaded')
         try:
             gathered = await asyncio.gather(
                 *[asyncio.wrap_future(f) for f in futures])
         except exceptions.RequestDeadlineExceededError as e:
             return self._openai_error(str(e), status=504)
         except exceptions.EngineWedgedError as e:
-            return self._openai_error(str(e), status=503, retry_after=2)
+            return self._openai_error(str(e), status=503, retry_after=2,
+                                      shed_reason='wedged')
         choices = []
         completion_tokens = 0
         for i, (out, _st) in enumerate(gathered):
@@ -658,7 +727,8 @@ class InferenceServer:
     async def handle_v1_chat(self, request: web.Request) -> web.Response:
         if self.draining:
             return self._openai_error('server is draining for shutdown',
-                                      status=503, retry_after=5)
+                                      status=503, retry_after=5,
+                                      shed_reason='draining')
         try:
             data = await request.json()
         except Exception:  # pylint: disable=broad-except
@@ -696,13 +766,15 @@ class InferenceServer:
         except (TypeError, ValueError, AttributeError) as e:
             return self._openai_error(str(e))
         except exceptions.EngineOverloadedError as e:
-            return self._openai_error(str(e), status=429, retry_after=1)
+            return self._openai_error(str(e), status=429, retry_after=1,
+                                      shed_reason='overloaded')
         try:
             out, _st = await asyncio.wrap_future(future)
         except exceptions.RequestDeadlineExceededError as e:
             return self._openai_error(str(e), status=504)
         except exceptions.EngineWedgedError as e:
-            return self._openai_error(str(e), status=503, retry_after=2)
+            return self._openai_error(str(e), status=503, retry_after=2,
+                                      shed_reason='wedged')
         text, finish = self._truncate_at_stop(self.decode(out),
                                               data.get('stop'))
         prompt_tokens, completion_tokens = len(ids), len(out)
@@ -729,8 +801,13 @@ class InferenceServer:
         })
 
     def make_app(self) -> web.Application:
-        app = web.Application()
+        # Serving a /metrics route IS attaching an exporter: recording
+        # flips on here, never at import (tests pin the import path
+        # side-effect-free).
+        obs.enable()
+        app = web.Application(middlewares=[_metrics_middleware])
         app.router.add_get('/health', self.handle_health)
+        app.router.add_get('/metrics', self.handle_metrics)
         app.router.add_post('/generate', self.handle_generate)
         app.router.add_post('/v1/completions', self.handle_v1_completions)
         app.router.add_post('/v1/chat/completions', self.handle_v1_chat)
@@ -864,6 +941,7 @@ def main(argv=None) -> int:
         if server.draining:
             return
         server.draining = True
+        _DRAINING_GAUGE.set(1)
         threading.Thread(target=_drain_and_exit, daemon=True,
                          name='drain').start()
 

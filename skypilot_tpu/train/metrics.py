@@ -9,12 +9,26 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import jax
 
 from skypilot_tpu import topology
 from skypilot_tpu.models.configs import ModelConfig
+from skypilot_tpu.observability import metrics as obs
+
+# Published into the process-wide registry so bench.py / dashboards
+# scrape the numbers instead of re-deriving them from raw step times.
+_STEP_SECONDS = obs.gauge(
+    'skytpu_train_step_seconds', 'Last measured training step time')
+_TOKENS_PER_SEC = obs.gauge(
+    'skytpu_train_tokens_per_sec',
+    'Training throughput over all chips (last published measurement)')
+_MFU = obs.gauge(
+    'skytpu_train_mfu',
+    'Model FLOPs utilization in [0, 1] (last published measurement)')
+_STEPS_TIMED = obs.counter(
+    'skytpu_train_steps_timed_total', 'Steps timed past warmup')
 
 
 def detect_chip_peak_tflops() -> float:
@@ -50,6 +64,8 @@ class StepTimer:
         self._count += 1
         if self._count > self.warmup_steps:
             self.times.append(dt)
+            _STEP_SECONDS.set(dt)
+            _STEPS_TIMED.inc()
 
     def mean_step_time(self) -> float:
         assert self.times, 'no timed steps (all warmup?)'
@@ -70,3 +86,16 @@ def mfu(cfg: ModelConfig, batch_size: int, seq_len: int, step_time_s: float,
                 step_time_s)
     peak = peak_tflops_per_chip * 1e12 * num_chips
     return achieved / peak
+
+
+def publish_throughput(cfg: ModelConfig, batch_size: int, seq_len: int,
+                       step_time_s: float, num_chips: int
+                       ) -> Tuple[float, float]:
+    """Compute (tokens/sec over all chips, MFU) and publish both into
+    the registry — the one call sites (bench.py, trainers) use so the
+    derived numbers and the scraped numbers can never disagree."""
+    tps = tokens_per_sec(batch_size, seq_len, step_time_s)
+    utilization = mfu(cfg, batch_size, seq_len, step_time_s, num_chips)
+    _TOKENS_PER_SEC.set(tps)
+    _MFU.set(utilization)
+    return tps, utilization

@@ -67,7 +67,7 @@ def entrypoint(fn: Callable) -> Callable:
 
     @functools.wraps(fn)
     def wrapper(*args: Any, **kwargs: Any) -> Any:
-        start = time.time()
+        start = time.monotonic()  # duration, not a timestamp
         outcome = 'success'
         exception_name = None
         try:
@@ -83,7 +83,7 @@ def entrypoint(fn: Callable) -> Callable:
                 'entrypoint': fn.__qualname__,
                 'outcome': outcome,
                 'exception': exception_name,
-                'runtime_seconds': round(time.time() - start, 3),
+                'runtime_seconds': round(time.monotonic() - start, 3),
                 'user_hash': common_utils.get_user_hash(),
                 'ts': time.time(),
             })

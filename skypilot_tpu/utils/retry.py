@@ -31,6 +31,24 @@ logger = logging.getLogger(__name__)
 # client escalates to a force-refreshed cloud-truth probe.
 RPC_FAILURES_BEFORE_PROBE = 3
 
+# Retry-ladder metrics (docs/observability.md): how often the process
+# is riding the backoff path, and how the escalation ladder resolves.
+from skypilot_tpu.observability import metrics as _obs  # noqa: E402
+
+_RETRY_ATTEMPTS = _obs.counter(
+    'skytpu_retry_attempts_total',
+    'Retries taken after a transient failure (first attempts are not '
+    'counted)')
+_RETRY_BACKOFF_SECONDS = _obs.counter(
+    'skytpu_retry_backoff_seconds_total',
+    'Cumulative backoff sleep scheduled between retries')
+_RETRY_EXHAUSTED = _obs.counter(
+    'skytpu_retry_exhausted_total',
+    'call_with_retry gave up (attempts or deadline exhausted)')
+_RPC_ESCALATIONS = _obs.counter(
+    'skytpu_rpc_escalations_total',
+    'record_rpc_failure_and_probe verdicts', ('verdict',))
+
 
 class Backoff:
     """Jittered exponential backoff: delay_k = min(cap, base * factor^k),
@@ -89,11 +107,15 @@ def call_with_retry(fn: Callable[[], Any], *,
             if retry_if is not None and not retry_if(e):
                 raise
             if attempt + 1 >= attempts:
+                _RETRY_EXHAUSTED.inc()
                 raise
             delay = backoff.next_delay()
             if deadline is not None and \
                     clock() - start + delay >= deadline:
+                _RETRY_EXHAUSTED.inc()
                 raise  # the next attempt would start past the deadline
+            _RETRY_ATTEMPTS.inc()
+            _RETRY_BACKOFF_SECONDS.inc(delay)
             logger.debug('retry %d/%d after %.2fs: %s', attempt + 1,
                          attempts, delay, e)
             sleep(delay)
@@ -153,6 +175,7 @@ def record_rpc_failure_and_probe(
     """
     fails = rpc_failure_tracker.record_failure(cluster_name)
     if fails < threshold:
+        _RPC_ESCALATIONS.labels(verdict='transient').inc()
         return 'transient', fails
     from skypilot_tpu.backends import backend_utils
     from skypilot_tpu.status_lib import ClusterStatus
@@ -164,10 +187,13 @@ def record_rpc_failure_and_probe(
             'Cloud probe of controller cluster %s inconclusive (%s) '
             'after %d RPC failures; keeping last-known state.',
             cluster_name, probe_err, fails)
+        _RPC_ESCALATIONS.labels(verdict='inconclusive').inc()
         return 'inconclusive', fails
     if status == ClusterStatus.UP:
+        _RPC_ESCALATIONS.labels(verdict='up').inc()
         return 'up', fails
     rpc_failure_tracker.reset(cluster_name)
+    _RPC_ESCALATIONS.labels(verdict='gone').inc()
     return 'gone', fails
 
 

@@ -29,17 +29,34 @@ def _is_enabled() -> bool:
     return _enabled
 
 
-def _record(name: str, phase: str) -> None:
+def _record(name: str, phase: str, args: Optional[dict] = None) -> None:
+    # ts must be a NUMERIC microsecond value: the reference emitted it
+    # as a string with a leading space (f'{...: .3f}'), which Perfetto /
+    # chrome://tracing parse unreliably (sorting and counter tracks
+    # silently break).
     event = {
         'name': name,
         'cat': 'default',
         'ph': phase,
-        'ts': f'{time.time() * 10 ** 6: .3f}',
-        'pid': str(os.getpid()),
-        'tid': str(threading.get_ident()),
+        'ts': round(time.time() * 10 ** 6, 3),
+        'pid': os.getpid(),
+        'tid': threading.get_ident(),
     }
+    if args is not None:
+        event['args'] = args
     with _lock:
         _events.append(event)
+
+
+def counter_event(name: str, values: dict) -> bool:
+    """Record a 'C' (counter) trace event — numeric series rendered by
+    Perfetto as stacked counter tracks alongside the B/E spans. Used by
+    the observability bridge to land metric snapshots in the same
+    trace. Returns False (no-op) when tracing is disabled."""
+    if not _is_enabled():
+        return False
+    _record(name, 'C', args=values)
+    return True
 
 
 class Event:
@@ -107,6 +124,14 @@ class FileLockEvent:
 
 
 def save_timeline() -> None:
+    # Final metrics snapshot first, so counters and spans land in one
+    # Perfetto view (lazy + guarded: tracing must not die on an
+    # observability import problem, and utils stays import-light).
+    try:
+        from skypilot_tpu.observability import exposition
+        exposition.timeline_snapshot()
+    except Exception:  # pylint: disable=broad-except
+        pass
     if not _events:
         return
     path = os.environ.get(
