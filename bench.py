@@ -46,7 +46,21 @@ _TIMEOUT_S = float(os.environ.get('SKYTPU_BENCH_TIMEOUT', '1200'))
 _BACKOFF_S = float(os.environ.get('SKYTPU_BENCH_BACKOFF', '15'))
 _PROBE_TIMEOUT_S = float(os.environ.get('SKYTPU_BENCH_PROBE_TIMEOUT',
                                         '150'))
+# Retry probes DECAY: only the first probe gets the full allowance (a
+# legitimately slow backend bring-up); a tunnel that answered nothing
+# in 150s is dead, and burning 150s twice more just delays the verdict
+# (r5: 3 x 150s sequential probes on a dead tunnel).
+_PROBE_DECAY = float(os.environ.get('SKYTPU_BENCH_PROBE_DECAY', '0.33'))
+_PROBE_FLOOR_S = 15.0
 _PARTIAL_ENV = 'SKYTPU_BENCH_PARTIAL'
+
+
+def _emit_skip(reason: str, **extra) -> None:
+    """The bench contract is ONE machine-parseable JSON line on stdout.
+    A dead tunnel/failed run must honor it too — {"skipped": true, ...}
+    — so the bench trajectory records a structured skip instead of
+    `parsed: null` (r5: rc=3 with nothing to parse)."""
+    print(json.dumps({'skipped': True, 'reason': reason, **extra}))
 
 
 def _parse_args(argv=None):
@@ -79,6 +93,11 @@ def _parse_args(argv=None):
                         help='serve row: LRU of N prefilled prompts; '
                              'shared-prefix requests prefill only the '
                              'suffix')
+    parser.add_argument('--paged-block-size', type=int, default=0,
+                        help='serve row: paged KV cache with N-token '
+                             'blocks (block-granular prefix sharing + '
+                             'chunked prefill); the row reports pool '
+                             'occupancy')
     parser.add_argument('--tune-attn', action='store_true',
                         help='sweep flash-attention block sizes per '
                              'sequence length (fwd+bwd wall time) and '
@@ -161,23 +180,29 @@ def _supervise(argv) -> int:
     # (r3's outage burned the driver's outer timeout → rc=124; exiting
     # here keeps the failure cheap and the diagnostics crisp).
     platform = ''
+    probes_s = []
     for probe in range(1, _ATTEMPTS + 1):
+        timeout = max(min(_PROBE_FLOOR_S, _PROBE_TIMEOUT_S),
+                      _PROBE_TIMEOUT_S * _PROBE_DECAY ** (probe - 1))
         t0 = time.time()
-        platform = _probe_device(_PROBE_TIMEOUT_S)
+        platform = _probe_device(timeout)
+        probes_s.append(round(time.time() - t0, 1))
         if platform:
             print(f'[bench] preflight: platform={platform} '
                   f'({time.time() - t0:.0f}s)', file=sys.stderr)
             break
         print(f'[bench] preflight probe {probe}/{_ATTEMPTS}: device '
-              f'unreachable after {time.time() - t0:.0f}s',
-              file=sys.stderr)
+              f'unreachable after {time.time() - t0:.0f}s '
+              f'(timeout {timeout:.0f}s)', file=sys.stderr)
         if probe < _ATTEMPTS:
-            time.sleep(_BACKOFF_S * probe)
+            time.sleep(_BACKOFF_S)
     if not platform:
         print('[bench] device unreachable: the TPU tunnel/device did not '
               'answer any preflight probe. Check the chip is attached '
               '(PALLAS_AXON_POOL_IPS for axon tunnels), no other process '
               'holds it, and retry.', file=sys.stderr)
+        _emit_skip('device unreachable (preflight)',
+                   probes=len(probes_s), probe_seconds=probes_s)
         return 3
 
     partial_path = os.path.join(
@@ -249,6 +274,7 @@ def _attempt_loop(cmd, env, partial_path) -> int:
           'UNAVAILABLE, the TPU tunnel/device is unreachable: check that '
           'the chip is attached (PALLAS_AXON_POOL_IPS for axon tunnels), '
           'no other process holds it, and retry.', file=sys.stderr)
+    _emit_skip(f'all {_ATTEMPTS} worker attempts failed: {last_note}')
     return 1
 
 
@@ -264,7 +290,8 @@ def _append_partial(row: dict) -> None:
 
 
 def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
-                  kv_quant=None, speculative=0, prefix_cache=0) -> dict:
+                  kv_quant=None, speculative=0, prefix_cache=0,
+                  paged_block_size=0) -> dict:
     """p50/p99 time-to-first-token + aggregate decode throughput under
     concurrent requests on the local chip(s) via the continuous-batching
     engine (models/inference.py) — the BASELINE.md serving row."""
@@ -274,14 +301,22 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
     engine = inference_lib.ContinuousBatchingEngine(
         cfg, num_slots=4, mesh=mesh, quantize=quantize,
         decode_chunk=decode_chunk, kv_quant=kv_quant,
-        speculative=speculative, prefix_cache=prefix_cache)
+        speculative=speculative, prefix_cache=prefix_cache,
+        paged_block_size=paged_block_size)
     prompt = list(range(1, 33))
     # Warmup: compile prefill + decode (and the verify step, if on).
     engine.generate(prompt, max_new_tokens=4)
+    if paged_block_size and prefix_cache:
+        # Second warmup HITS the prefix the first one stored, compiling
+        # the copy-on-write clone too — otherwise the first measured
+        # request pays that jit and pollutes the p99 TTFT this row
+        # exists to benchmark.
+        engine.generate(prompt, max_new_tokens=4)
     t0 = time_lib.time()
     stats = engine.measure_ttft(num_requests=16, prompt=prompt,
                                 max_new_tokens=16, return_stats=True)
     wall = time_lib.time() - t0
+    occupancy = engine.paged_occupancy()
     engine.stop()
     ttfts = sorted(st['ttft_s'] for st in stats)
     total_new = sum(st['new_tokens'] for st in stats)
@@ -315,6 +350,18 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
             max(1, engine.prefix_stats['hits'] +
                 engine.prefix_stats['misses']), 3)
         row['prefix_tokens_reused'] = engine.prefix_stats['tokens_reused']
+    if occupancy:
+        # Pool accounting: peak blocks touched vs capacity — the HBM
+        # the paged layout actually used (vs slots x max_seq_len).
+        row['paged_block_size'] = occupancy['block_size']
+        row['paged_blocks_capacity'] = occupancy['blocks_capacity']
+        row['paged_peak_blocks_used'] = occupancy['peak_blocks_used']
+        row['paged_pool_occupancy'] = round(
+            occupancy['peak_blocks_used'] /
+            max(1, occupancy['blocks_capacity']), 3)
+        row['paged_blocks_reused'] = occupancy['blocks_reused']
+        row['paged_cow_copies'] = occupancy['cow_copies']
+        row['paged_prefill_chunks'] = occupancy['prefill_chunks']
     return row
 
 
@@ -470,7 +517,8 @@ def _worker(args) -> int:
                              decode_chunk=args.decode_chunk,
                              kv_quant=args.kv_quant,
                              speculative=args.speculative,
-                             prefix_cache=args.prefix_cache)
+                             prefix_cache=args.prefix_cache,
+                             paged_block_size=args.paged_block_size)
         print(f'serve: {ttft}', file=sys.stderr)
         tags = [t for t in (args.quantize,
                             f'kv-{args.kv_quant}' if args.kv_quant
@@ -478,7 +526,9 @@ def _worker(args) -> int:
                             f'spec-{args.speculative}'
                             if args.speculative else None,
                             f'pfx-{args.prefix_cache}'
-                            if args.prefix_cache else None) if t]
+                            if args.prefix_cache else None,
+                            f'paged-{args.paged_block_size}'
+                            if args.paged_block_size else None) if t]
         result = {
             'metric': f'{serve_cfg.name} serve p50 TTFT'
                       + (f' ({"+".join(tags)})' if tags else ''),
@@ -490,6 +540,7 @@ def _worker(args) -> int:
             'kv_quant': args.kv_quant or 'none',
             'speculative': args.speculative,
             'prefix_cache': args.prefix_cache,
+            'paged_block_size': args.paged_block_size,
             **ttft,
         }
         print(json.dumps(result))

@@ -138,6 +138,16 @@ class ModelConfig:
     # matmuls read int8 directly (XLA fuses the convert) and the scales
     # are applied outside the contracted dim (JetStream-style).
     kv_cache_quant: str = ''
+    # Paged KV cache (decode only; vLLM-style). >0 ⇒ Attention stores
+    # K/V in a shared pool of `paged_num_blocks` fixed-size blocks of
+    # `paged_block_size` tokens instead of one (batch, max_seq_len)
+    # window per row; callers pass per-row block tables (logical block →
+    # physical block id) and attention gathers through them. Block 0 is
+    # the engine's scratch block (pad/inactive-row writes land there).
+    # HBM then scales with TOKENS HELD, not slots × max_seq_len — see
+    # docs/performance.md. 0 ⇒ the contiguous reference layout.
+    paged_block_size: int = 0
+    paged_num_blocks: int = 0
 
     @property
     def head_dim(self) -> int:

@@ -12,6 +12,7 @@ and the TTFT benchmark.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import logging
@@ -22,6 +23,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.models import kv_cache as kv_cache_lib
 from skypilot_tpu.models.configs import ModelConfig, get_config
 from skypilot_tpu.models.transformer import Transformer
 from skypilot_tpu.observability import metrics as obs
@@ -76,6 +78,36 @@ _SPEC_ACCEPTED = obs.counter(
 _WEDGE_RECOVERIES = obs.counter(
     'skytpu_engine_wedge_recoveries_total',
     'Watchdog recoveries (engine thread wedged or died)')
+_PAGED_CAPACITY = obs.gauge(
+    'skytpu_engine_paged_blocks_capacity',
+    'Paged KV pool size in blocks (incl. the scratch block)')
+_PAGED_USED = obs.gauge(
+    'skytpu_engine_paged_blocks_used',
+    'Paged KV pool blocks currently referenced')
+_PAGED_REUSED = obs.counter(
+    'skytpu_engine_paged_blocks_reused_total',
+    'Whole blocks attached read-only from cached prefixes at admission')
+_PAGED_COW = obs.counter(
+    'skytpu_engine_paged_cow_copies_total',
+    'Copy-on-write block copies (partial prefix block made private)')
+_CHUNKED_PREFILL = obs.counter(
+    'skytpu_engine_chunked_prefill_ticks_total',
+    'Prefill chunks processed (interleaved between decode ticks)')
+
+# step_log cap: enough history for any interleaving assertion while
+# bounding a serve replica that decodes for weeks (the old unbounded
+# list grew one tuple per tick forever — a slow leak).
+_STEP_LOG_CAP = 4096
+
+
+class _StepLog(collections.deque):
+    """Capped deque that still supports the list-style slicing the
+    interleaving tests (and debuggers) use: log[marker:]."""
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self)[idx]
+        return super().__getitem__(idx)
 
 
 class _StaleEngineError(Exception):
@@ -370,7 +402,8 @@ class _Request:
 
     __slots__ = ('ids', 'max_new_tokens', 'temperature', 'eos_id',
                  'future', 'submit_time', 'first_token_time', 'tokens',
-                 'next_pos', 'on_token', 'deadline')
+                 'next_pos', 'on_token', 'deadline', 'blocks',
+                 'prefilling', 'prefill_pos')
 
     def __init__(self, ids, max_new_tokens, temperature, eos_id, future,
                  on_token=None, deadline=None):
@@ -394,6 +427,13 @@ class _Request:
         # Checked at admission and per tick — an expired request fails
         # with RequestDeadlineExceededError instead of occupying a slot.
         self.deadline = deadline
+        # Paged-KV bookkeeping (unused on the contiguous path): the
+        # physical block ids this request's table maps, whether it is
+        # still mid-chunked-prefill, and how many prompt tokens have
+        # been prefilled so far.
+        self.blocks: list = []
+        self.prefilling = False
+        self.prefill_pos = 0
 
 
 class ContinuousBatchingEngine:
@@ -426,7 +466,10 @@ class ContinuousBatchingEngine:
                  speculative: int = 0,
                  prefix_cache: int = 0,
                  max_queue_depth: int = 0,
-                 watchdog_timeout: Optional[float] = None) -> None:
+                 watchdog_timeout: Optional[float] = None,
+                 paged_block_size: int = 0,
+                 paged_num_blocks: Optional[int] = None,
+                 prefill_chunk: int = 0) -> None:
         import queue as queue_lib
         import threading
         import time as time_lib
@@ -448,15 +491,63 @@ class ContinuousBatchingEngine:
         # one token per tick. Takes precedence over decode_chunk.
         self.speculative = max(0, speculative)
         self.spec_stats = {'ticks': 0, 'drafted': 0, 'accepted': 0}
-        # >0 ⇒ keep the last N prompts' prefilled KV (batch-1 caches) in
-        # an LRU; a new prompt sharing a cached PREFIX prefills only the
-        # suffix (chat turns append to history; shared system prompts).
-        # Each entry holds a full-capacity batch-1 cache in device
-        # memory — size N to the HBM you can spare.
+        # >0 ⇒ keep the last N prompts' prefilled KV in an LRU; a new
+        # prompt sharing a cached PREFIX prefills only the suffix (chat
+        # turns append to history; shared system prompts). Contiguous
+        # mode: each entry holds a full-capacity batch-1 cache in device
+        # memory — size N to the HBM you can spare. Paged mode: an entry
+        # is a list of ref-counted shared blocks, ceil(L/block_size)
+        # blocks for a length-L prefix — N can be much larger for the
+        # same HBM (docs/performance.md has the sizing math).
         self.prefix_cache = max(0, prefix_cache)
-        from collections import OrderedDict
-        self._prefix_entries: 'OrderedDict[tuple, Any]' = OrderedDict()
         self.prefix_stats = {'hits': 0, 'misses': 0, 'tokens_reused': 0}
+        # -------- paged KV cache (docs/performance.md) --------
+        # Opt-in via paged_block_size=N: KV lives in a shared pool of
+        # fixed-size blocks (kv_cache.BlockPool) indexed through
+        # per-slot block tables, prefixes share blocks read-only with
+        # copy-on-write at the partial-block boundary, and prefill runs
+        # in fixed-size chunks interleaved between decode ticks (ONE
+        # compiled prefill shape instead of one per prompt bucket; a
+        # long prompt no longer stalls in-flight slots' TPOT).
+        self.paged_block_size = max(0, paged_block_size)
+        if self.paged_block_size:
+            if self.cfg.max_seq_len % self.paged_block_size:
+                raise ValueError(
+                    f'max_seq_len {self.cfg.max_seq_len} not divisible '
+                    f'by paged_block_size {self.paged_block_size}')
+            if self.speculative:
+                raise ValueError('paged KV cache + speculative decoding '
+                                 'is not wired; pick one')
+            if self.cfg.kv_cache_quant:
+                raise ValueError('paged KV cache + int8 KV quantization '
+                                 'is not wired; pick one')
+            self._blocks_per_seq = (self.cfg.max_seq_len //
+                                    self.paged_block_size)
+            # Default pool: every slot can reach max_seq_len plus full
+            # headroom for the prefix LRU, plus the scratch block. Size
+            # explicitly (paged_num_blocks) to fit real HBM budgets.
+            nb = paged_num_blocks or (
+                (num_slots + self.prefix_cache) * self._blocks_per_seq
+                + 1)
+            self.cfg = dataclasses.replace(
+                self.cfg, paged_block_size=self.paged_block_size,
+                paged_num_blocks=nb)
+            self._pool: 'Optional[kv_cache_lib.BlockPool]' = \
+                kv_cache_lib.BlockPool(nb, self.paged_block_size)
+            self.prefill_chunk = max(1, prefill_chunk or
+                                     self.paged_block_size)
+            _PAGED_CAPACITY.set(nb)
+        else:
+            self._blocks_per_seq = 0
+            self._pool = None
+            self.prefill_chunk = 0
+        self.paged_stats = {'cow_copies': 0, 'blocks_reused': 0,
+                            'prefill_chunks': 0, 'prefix_evictions': 0}
+        # Decode-tick block-table cache (see _tick): rebuilt only when
+        # the per-slot fingerprint changes.
+        self._table_sig: Optional[tuple] = None
+        self._table_cache = None
+        self._prefix_entries = self._new_prefix_index()
         self.model = Transformer(self.cfg)
         self._rng = jax.random.PRNGKey(rng_seed)
 
@@ -469,6 +560,10 @@ class ContinuousBatchingEngine:
         self._decode_multi = jax.jit(self._decode_multi_impl,
                                      donate_argnames=('cache',))
         self._verify = jax.jit(self._verify_impl,
+                               donate_argnames=('cache',))
+        self._prefill_chunk_fn = jax.jit(self._prefill_chunk_impl,
+                                         donate_argnames=('cache',))
+        self._cow_fn = jax.jit(self._cow_copy_impl,
                                donate_argnames=('cache',))
 
         self._queue: 'queue_lib.Queue[_Request]' = queue_lib.Queue()
@@ -503,8 +598,10 @@ class ContinuousBatchingEngine:
         self._warm_tick = False
         self._admitting_tick = False
         # (decode_step, frozenset(active slot ids)) history — lets tests
-        # assert that requests really interleaved.
-        self.step_log: list = []
+        # assert that requests really interleaved. Chunked-prefill work
+        # logs as ('prefill', frozenset({slot})). CAPPED: a serve
+        # replica ticks for weeks; an unbounded list is a slow leak.
+        self.step_log = _StepLog(maxlen=_STEP_LOG_CAP)
         self._decode_steps = 0
 
     # ---------------- jitted pieces ----------------
@@ -525,6 +622,33 @@ class ContinuousBatchingEngine:
         return nn.unbox(
             jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes,
                          is_leaf=lambda x: hasattr(x, 'shape')))
+
+    def _init_paged_cache(self) -> Any:
+        """Zeroed BLOCK POOL — batch-free (num_blocks, block, kv_heads,
+        head_dim) leaves shared by prefill (batch 1) and decode
+        (batch num_slots) dispatches alike."""
+        width = self._blocks_per_seq + 1
+        shapes = jax.eval_shape(
+            lambda: self.model.init(
+                jax.random.PRNGKey(0), jnp.ones((1, 1), jnp.int32),
+                jnp.zeros((1, 1), jnp.int32),
+                block_tables=jnp.zeros((1, width), jnp.int32))['cache'])
+        return nn.unbox(
+            jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes,
+                         is_leaf=lambda x: hasattr(x, 'shape')))
+
+    def _init_cache_for_mode(self) -> Any:
+        return (self._init_paged_cache() if self.paged_block_size
+                else self._init_slot_cache())
+
+    def _new_prefix_index(self) -> 'kv_cache_lib.PrefixIndex':
+        """Prefix LRU keyed by hashable tuple chunks (satellite: lookup
+        is O(prompt/chunk) dict probes, not O(entries × prompt) list
+        re-comparison). Paged mode chunks at block granularity so a hit
+        maps directly onto whole shareable blocks."""
+        chunk = self.paged_block_size or self._MIN_PREFIX
+        return kv_cache_lib.PrefixIndex(
+            capacity=max(1, self.prefix_cache), chunk=chunk)
 
     def _prefill_impl(self, params, tokens, true_len):
         """tokens: (1, bucket) right-padded; returns (logits at token
@@ -579,14 +703,16 @@ class ContinuousBatchingEngine:
 
         return jax.tree.map(ins, cache, cache1)
 
-    def _decode_impl(self, params, cache, tokens, positions, temps, rng):
+    def _decode_impl(self, params, cache, tokens, positions, temps, rng,
+                     tables=None):
         """One all-slots decode tick WITH in-jit sampling (one host sync
         per tick instead of one per slot — the difference between ~ms and
         ~100ms ticks over a remote-chip tunnel). tokens/positions:
-        (num_slots, 1); temps: (num_slots,) — <=0 means greedy."""
+        (num_slots, 1); temps: (num_slots,) — <=0 means greedy. `tables`
+        (paged mode only): per-row block tables for the shared pool."""
         logits, mutated = self.model.apply(
             {'params': params, 'cache': cache}, tokens, positions,
-            mutable=['cache'])
+            block_tables=tables, mutable=['cache'])
         last = logits[:, -1, :].astype(jnp.float32)
         greedy = jnp.argmax(last, axis=-1)
         scaled = apply_logit_filters(
@@ -597,20 +723,62 @@ class ContinuousBatchingEngine:
         return out, nn.unbox(mutated['cache'])
 
     def _decode_multi_impl(self, params, cache, tokens, positions, temps,
-                           rngs):
+                           rngs, tables=None):
         """K all-slots decode steps in one dispatch (K = rngs' leading
         dim): returns ((num_slots, K) tokens, cache). tokens/positions:
-        (num_slots,)."""
+        (num_slots,). Paged mode: the engine pre-allocates blocks to
+        cover all K positions, so `tables` stays fixed across the
+        scan."""
 
         def body(carry, rng):
             cache, toks, pos = carry
             out, cache = self._decode_impl(params, cache, toks[:, None],
-                                           pos[:, None], temps, rng)
+                                           pos[:, None], temps, rng,
+                                           tables)
             return (cache, out, pos + 1), out
 
         (cache, _, _), toks = jax.lax.scan(
             body, (cache, tokens, positions), rngs)
         return toks.swapaxes(0, 1), cache
+
+    def _prefill_chunk_impl(self, params, cache, tokens, tables, start,
+                            true_n):
+        """One chunked-prefill step on the PAGED pool: process the
+        (1, prefill_chunk) right-padded chunk at positions
+        [start, start+chunk) through the slot's block table. The chunk
+        shape is FIXED, so exactly one prefill program compiles per
+        engine — vs one per power-of-two prompt bucket on the contiguous
+        path (pinned by tests/test_paged_cache.py). Returns (logits at
+        chunk token true_n-1 — only meaningful on the final chunk — and
+        the updated pool). Pad-token writes land in private blocks that
+        later real writes overwrite, or clip into the table's scratch
+        column (same stale-entry masking argument as _prefill_impl)."""
+        positions = start + jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :],
+            tokens.shape)
+        logits, mutated = self.model.apply(
+            {'params': params, 'cache': cache}, tokens, positions,
+            block_tables=tables, mutable=['cache'])
+        last = jax.lax.dynamic_index_in_dim(logits, true_n - 1, axis=1,
+                                            keepdims=False)
+        return last[0], nn.unbox(mutated['cache'])
+
+    def _cow_copy_impl(self, cache, src, dst):
+        """Copy-on-write: clone physical block `src` into `dst` across
+        every pool leaf. Used at admission when a request extends a
+        cached prefix whose last block is PARTIAL: the shared block
+        stays read-only for everyone else; this request appends into its
+        private copy. Pool leaves are (*, num_blocks, block, kv_heads,
+        head_dim) with an optional leading scanned-layers axis, so the
+        block axis is always ndim-4."""
+
+        def cp(arr):
+            axis = arr.ndim - 4
+            blk = jax.lax.dynamic_slice_in_dim(arr, src, 1, axis=axis)
+            return jax.lax.dynamic_update_slice_in_dim(arr, blk, dst,
+                                                       axis=axis)
+
+        return jax.tree.map(cp, cache)
 
     def _verify_impl(self, params, cache, tokens, positions, temps, rng):
         """Speculative verification: ONE forward over (num_slots, K+1)
@@ -807,6 +975,13 @@ class ContinuousBatchingEngine:
             # The wedged thread may hold (or have donated) the old
             # cache mid-dispatch; the successor re-initializes its own.
             self._cache = None
+            if self.paged_block_size:
+                # Fresh pool/prefix objects (not clears): the abandoned
+                # thread keeps mutating ITS objects harmlessly, same
+                # isolation pattern as the slots/queue swap above.
+                self._pool = kv_cache_lib.BlockPool(
+                    self.cfg.paged_num_blocks, self.paged_block_size)
+                self._prefix_entries = self._new_prefix_index()
             self._thread = None
             self._heartbeat = time_lib.monotonic()
         logger.error('engine watchdog: %s; failing in-flight requests '
@@ -865,26 +1040,231 @@ class ContinuousBatchingEngine:
     _MIN_PREFIX = 16
 
     def _longest_cached_prefix(self, ids: list):
-        """(prefix_len, cache) of the best LRU entry that is a prefix of
-        `ids`, or (0, None). An exact-length hit reuses all but the last
-        token (the suffix must be non-empty to produce logits)."""
-        best_len, best_cache = 0, None
-        limit = len(ids) - 1
-        for key, cache in self._prefix_entries.items():
-            plen = min(len(key), limit)
-            if plen > best_len and list(key[:plen]) == ids[:plen]:
-                best_len, best_cache = plen, cache
-        return best_len, best_cache
+        """(prefix_len, payload) of the best LRU entry that is a prefix
+        of `ids`, or (0, None). An exact-length hit reuses all but the
+        last token (the suffix must be non-empty to produce logits).
+        Chunk-trie lookup: O(prompt/chunk) probes, not a full re-compare
+        per entry (kv_cache.PrefixIndex; work counted in
+        _prefix_entries.last_compares)."""
+        return self._prefix_entries.lookup(ids, len(ids) - 1)
 
     def _store_prefix(self, ids: list, cache1) -> None:
-        key = tuple(ids)
-        self._prefix_entries[key] = cache1
-        self._prefix_entries.move_to_end(key)
-        while len(self._prefix_entries) > self.prefix_cache:
-            self._prefix_entries.popitem(last=False)
+        # Displaced contiguous payloads are batch-1 device caches with
+        # no other owner — dropping the reference frees them.
+        self._prefix_entries.put(ids, cache1)
+
+    # ---------------- paged-KV host bookkeeping ----------------
+
+    def _alloc_block(self) -> int:
+        """Allocate one pool block, evicting prefix-LRU entries under
+        pressure. Eviction only DEREFS: a block shared with an active
+        slot stays alive until its refcount hits 0 (kv_cache.BlockPool),
+        so evicting the LRU can never corrupt in-flight requests."""
+        try:
+            return self._pool.alloc()
+        except kv_cache_lib.PoolExhaustedError:
+            while len(self._prefix_entries):
+                popped = self._prefix_entries.pop_lru()
+                if popped is None:
+                    break
+                _key, blocks = popped
+                self._pool.release(blocks)
+                self.paged_stats['prefix_evictions'] += 1
+                if self._pool.free:
+                    return self._pool.alloc()
+            raise
+
+    def _ensure_blocks(self, req: '_Request', upto_pos: int) -> None:
+        """Grow the request's block table to cover positions
+        [0, upto_pos) — lazy allocation, clamped to the logical
+        window."""
+        bs = self.paged_block_size
+        need = min(-(-upto_pos // bs), self._blocks_per_seq)
+        while len(req.blocks) < need:
+            req.blocks.append(self._alloc_block())
+
+    def _release_blocks(self, req: '_Request') -> None:
+        """Return a finished/failed request's block refs to the pool
+        (shared prefix blocks survive via the prefix entry's refs)."""
+        if self._pool is None or not req.blocks:
+            return
+        self._pool.release(req.blocks)
+        req.blocks = []
+
+    def _table_array(self, reqs) -> jnp.ndarray:
+        """(len(reqs), blocks_per_seq + 1) int32 block tables. Unmapped
+        logical blocks — and the extra last column that absorbs
+        clipped pad-token writes — point at the scratch block (0).
+        `None` rows (empty/prefilling slots in a decode tick) are all
+        scratch."""
+        import numpy as np
+        width = self._blocks_per_seq + 1
+        table = np.zeros((len(reqs), width), np.int32)
+        for row, req in enumerate(reqs):
+            if req is not None and req.blocks:
+                table[row, :len(req.blocks)] = req.blocks
+        return jnp.asarray(table)
+
+    def _admit_paged(self, slot: int, req: '_Request',
+                     gen: int = -1) -> None:
+        """Paged admission: CHEAP — attach shared prefix blocks
+        (incref), copy-on-write the partial boundary block, and mark the
+        request as prefilling. The prompt itself prefills chunk by chunk
+        across subsequent ticks (_prefill_tick), so a long prompt never
+        stalls in-flight slots for more than one chunk."""
+        if gen >= 0:
+            # Same guard as _prefill_tick: a watchdog-abandoned thread
+            # must not incref/alloc against its SUCCESSOR's fresh pool
+            # (or donate the successor's cache through _cow_fn).
+            self._check_gen(gen)
+        plen, entry = (self._longest_cached_prefix(req.ids)
+                       if self.prefix_cache else (0, None))
+        if plen < self._MIN_PREFIX:
+            plen, entry = 0, None
+        bs = self.paged_block_size
+        blocks: list = []
+        if entry is not None:
+            full = plen // bs
+            for block in entry[:full]:
+                self._pool.incref(block)
+            blocks.extend(entry[:full])
+            # Visible on the request from here on, so the admission
+            # failure handler can release them if the CoW dispatch
+            # fails mid-way (same list object; the dst append below
+            # flows through).
+            req.blocks = blocks
+            cow = 0
+            if plen % bs:
+                # The boundary block is shared read-only AND partially
+                # filled: clone it so this request can append. If the
+                # pool is exhausted, UNDO the increfs above before
+                # re-raising — the shed path never sees req.blocks, so
+                # leaked refs would shrink the pool permanently.
+                try:
+                    dst = self._alloc_block()
+                except kv_cache_lib.PoolExhaustedError:
+                    self._pool.release(blocks)
+                    blocks.clear()   # shed path must not double-release
+                    raise
+                pool_arr = self._cow_fn(self._cache,
+                                        jnp.asarray(entry[full],
+                                                    jnp.int32),
+                                        jnp.asarray(dst, jnp.int32))
+                if gen >= 0:
+                    self._commit_gen(
+                        gen, lambda: setattr(self, '_cache', pool_arr))
+                else:
+                    self._cache = pool_arr
+                blocks.append(dst)
+                cow = 1
+            self.paged_stats['blocks_reused'] += full
+            self.paged_stats['cow_copies'] += cow
+            _PAGED_REUSED.inc(full)
+            if cow:
+                _PAGED_COW.inc()
+            self.prefix_stats['hits'] += 1
+            self.prefix_stats['tokens_reused'] += plen
+            _PREFIX_HIT.inc()
+            _PREFIX_TOKENS.inc(plen)
+        elif self.prefix_cache:
+            self.prefix_stats['misses'] += 1
+            _PREFIX_MISS.inc()
+        req.blocks = blocks
+        req.prefill_pos = plen
+        req.next_pos = plen
+        req.prefilling = True
+
+        def _commit():
+            self._slots[slot] = req
+
+        if gen >= 0:
+            self._commit_gen(gen, _commit)
+        else:
+            _commit()
+
+    def _store_prefix_paged(self, req: '_Request') -> None:
+        """Publish the freshly prefilled prompt's blocks as a shared
+        prefix: ceil(L/block_size) ref-counted blocks — NOT a full
+        max_seq_len cache (the HBM waste the paged layout removes)."""
+        if not self.prefix_cache:
+            return
+        num = -(-len(req.ids) // self.paged_block_size)
+        blocks = list(req.blocks[:num])
+        for block in blocks:
+            self._pool.incref(block)
+        displaced = self._prefix_entries.put(req.ids, blocks)
+        for _key, old_blocks in displaced:
+            self._pool.release(old_blocks)
+
+    def _prefill_tick(self, slots, prefilling, gen: int) -> None:
+        """Advance every mid-prefill slot by ONE fixed-size chunk. The
+        final chunk's logits seed the first sampled token (TTFT) and
+        flip the slot to decoding; the prompt's blocks publish to the
+        prefix LRU."""
+        import time as time_lib
+        self._check_gen(gen)  # don't let a stale thread leak blocks
+                              # from a successor's pool
+        for slot in prefilling:
+            req = slots[slot]
+            total = len(req.ids)
+            start = req.prefill_pos
+            n = min(self.prefill_chunk, total - start)
+            try:
+                self._ensure_blocks(
+                    req, min(start + self.prefill_chunk,
+                             self.cfg.max_seq_len))
+            except kv_cache_lib.PoolExhaustedError:
+                slots[slot] = None
+                self._release_blocks(req)
+                self._fail_request(req, exceptions.EngineOverloadedError(
+                    'KV block pool exhausted mid-prefill; request shed '
+                    '(size paged_num_blocks to the load)'))
+                continue
+            chunk = req.ids[start:start + n] + \
+                [0] * (self.prefill_chunk - n)
+            logits, pool_arr = self._prefill_chunk_fn(
+                self.params, self._cache,
+                jnp.asarray([chunk], jnp.int32),
+                self._table_array([req]),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(n, jnp.int32))
+            self._commit_gen(gen,
+                             lambda: setattr(self, '_cache', pool_arr))
+            req.prefill_pos = start + n
+            self.paged_stats['prefill_chunks'] += 1
+            _CHUNKED_PREFILL.inc()
+            self.step_log.append(('prefill', frozenset([slot])))
+            if req.prefill_pos >= total:
+                req.prefilling = False
+                self._store_prefix_paged(req)
+                first = self._sample(logits, req.temperature)
+                req.first_token_time = time_lib.monotonic()
+                _TTFT_HIST.observe(req.first_token_time -
+                                   req.submit_time)
+                req.tokens.append(first)
+                _TOKENS_TOTAL.inc()
+                self._notify(req, first)
+                req.next_pos = total
+
+    def paged_occupancy(self) -> Dict[str, Any]:
+        """Pool accounting snapshot (bench.py --serve reports it; tests
+        pin ceil(L/block_size) prefix-entry costs against it)."""
+        if not self.paged_block_size:
+            return {}
+        return {
+            'block_size': self.paged_block_size,
+            'blocks_capacity': self._pool.num_blocks,
+            'blocks_used': self._pool.used,
+            'peak_blocks_used': self._pool.peak_used,
+            'prefix_entries': len(self._prefix_entries),
+            **self.paged_stats,
+        }
 
     def _admit(self, slot: int, req: '_Request', gen: int = -1) -> None:
         import time
+        if self.paged_block_size:
+            self._admit_paged(slot, req, gen)
+            return
         true_len = len(req.ids)
         plen, pcache = (self._longest_cached_prefix(req.ids)
                         if self.prefix_cache else (0, None))
@@ -955,6 +1335,9 @@ class ContinuousBatchingEngine:
         import time
         req = slots[slot]
         slots[slot] = None
+        # Paged: return block refs; blocks shared with a prefix entry
+        # stay alive (refcount > 0), private suffix blocks free now.
+        self._release_blocks(req)
         now = time.monotonic()
         stats = {
             'ttft_s': req.first_token_time - req.submit_time,
@@ -986,7 +1369,7 @@ class ContinuousBatchingEngine:
             contextlib.nullcontext()
         with ctx:
             if self._cache is None:
-                self._cache = self._init_slot_cache()
+                self._cache = self._init_cache_for_mode()
             while not self._stop.is_set():
                 if self._generation != gen:
                     return  # abandoned by the watchdog: a successor owns
@@ -1019,11 +1402,21 @@ class ContinuousBatchingEngine:
                                 break
                     for req in failed:
                         self._fail_request(req, e)
-                    fresh_cache = self._init_slot_cache()
+                    fresh_cache = self._init_cache_for_mode()
+
+                    def _reset_state(fresh_cache=fresh_cache):
+                        self._cache = fresh_cache
+                        if self.paged_block_size:
+                            # Fresh pool + prefix index: the failed
+                            # tick's block bookkeeping is untrusted.
+                            self._pool = kv_cache_lib.BlockPool(
+                                self.cfg.paged_num_blocks,
+                                self.paged_block_size)
+                            self._prefix_entries = \
+                                self._new_prefix_index()
+
                     try:
-                        self._commit_gen(
-                            gen,
-                            lambda: setattr(self, '_cache', fresh_cache))
+                        self._commit_gen(gen, _reset_state)
                     except _StaleEngineError:
                         return
                 if self._generation == gen:
@@ -1052,9 +1445,11 @@ class ContinuousBatchingEngine:
                 continue
             if req.future.cancelled():
                 slots[slot] = None
+                self._release_blocks(req)
                 self._notify(req, None)
             elif req.deadline is not None and now > req.deadline:
                 slots[slot] = None
+                self._release_blocks(req)
                 self._fail_request(
                     req,
                     exceptions.RequestDeadlineExceededError(
@@ -1067,14 +1462,23 @@ class ContinuousBatchingEngine:
         # queued or mid-decode, and a dead entry must not hold
         # admission-queue capacity.
         if not queue.empty():
+            # One pass under the mutex: partition into kept/dead and
+            # swap the deque contents in place. (The old loop called
+            # deque.remove(req) inside a scan over a snapshot — O(n²)
+            # on a deep backlog, all while holding the mutex.)
             dead = []
             with queue.mutex:
-                for req in list(queue.queue):
+                kept = collections.deque()
+                for req in queue.queue:
                     if req.future.cancelled() or (
                             req.deadline is not None and
                             now > req.deadline):
-                        queue.queue.remove(req)
                         dead.append(req)
+                    else:
+                        kept.append(req)
+                if dead:
+                    queue.queue.clear()
+                    queue.queue.extend(kept)
             for req in dead:
                 if req.future.cancelled():
                     self._notify(req, None)
@@ -1105,15 +1509,30 @@ class ContinuousBatchingEngine:
                             f'after {mono_now - req.submit_time:.1f}s'))
                     continue
                 # Prefill of a fresh prompt bucket may JIT-compile:
-                # widen the watchdog allowance for the dispatch.
+                # widen the watchdog allowance for the dispatch. (Paged
+                # admission is cheap — block attach + CoW — but keeps
+                # the same flag for its CoW-copy first compile.)
                 self._admitting_tick = True
                 try:
                     self._admit(slot, req, gen)
+                except kv_cache_lib.PoolExhaustedError as e:
+                    # Shed THIS request; in-flight slots keep their
+                    # blocks and keep decoding.
+                    self._fail_request(
+                        req, exceptions.EngineOverloadedError(
+                            f'KV block pool exhausted at admission: '
+                            f'{e}'))
+                    continue
                 except BaseException as e:
                     # The request is "in hand" — in neither the queue
                     # nor a slot — so no recovery/cleanup path would
                     # ever resolve its future: fail it here before
-                    # propagating.
+                    # propagating. Paged blocks it acquired are
+                    # returned — except on stale abandonment, where
+                    # the pool object belongs to a successor now and
+                    # this thread must not touch it.
+                    if not isinstance(e, _StaleEngineError):
+                        self._release_blocks(req)
                     self._fail_request(
                         req,
                         exceptions.EngineWedgedError(
@@ -1121,6 +1540,19 @@ class ContinuousBatchingEngine:
                             'request aborted')
                         if isinstance(e, _StaleEngineError) else e)
                     raise
+        # Chunked prefill (paged mode): every mid-prefill slot advances
+        # ONE fixed-shape chunk, then the decode below still runs for
+        # the slots already past prefill — the interleaving that keeps
+        # TPOT flat while a long prompt lands. First chunk may
+        # JIT-compile (once per engine), hence inside the widened
+        # watchdog allowance.
+        prefilling = [i for i, r in enumerate(slots)
+                      if r is not None and r.prefilling]
+        if prefilling:
+            self._admitting_tick = True
+            self._prefill_tick(slots, prefilling, gen)
+            prefilling = [i for i, r in enumerate(slots)
+                          if r is not None and r.prefilling]
         # Admission (and its possible compile) is over; refresh the
         # heartbeat BEFORE dropping the widened allowance, or a
         # longer-than-timeout (but legitimate) admission would read as
@@ -1130,14 +1562,22 @@ class ContinuousBatchingEngine:
         if self._generation == gen:
             self._heartbeat = time_lib.monotonic()
         self._admitting_tick = False
-        active = [i for i, r in enumerate(slots) if r is not None]
+        active = [i for i, r in enumerate(slots)
+                  if r is not None and not r.prefilling]
         # Saturation signals, refreshed once per tick (cheap: gauge sets
         # behind the enabled-check).
         _ACTIVE_SLOTS.set(len(active))
         _QUEUE_DEPTH.set(queue.qsize())
+        if self._pool is not None:
+            # Capacity re-set here (not only at __init__): the exporter
+            # usually enables AFTER engine construction, and a gauge set
+            # while recording is disabled is a no-op.
+            _PAGED_CAPACITY.set(self._pool.num_blocks)
+            _PAGED_USED.set(self._pool.used)
         if not active:
-            self._wake.wait(timeout=0.05)
-            self._wake.clear()
+            if not prefilling:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
             return
         # Chaos harness: tests/SKYTPU_FAULTS can fail or wedge the
         # decode step here; disarmed this is a single boolean check.
@@ -1161,25 +1601,63 @@ class ContinuousBatchingEngine:
         # waiting to be admitted (admission latency stays bounded by one
         # chunk), a single step otherwise.
         k = 1
-        if self.decode_chunk > 1 and self._queue.empty():
+        if self.decode_chunk > 1 and self._queue.empty() \
+                and not prefilling:
             # Full chunks only: k ∈ {1, decode_chunk} so serving never
             # JIT-compiles a new scan length mid-stream. Slots whose
             # cache window can't absorb a full chunk finish on single
-            # steps.
+            # steps; a mid-prefill slot also forces single steps so its
+            # next chunk isn't delayed by a whole decode scan.
             window_ok = all(
                 self.cfg.max_seq_len - slots[i].next_pos
                 >= self.decode_chunk for i in active)
             if window_ok:
                 k = self.decode_chunk
+        # Prefilling slots have no sampled token yet: they ride the
+        # dispatch as inert rows (scratch-table writes, outputs
+        # discarded), exactly like empty slots.
+        active_set = set(active)
         tokens = [(slots[i].tokens[-1]
-                   if slots[i] is not None else 0)
+                   if i in active_set else 0)
                   for i in range(self.num_slots)]
         positions = [(slots[i].next_pos
-                      if slots[i] is not None else 0)
+                      if i in active_set else 0)
                      for i in range(self.num_slots)]
         temps = [(slots[i].temperature
-                  if slots[i] is not None else 0.0)
+                  if i in active_set else 0.0)
                  for i in range(self.num_slots)]
+        tables = None
+        if self.paged_block_size:
+            # Cover every position this dispatch writes (k steps) so
+            # the table stays fixed across the scanned chunk.
+            try:
+                for i in active:
+                    self._ensure_blocks(req=slots[i],
+                                        upto_pos=min(
+                                            slots[i].next_pos + k,
+                                            self.cfg.max_seq_len))
+            except kv_cache_lib.PoolExhaustedError as e:
+                # Can only happen with an undersized explicit pool:
+                # surface it through the tick-failure path (fails and
+                # clears in-flight requests) rather than wedging.
+                raise exceptions.EngineOverloadedError(
+                    f'KV block pool exhausted mid-decode: {e}') from e
+            # Tables only change at admission/finish/block-growth, so
+            # steady-state ticks reuse the cached device array instead
+            # of rebuilding + re-uploading it (per-tick host work is
+            # the tick-latency budget). The fingerprint is the block
+            # ids themselves — a few dozen ints, far cheaper than a
+            # numpy build + host-to-device transfer, and immune to
+            # id()-recycling across request objects.
+            sig = tuple(
+                tuple(slots[i].blocks) if i in active_set else None
+                for i in range(self.num_slots))
+            if sig != self._table_sig:
+                self._table_cache = self._table_array(
+                    [slots[i] if i in active_set else None
+                     for i in range(self.num_slots)])
+                self._table_sig = sig
+            tables = self._table_cache
         self._rng, rng = jax.random.split(self._rng)
         import numpy as np
         if k == 1:
@@ -1187,7 +1665,7 @@ class ContinuousBatchingEngine:
                 self.params, self._cache,
                 jnp.asarray(tokens, jnp.int32)[:, None],
                 jnp.asarray(positions, jnp.int32)[:, None],
-                jnp.asarray(temps, jnp.float32), rng)
+                jnp.asarray(temps, jnp.float32), rng, tables)
             out_cols = np.asarray(out_tokens)[:, None]
         else:
             rngs = jax.random.split(rng, k)
@@ -1195,7 +1673,7 @@ class ContinuousBatchingEngine:
                 self.params, self._cache,
                 jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(positions, jnp.int32),
-                jnp.asarray(temps, jnp.float32), rngs)
+                jnp.asarray(temps, jnp.float32), rngs, tables)
             out_cols = np.asarray(out_tokens)     # (num_slots, k)
         self._commit_gen(gen, lambda: setattr(self, '_cache', cache))
         self._decode_steps += k
@@ -1356,6 +1834,7 @@ class ContinuousBatchingEngine:
                 'engine drain timed out; request aborted during '
                 'shutdown')
             for req in leftovers:
+                self._release_blocks(req)
                 self._fail_request(req, err)
         return finished
 

@@ -1,0 +1,290 @@
+"""Paged KV cache bookkeeping: block-pool allocator + prefix index.
+
+The device side of paging lives in models/transformer.py
+(`Attention._paged_decode_attention`: scatter-write through per-row
+block tables, gather-read the logical window). This module is the HOST
+side the continuous-batching engine drives:
+
+- `BlockPool` — a fixed pool of KV blocks with a free list and
+  refcounts. A block is storage for `block_size` tokens of K/V across
+  all layers; a cached prefix of length L costs ceil(L/block_size)
+  blocks instead of a full max_seq_len cache per entry (the HBM waste
+  the paged layout exists to eliminate — see docs/performance.md).
+  Refcounts make block-granular prefix SHARING safe: a cached prefix's
+  blocks are referenced read-only by every request extending it, and a
+  block returns to the free list only when its refcount hits 0.
+- `PrefixIndex` — an LRU of cached prefixes keyed by hashable tuple
+  CHUNKS (a trie over chunk tuples), so longest-prefix lookup costs
+  O(prompt/chunk) dict probes + O(chunk) token compares per candidate
+  instead of the old O(entries × prompt) full-list re-comparison
+  (`last_compares` counts the work; pinned by tests/test_paged_cache.py).
+
+Everything here is plain host Python — no jax imports — so allocator
+invariants are testable without a device.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class PoolExhaustedError(Exception):
+    """No free block: the caller should evict cached prefixes (refcount
+    drops free their blocks) or shed the request."""
+
+
+class BlockPool:
+    """Fixed-size pool of KV blocks with refcounts and a free list.
+
+    Block 0 is the SCRATCH block: permanently pinned, never handed out.
+    The engine points pad-token writes and inactive decode rows at it,
+    so garbage lands somewhere harmless instead of in live data.
+
+    Thread-safe: the engine thread allocates/releases per tick while
+    drain/watchdog paths release from other threads.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        if num_blocks < 2:
+            raise ValueError(f'need >= 2 blocks (scratch + data); got '
+                             f'{num_blocks}')
+        if block_size < 1:
+            raise ValueError(f'block_size must be >= 1; got {block_size}')
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._lock = threading.Lock()
+        # LIFO free list: recently-freed blocks are re-used first (their
+        # pool region is the likeliest to still sit in cache/HBM pages).
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._refs: List[int] = [0] * num_blocks
+        self._refs[0] = 1                    # scratch, pinned forever
+        self.peak_used = 1
+
+    # -- accounting --
+
+    @property
+    def used(self) -> int:
+        """Blocks not on the free list (includes the scratch block)."""
+        return self.num_blocks - len(self._free)
+
+    @property
+    def free(self) -> int:
+        return len(self._free)
+
+    def refcount(self, block: int) -> int:
+        return self._refs[block]
+
+    # -- lifecycle --
+
+    def alloc(self) -> int:
+        with self._lock:
+            if not self._free:
+                raise PoolExhaustedError(
+                    f'all {self.num_blocks} KV blocks in use')
+            block = self._free.pop()
+            self._refs[block] = 1
+            self.peak_used = max(self.peak_used, self.used)
+            return block
+
+    def incref(self, block: int) -> None:
+        with self._lock:
+            if self._refs[block] <= 0:
+                raise ValueError(f'incref on free block {block}')
+            self._refs[block] += 1
+
+    def decref(self, block: int) -> None:
+        if block == 0:
+            raise ValueError('decref on the scratch block')
+        with self._lock:
+            if self._refs[block] <= 0:
+                raise ValueError(f'decref on free block {block}')
+            self._refs[block] -= 1
+            if self._refs[block] == 0:
+                self._free.append(block)
+
+    def release(self, blocks) -> None:
+        """decref a whole table (a finished request's blocks)."""
+        for block in blocks:
+            self.decref(block)
+
+    def check(self) -> None:
+        """Invariants (tests call this after churn): free list and
+        referenced set partition the pool; no double-free; scratch
+        pinned."""
+        with self._lock:
+            free_set = set(self._free)
+            assert len(free_set) == len(self._free), 'duplicate free block'
+            assert 0 not in free_set, 'scratch block on the free list'
+            for block in range(self.num_blocks):
+                if block in free_set:
+                    assert self._refs[block] == 0, (
+                        f'free block {block} has refcount '
+                        f'{self._refs[block]}')
+                else:
+                    assert self._refs[block] > 0, (
+                        f'in-use block {block} has refcount 0')
+
+
+class _TrieNode:
+    __slots__ = ('children', 'entries')
+
+    def __init__(self) -> None:
+        self.children: Dict[tuple, '_TrieNode'] = {}
+        # (tail_tuple, full_key) pairs for entries whose full chunks end
+        # at this node; tail is the sub-chunk remainder (possibly ()).
+        self.entries: List[Tuple[tuple, tuple]] = []
+
+
+class PrefixIndex:
+    """LRU of cached prefixes with chunked-trie longest-prefix lookup.
+
+    Keys are token tuples; payloads are opaque (the contiguous engine
+    stores a batch-1 device cache, the paged engine a block list).
+    Lookup semantics match the engine's historical contract: an entry
+    matches iff `entry[:min(len(entry), limit)] == ids[:...]` — all or
+    nothing per entry, longest match wins, and `limit` (= len(ids)-1)
+    keeps the suffix non-empty so continuation still produces logits.
+
+    Iteration yields keys in LRU order (oldest first), so tests that
+    asserted against the old OrderedDict keep passing unchanged.
+    """
+
+    def __init__(self, capacity: int, chunk: int) -> None:
+        if capacity < 1:
+            raise ValueError('capacity must be >= 1')
+        if chunk < 1:
+            raise ValueError('chunk must be >= 1')
+        self.capacity = capacity
+        self.chunk = chunk
+        self._lru: 'OrderedDict[tuple, Any]' = OrderedDict()
+        self._root = _TrieNode()
+        # Token-compare work done by the LAST lookup (hashing a chunk
+        # tuple counts as `chunk` compares) — the satellite's O(prompt/
+        # chunk) bound is pinned against this counter.
+        self.last_compares = 0
+
+    # -- container protocol (tests iterate/len the entry table) --
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def __iter__(self):
+        return iter(self._lru)
+
+    def __contains__(self, key) -> bool:
+        return tuple(key) in self._lru
+
+    # -- mutation --
+
+    def _chunks(self, key: tuple) -> List[tuple]:
+        c = self.chunk
+        return [key[i:i + c] for i in range(0, len(key) - len(key) % c, c)]
+
+    def put(self, ids, payload) -> List[Tuple[tuple, Any]]:
+        """Insert/refresh an entry; returns [(key, payload), ...] that
+        were DISPLACED (an older payload under the same key, plus LRU
+        evictions past capacity) so the caller can release their
+        storage."""
+        key = tuple(ids)
+        displaced: List[Tuple[tuple, Any]] = []
+        if key in self._lru:
+            displaced.append((key, self._lru[key]))
+            self._lru[key] = payload
+            self._lru.move_to_end(key)
+            return displaced
+        self._lru[key] = payload
+        node = self._root
+        for chunk in self._chunks(key):
+            node = node.children.setdefault(chunk, _TrieNode())
+        node.entries.append((key[len(key) - len(key) % self.chunk:], key))
+        while len(self._lru) > self.capacity:
+            old_key, old_payload = self._lru.popitem(last=False)
+            self._remove_from_trie(old_key)
+            displaced.append((old_key, old_payload))
+        return displaced
+
+    def pop_lru(self) -> Optional[Tuple[tuple, Any]]:
+        """Evict the least-recently-stored entry (pool-pressure path)."""
+        if not self._lru:
+            return None
+        key, payload = self._lru.popitem(last=False)
+        self._remove_from_trie(key)
+        return key, payload
+
+    def _remove_from_trie(self, key: tuple) -> None:
+        path = [self._root]
+        for chunk in self._chunks(key):
+            path.append(path[-1].children[chunk])
+        tail = key[len(key) - len(key) % self.chunk:]
+        path[-1].entries.remove((tail, key))
+        # Prune now-empty nodes bottom-up.
+        for depth in range(len(path) - 1, 0, -1):
+            node = path[depth]
+            if node.entries or node.children:
+                break
+            del path[depth - 1].children[self._chunks(key)[depth - 1]]
+
+    # -- lookup --
+
+    def lookup(self, ids, limit: int) -> Tuple[int, Any]:
+        """(matched_len, payload) of the best entry with
+        entry[:min(len(entry), limit)] == ids[:...], or (0, None)."""
+        c = self.chunk
+        prefix = tuple(ids[:max(0, limit)])
+        limit = len(prefix)
+        self.last_compares = 0
+        best_len, best_key = 0, None
+
+        def consider(node: '_TrieNode', depth: int) -> None:
+            nonlocal best_len, best_key
+            base = depth * c
+            for tail, key in node.entries:
+                m = min(len(key), limit)
+                span = m - base
+                self.last_compares += max(span, 1)
+                if m > best_len and tail[:span] == prefix[base:m]:
+                    best_len, best_key = m, key
+
+        node = self._root
+        consider(node, 0)
+        depth = 0
+        while (depth + 1) * c <= limit:
+            self.last_compares += c          # one chunk-tuple hash/probe
+            child = node.children.get(prefix[depth * c:(depth + 1) * c])
+            if child is None:
+                break
+            depth += 1
+            node = child
+            consider(node, depth)
+        else:
+            # Walked every full prompt chunk; longer entries live one
+            # edge deeper. rem > 0: any child whose chunk starts with
+            # the prompt's final partial chunk covers `limit` tokens.
+            # rem == 0 (limit chunk-aligned): EVERY descendant already
+            # matches all `limit` tokens via the walked path alone.
+            rem = limit - depth * c
+            if best_len < limit:
+                tail = prefix[depth * c:]
+                for chunk, child in node.children.items():
+                    self.last_compares += max(rem, 1)
+                    if chunk[:rem] == tail:
+                        key = self._any_key(child)
+                        if key is not None:
+                            best_len, best_key = limit, key
+                            break
+        if best_key is None:
+            return 0, None
+        # No recency refresh here: historically a hit refreshes via the
+        # store-after-admit (the admitted prompt re-stored under the
+        # same or an extended key), never via lookup itself.
+        return best_len, self._lru[best_key]
+
+    def _any_key(self, node: '_TrieNode') -> Optional[tuple]:
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            if cur.entries:
+                return cur.entries[0][1]
+            stack.extend(cur.children.values())
+        return None

@@ -289,7 +289,8 @@ class Attention(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 block_tables: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         dense = lambda feats, axes, name: dense_general(
             cfg, feats, axes, name, use_bias=cfg.qkv_bias)
@@ -323,7 +324,7 @@ class Attention(nn.Module):
             k = apply_rope(k, positions, cfg.rope_theta, rotary_dim=rot,
                            scaling=cfg.rope_scaling)
         if cfg.decode:
-            out = self._decode_attention(q, k, v, positions)
+            out = self._decode_attention(q, k, v, positions, block_tables)
         else:
             block_kw = {}
             if cfg.attn_block_q:
@@ -341,7 +342,9 @@ class Attention(nn.Module):
 
     def _decode_attention(self, q: jax.Array, k: jax.Array,
                           v: jax.Array,
-                          positions: jax.Array) -> jax.Array:
+                          positions: jax.Array,
+                          block_tables: Optional[jax.Array] = None
+                          ) -> jax.Array:
         """KV-cached attention for prefill + autoregressive decode.
 
         The cache (`'cache'` variable collection) holds K/V over a static
@@ -369,6 +372,9 @@ class Attention(nn.Module):
             raise ValueError(
                 f'prompt chunk {cur_len} exceeds max_seq_len '
                 f'{cfg.max_seq_len}')
+        if cfg.paged_block_size:
+            return self._paged_decode_attention(q, k, v, positions,
+                                                block_tables)
         kv_heads = k.shape[2]
         kv_quant = cfg.kv_cache_quant == 'int8'
         cache_dtype = jnp.int8 if kv_quant else k.dtype
@@ -483,6 +489,107 @@ class Attention(nn.Module):
             out = jnp.einsum('bkrqs,bskd->bqkrd', probs, value_arr)
         return out.reshape(batch, cur_len, cfg.num_heads, cfg.head_dim)
 
+    def _paged_decode_attention(self, q: jax.Array, k: jax.Array,
+                                v: jax.Array, positions: jax.Array,
+                                block_tables: Optional[jax.Array]
+                                ) -> jax.Array:
+        """Paged variant of _decode_attention: K/V live in a SHARED pool
+        of `cfg.paged_num_blocks` blocks of `cfg.paged_block_size`
+        tokens; `block_tables` (batch, max_seq_len//block_size + 1)
+        maps each row's logical block index to a physical block id.
+
+        Writes scatter the current chunk to
+        table[row, pos // bs] * bs + pos % bs; reads gather each row's
+        full logical window back to (B, S, KV, D) and run EXACTLY the
+        contiguous score/softmax math, so greedy outputs are
+        bit-identical to the contiguous layout (pinned by
+        tests/test_paged_cache.py). Unwritten logical blocks map to the
+        scratch block (id 0, also the table's extra last column, which
+        absorbs pad-token writes past max_seq_len via index clipping);
+        whatever garbage they hold is causally masked to -1e30 before
+        softmax, so it contributes exactly 0.
+
+        The capacity win: pool HBM scales with tokens actually held
+        (shared prefix blocks are stored ONCE and referenced by many
+        rows' tables), not slots × max_seq_len. Engine-side allocation,
+        refcounts, and copy-on-write live in models/kv_cache.py.
+        """
+        cfg = self.cfg
+        if block_tables is None:
+            raise ValueError('paged KV cache requires block_tables')
+        if cfg.kv_cache_quant:
+            raise NotImplementedError(
+                'paged KV cache + int8 KV quantization is not wired; '
+                'use one or the other')
+        batch, cur_len, kv_heads, _ = k.shape
+        bs = cfg.paged_block_size
+        nblocks = cfg.paged_num_blocks
+        bps = cfg.max_seq_len // bs          # logical blocks per row
+        cache_shape = (nblocks, bs, kv_heads, cfg.head_dim)
+        # No batch axis: the pool is shared across rows (that is the
+        # point), so it shards on kv_heads (tp) only.
+        cached_key = self.variable(
+            'cache', 'cached_key',
+            lambda: nn.with_logical_partitioning(
+                jnp.zeros, (None, None, 'kv_heads', None))(
+                    cache_shape, k.dtype))
+        cached_value = self.variable(
+            'cache', 'cached_value',
+            lambda: nn.with_logical_partitioning(
+                jnp.zeros, (None, None, 'kv_heads', None))(
+                    cache_shape, k.dtype))
+
+        def unbox(var):
+            box = var.value
+            return (box.unbox() if hasattr(box, 'unbox') else box), box
+
+        def rebox(var, box, arr):
+            if hasattr(box, 'replace_boxed'):
+                var.value = box.replace_boxed(arr)
+            else:
+                var.value = arr
+
+        key_arr, key_box = unbox(cached_key)
+        value_arr, value_box = unbox(cached_value)
+        # ---- write the current chunk through the table ----
+        # Pad tokens past max_seq_len clip into the table's extra last
+        # column, which the engine pins to the scratch block.
+        log_block = jnp.clip(positions // bs, 0, block_tables.shape[1] - 1)
+        phys = jnp.take_along_axis(block_tables, log_block, axis=1)
+        flat_idx = phys * bs + positions % bs          # (B, cur)
+        kf = key_arr.reshape(nblocks * bs, kv_heads, cfg.head_dim)
+        vf = value_arr.reshape(nblocks * bs, kv_heads, cfg.head_dim)
+        kf = kf.at[flat_idx.reshape(-1)].set(
+            k.reshape(-1, kv_heads, cfg.head_dim))
+        vf = vf.at[flat_idx.reshape(-1)].set(
+            v.reshape(-1, kv_heads, cfg.head_dim))
+        rebox(cached_key, key_box, kf.reshape(cache_shape))
+        rebox(cached_value, value_box, vf.reshape(cache_shape))
+        # ---- gather each row's logical window and attend ----
+        gidx = (block_tables[:, :bps, None] * bs +
+                jnp.arange(bs)[None, None, :]).reshape(batch, bps * bs)
+        k_full = kf[gidx]                              # (B, S, KV, D)
+        v_full = vf[gidx]
+        n_rep = cfg.num_heads // kv_heads
+        q_grouped = q.reshape(batch, cur_len, kv_heads, n_rep,
+                              cfg.head_dim)
+        scores = jnp.einsum('bqkrd,bskd->bkrqs', q_grouped, k_full,
+                            preferred_element_type=jnp.float32)
+        scores = scores * (cfg.head_dim**-0.5)
+        if cfg.attn_logit_softcap:
+            cap = cfg.attn_logit_softcap
+            scores = cap * jnp.tanh(scores / cap)
+        q_pos = positions[:, :, None]                          # (b, q, 1)
+        k_pos = jnp.arange(bps * bs)[None, None, :]            # (1, 1, s)
+        mask = k_pos <= q_pos
+        if cfg.sliding_window:
+            mask &= q_pos - k_pos < cfg.sliding_window
+        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        probs = probs.astype(v_full.dtype)
+        out = jnp.einsum('bkrqs,bskd->bqkrd', probs, v_full)
+        return out.reshape(batch, cur_len, cfg.num_heads, cfg.head_dim)
+
 
 class SwiGLU(nn.Module):
     """Feed-forward in the family's dialect: GLU (gate·act × up → down;
@@ -513,7 +620,8 @@ class DecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array,
-                 positions: jax.Array) -> jax.Array:
+                 positions: jax.Array,
+                 block_tables: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         h = RMSNorm(cfg, name='attn_norm')(x)
         if cfg.parallel_block:
@@ -525,9 +633,10 @@ class DecoderLayer(nn.Module):
             # same normed input and their outputs sum into the residual
             # in a single step — the two matmul chains are independent,
             # so XLA overlaps them freely.
-            return (x + Attention(cfg, name='attn')(h, positions)
+            return (x + Attention(cfg, name='attn')(h, positions,
+                                                    block_tables)
                     + SwiGLU(cfg, name='mlp')(h))
-        x = x + Attention(cfg, name='attn')(h, positions)
+        x = x + Attention(cfg, name='attn')(h, positions, block_tables)
         h = RMSNorm(cfg, name='mlp_norm')(x)
         if cfg.is_moe:
             from skypilot_tpu.models.moe import MoEBlock
@@ -544,9 +653,10 @@ class _ScannedLayer(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, positions = carry
-        x = DecoderLayer(self.cfg, name='layer')(x, positions)
-        return (x, positions), None
+        x, positions, block_tables = carry
+        x = DecoderLayer(self.cfg, name='layer')(x, positions,
+                                                 block_tables)
+        return (x, positions, block_tables), None
 
 
 class Transformer(nn.Module):
@@ -555,7 +665,8 @@ class Transformer(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array,
                  positions: Optional[jax.Array] = None,
-                 mode: str = 'full') -> jax.Array:
+                 mode: str = 'full',
+                 block_tables: Optional[jax.Array] = None) -> jax.Array:
         """mode: 'full' (tokens → logits, the normal path), or the two
         halves the pipeline executor (parallel/pipeline.py) sandwiches
         around its microbatched layer schedule — 'embed' (tokens →
@@ -608,14 +719,15 @@ class Transformer(nn.Module):
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: 'layers'},
             )(cfg, name='layers')
-            (x, _), _ = scanned((x, positions), None)
+            (x, _, _), _ = scanned((x, positions, block_tables), None)
         else:
             # Remat is an execution knob: the param tree keys must not
             # depend on it (checkpoint compatibility).
             layer_ctor = (nn.remat(DecoderLayer, prevent_cse=False)
                           if cfg.remat else DecoderLayer)
             for i in range(cfg.num_layers):
-                x = layer_ctor(cfg, name=f'layer_{i}')(x, positions)
+                x = layer_ctor(cfg, name=f'layer_{i}')(x, positions,
+                                                       block_tables)
 
         return self._head(embed, x)
 

@@ -120,7 +120,10 @@ class InferenceServer:
                  prefix_cache: int = 0,
                  max_queue_depth: int = 0,
                  request_timeout: float = 0.0,
-                 watchdog_timeout: float = 0.0) -> None:
+                 watchdog_timeout: float = 0.0,
+                 paged_block_size: int = 0,
+                 paged_num_blocks: Optional[int] = None,
+                 prefill_chunk: int = 0) -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
@@ -156,7 +159,10 @@ class InferenceServer:
                                                prefix_cache=prefix_cache,
                                                max_queue_depth=max_queue_depth,
                                                watchdog_timeout=(
-                                                   watchdog_timeout or None))
+                                                   watchdog_timeout or None),
+                                               paged_block_size=paged_block_size,
+                                               paged_num_blocks=paged_num_blocks,
+                                               prefill_chunk=prefill_chunk)
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
         if tokenizer.startswith('hf:'):
@@ -876,6 +882,21 @@ def main(argv=None) -> int:
                              'only the suffix. Each entry holds a full '
                              'batch-1 KV cache in HBM — size to spare '
                              'memory.')
+    parser.add_argument('--paged-block-size', type=int, default=0,
+                        help='paged KV cache: pool KV in fixed blocks '
+                             'of N tokens with ref-counted block-'
+                             'granular prefix sharing and chunked '
+                             'prefill (0 = contiguous per-slot cache; '
+                             'see docs/performance.md)')
+    parser.add_argument('--paged-num-blocks', type=int, default=None,
+                        help='paged pool capacity in blocks (default: '
+                             '(num_slots + prefix_cache) x max_seq_len '
+                             '/ block_size + 1)')
+    parser.add_argument('--prefill-chunk', type=int, default=0,
+                        help='paged mode: prompt tokens prefilled per '
+                             'tick — ONE compiled prefill shape, long '
+                             'prompts interleave with decode (default: '
+                             'block size)')
     parser.add_argument('--max-queue', type=int, default=64,
                         help='admission control: queued-request cap; '
                              'beyond it requests are shed with 429/503 '
@@ -912,7 +933,10 @@ def main(argv=None) -> int:
                              prefix_cache=args.prefix_cache,
                              max_queue_depth=args.max_queue,
                              request_timeout=args.request_timeout,
-                             watchdog_timeout=args.watchdog_timeout)
+                             watchdog_timeout=args.watchdog_timeout,
+                             paged_block_size=args.paged_block_size,
+                             paged_num_blocks=args.paged_num_blocks,
+                             prefill_chunk=args.prefill_chunk)
     logger.info('sampling filters: top_k=%s top_p=%s (0 = off)',
                 args.top_k, args.top_p)
     server.warmup()

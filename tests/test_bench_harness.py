@@ -58,6 +58,32 @@ class TestPartialSalvage:
         assert result['value'] == 1
 
 
+class TestStructuredSkip:
+
+    def test_dead_device_emits_skip_json_with_decaying_probes(self):
+        """A dead tunnel must fail FAST (decaying probe timeouts, not
+        3 x 150 s) and still print one machine-parseable JSON line —
+        {"skipped": true, ...} — so the bench trajectory records a
+        structured skip instead of `parsed: null` (r5)."""
+        env = dict(os.environ,
+                   JAX_PLATFORMS='tpu',          # no TPU here → probe hangs
+                   SKYTPU_BENCH_PROBE_TIMEOUT='2',
+                   SKYTPU_BENCH_ATTEMPTS='3',
+                   SKYTPU_BENCH_BACKOFF='0.1')
+        proc = subprocess.run(
+            [sys.executable, _BENCH, '--quick'],
+            capture_output=True, text=True, timeout=120, env=env,
+            check=False)
+        assert proc.returncode == 3, proc.stderr[-2000:]
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert result['skipped'] is True
+        assert 'unreachable' in result['reason']
+        assert result['probes'] == 3
+        # Decay actually applied: retry probes were cheaper than probe 1
+        # would have been at the old fixed timeout.
+        assert sum(result['probe_seconds']) < 30
+
+
 class TestTuneAttn:
 
     def test_tune_attn_worker_emits_best_blocks(self, tmp_path):
