@@ -98,6 +98,13 @@ def _parse_args(argv=None):
                              'blocks (block-granular prefix sharing + '
                              'chunked prefill); the row reports pool '
                              'occupancy')
+    parser.add_argument('--async-depth', type=int, default=0,
+                        choices=[0, 1],
+                        help='serve row: async decode pipeline — '
+                             'dispatch each decode step one tick ahead '
+                             'off the previous step\'s device output; '
+                             'the row reports the host-gap fraction '
+                             'the pipeline removes')
     parser.add_argument('--tune-attn', action='store_true',
                         help='sweep flash-attention block sizes per '
                              'sequence length (fwd+bwd wall time) and '
@@ -291,7 +298,7 @@ def _append_partial(row: dict) -> None:
 
 def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
                   kv_quant=None, speculative=0, prefix_cache=0,
-                  paged_block_size=0) -> dict:
+                  paged_block_size=0, async_depth=0) -> dict:
     """p50/p99 time-to-first-token + aggregate decode throughput under
     concurrent requests on the local chip(s) via the continuous-batching
     engine (models/inference.py) — the BASELINE.md serving row."""
@@ -302,7 +309,7 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
         cfg, num_slots=4, mesh=mesh, quantize=quantize,
         decode_chunk=decode_chunk, kv_quant=kv_quant,
         speculative=speculative, prefix_cache=prefix_cache,
-        paged_block_size=paged_block_size)
+        paged_block_size=paged_block_size, async_depth=async_depth)
     prompt = list(range(1, 33))
     # Warmup: compile prefill + decode (and the verify step, if on).
     engine.generate(prompt, max_new_tokens=4)
@@ -312,11 +319,19 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
         # request pays that jit and pollutes the p99 TTFT this row
         # exists to benchmark.
         engine.generate(prompt, max_new_tokens=4)
+    # Host-gap deltas from engine.tick_stats — the exact quantity the
+    # skytpu_engine_tick_host_gap_seconds histogram records, read
+    # WITHOUT obs.enable(): turning recording on would add per-observe
+    # locking inside the very loop being measured.
+    gap0 = engine.tick_stats['host_gap_s']
+    chained0 = engine.tick_stats['chained']
     t0 = time_lib.time()
     stats = engine.measure_ttft(num_requests=16, prompt=prompt,
                                 max_new_tokens=16, return_stats=True)
     wall = time_lib.time() - t0
     occupancy = engine.paged_occupancy()
+    tick_stats = dict(engine.tick_stats)
+    host_gap_s = tick_stats['host_gap_s'] - gap0
     engine.stop()
     ttfts = sorted(st['ttft_s'] for st in stats)
     total_new = sum(st['new_tokens'] for st in stats)
@@ -338,6 +353,13 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
             decode_rates[len(decode_rates) // 2], 1)
         if decode_rates else 0.0,
     }
+    # Host-gap fraction: host time in which the device had no queued
+    # decode work, over the measured wall — the dispatch-bound overhead
+    # the async pipeline (--async-depth 1) exists to remove.
+    row['host_gap_frac'] = round(min(1.0, host_gap_s / max(wall, 1e-9)),
+                                 4)
+    row['async_depth'] = async_depth
+    row['chained_dispatches'] = tick_stats['chained'] - chained0
     if speculative:
         drafted = max(1, engine.spec_stats['drafted'])
         row['spec_accept_rate'] = round(
@@ -518,7 +540,8 @@ def _worker(args) -> int:
                              kv_quant=args.kv_quant,
                              speculative=args.speculative,
                              prefix_cache=args.prefix_cache,
-                             paged_block_size=args.paged_block_size)
+                             paged_block_size=args.paged_block_size,
+                             async_depth=args.async_depth)
         print(f'serve: {ttft}', file=sys.stderr)
         tags = [t for t in (args.quantize,
                             f'kv-{args.kv_quant}' if args.kv_quant
@@ -528,7 +551,9 @@ def _worker(args) -> int:
                             f'pfx-{args.prefix_cache}'
                             if args.prefix_cache else None,
                             f'paged-{args.paged_block_size}'
-                            if args.paged_block_size else None) if t]
+                            if args.paged_block_size else None,
+                            f'async-{args.async_depth}'
+                            if args.async_depth else None) if t]
         result = {
             'metric': f'{serve_cfg.name} serve p50 TTFT'
                       + (f' ({"+".join(tags)})' if tags else ''),

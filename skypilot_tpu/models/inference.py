@@ -15,11 +15,14 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import itertools
 import logging
+import time as time_lib
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 
 from skypilot_tpu import exceptions
@@ -93,6 +96,18 @@ _PAGED_COW = obs.counter(
 _CHUNKED_PREFILL = obs.counter(
     'skytpu_engine_chunked_prefill_ticks_total',
     'Prefill chunks processed (interleaved between decode ticks)')
+_HOST_GAP_HIST = obs.histogram(
+    'skytpu_engine_tick_host_gap_seconds',
+    'Per decode dispatch: host time between consuming the previous '
+    'dispatch result and issuing the next dispatch — the window in '
+    'which the device has no queued decode work. Chained lookahead '
+    'dispatches (async_depth>0) record 0 by construction.',
+    buckets=(0.00001, 0.00003, 0.0001, 0.0003, 0.001, 0.003, 0.01,
+             0.03, 0.1, 0.3, 1.0))
+_DISPATCH_AHEAD = obs.gauge(
+    'skytpu_engine_dispatch_ahead',
+    'Decode dispatches in flight beyond the last consumed result '
+    '(the async lookahead depth currently in effect)')
 
 # step_log cap: enough history for any interleaving assertion while
 # bounding a serve replica that decodes for weeks (the old unbounded
@@ -114,6 +129,44 @@ class _StaleEngineError(Exception):
     """Raised inside a tick when the watchdog has abandoned this engine
     thread (generation bumped): the thread must exit WITHOUT touching
     the (already replaced) slots/queue/cache of its successor."""
+
+
+def _upload(value, dtype=None):
+    """The engine's single host→device upload funnel. Every hot-path
+    host-list/scalar → device-array conversion routes through here so
+    the tier-1 transfer-counting test can shim ONE symbol and pin the
+    steady-state zero-upload property (a steady decode tick feeds the
+    previous dispatch's output arrays straight back — see _tick)."""
+    return jnp.asarray(value, dtype)
+
+
+# Monotone per-request ids: the device-feed / lookahead signatures key
+# on (seq, next_pos) so a finished request and its slot's next occupant
+# can never alias (unlike id(), which recycles).
+_REQ_SEQ = itertools.count()
+
+
+class _Inflight:
+    """One dispatched-but-not-yet-consumed decode step (async_depth>0).
+
+    `out` is the device array of sampled columns (num_slots, k) with
+    copy_to_host_async already started; `feed` is the NEXT step's
+    device-resident input (tokens, positions) returned in-graph by the
+    dispatch; `reqs` snapshots slot→request identity at dispatch time so
+    emission one tick later can discard columns whose slot changed hands
+    (EOS overshoot, deadline kills, admission churn); `gen` ties the
+    dispatch to the engine generation that issued it — a watchdog
+    recovery discards the record wholesale."""
+
+    __slots__ = ('out', 'feed', 'reqs', 'active', 'k', 'gen')
+
+    def __init__(self, out, feed, reqs, active, k, gen):
+        self.out = out
+        self.feed = feed
+        self.reqs = reqs
+        self.active = active
+        self.k = k
+        self.gen = gen
 
 
 def greedy_sample(logits: jax.Array, rng: jax.Array,
@@ -306,6 +359,17 @@ class InferenceEngine:
 
     # ---------------- generation ----------------
 
+    @staticmethod
+    def _trim_at_eos(toks, eos_id):
+        """Host EOS scan of one emitted chunk (its copy_to_host_async
+        is already in flight): truncate at the first all-EOS column.
+        Returns (kept columns, done)."""
+        cols = np.asarray(toks)
+        for c in range(cols.shape[1]):
+            if (cols[:, c] == eos_id).all():
+                return toks[:, :c + 1], True
+        return toks, False
+
     def generate(self,
                  prompt: jnp.ndarray,
                  max_new_tokens: int = 32,
@@ -314,7 +378,6 @@ class InferenceEngine:
                  ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
         """prompt: (B, prompt_len) int32. Returns
         ((B, <=max_new_tokens) generated ids, stats)."""
-        import time
         assert prompt.ndim == 2 and prompt.shape[0] == self.batch_size, (
             f'prompt must be ({self.batch_size}, L); got {prompt.shape}')
         prompt_len = int(prompt.shape[1])
@@ -326,15 +389,22 @@ class InferenceEngine:
 
         cache = self.init_cache()
         # monotonic: latencies must not go negative on wall-clock steps.
-        t0 = time.monotonic()
+        t0 = time_lib.monotonic()
         logits, cache = self._prefill(self.params, cache,
                                       prompt.astype(jnp.int32),
                                       prompt_len=prompt_len)
         self._rng, rng = jax.random.split(self._rng)
         token = sampler(logits, rng, temperature)
         token.block_until_ready()
-        ttft = time.monotonic() - t0
+        ttft = time_lib.monotonic() - t0
 
+        # Both loops below run ONE dispatch ahead of the host's EOS
+        # scan: the next chunk/step is dispatched off the previous
+        # output's DEVICE array (no host round-trip on the critical
+        # path) while copy_to_host_async lands the previous output for
+        # the scan. EOS is therefore detected one dispatch late; the
+        # already-dispatched overshoot is discarded, so the emitted
+        # stream is bit-identical to the synchronous scan.
         if self.decode_chunk > 1:
             # Chunked: K tokens per dispatch. EOS honored at chunk
             # granularity (the host truncates at the first all-EOS
@@ -346,6 +416,7 @@ class InferenceEngine:
             last = token
             step = 1
             done = False
+            pending = None    # youngest dispatch, EOS scan outstanding
             while step < max_new_tokens and not done:
                 remaining = max_new_tokens - step
                 k = self.decode_chunk
@@ -360,16 +431,22 @@ class InferenceEngine:
                     jnp.asarray(temperature, jnp.float32),
                     greedy=temperature <= 0)
                 toks = toks[:, :remaining]
-                if eos_id is not None:
-                    cols = jax.device_get(toks)
-                    for c in range(cols.shape[1]):
-                        if (cols[:, c] == eos_id).all():
-                            toks = toks[:, :c + 1]
-                            done = True
-                            break
-                chunks.append(toks)
-                last = toks[:, -1]
+                last = toks[:, -1]            # device feed, no sync
                 step += int(toks.shape[1])
+                if eos_id is None:
+                    chunks.append(toks)
+                    continue
+                toks.copy_to_host_async()     # overlaps the next chunk
+                if pending is not None:
+                    trimmed, done = self._trim_at_eos(pending, eos_id)
+                    chunks.append(trimmed)
+                    # done ⇒ the chunk just dispatched is overshoot:
+                    # drop it on the floor (its cache writes sit beyond
+                    # every kept query position — causally masked).
+                pending = toks if not done else None
+            if pending is not None:   # only ever set when eos_id given
+                trimmed, _ = self._trim_at_eos(pending, eos_id)
+                chunks.append(trimmed)
             generated = jnp.concatenate(chunks, axis=1)
         else:
             out = [token]
@@ -380,11 +457,21 @@ class InferenceEngine:
                     jnp.asarray(prompt_len + step - 1, jnp.int32))
                 token = sampler(logits, rng, temperature)
                 out.append(token)
-                if eos_id is not None and bool((token == eos_id).all()):
+                if eos_id is None:
+                    continue
+                token.copy_to_host_async()
+                # Scan the PREVIOUS step's token while this one
+                # computes: if it was EOS, the step just dispatched is
+                # overshoot — truncate it away (identical output to the
+                # synchronous per-step check, which also never scanned
+                # the prefill-sampled token out[0]).
+                if len(out) >= 3 and \
+                        bool((np.asarray(out[-2]) == eos_id).all()):
+                    out = out[:-1]
                     break
             generated = jnp.stack(out, axis=1)
         generated.block_until_ready()
-        total = time.monotonic() - t0
+        total = time_lib.monotonic() - t0
         num_tokens = int(generated.shape[1])
         stats = {
             'ttft_s': ttft,
@@ -403,11 +490,11 @@ class _Request:
     __slots__ = ('ids', 'max_new_tokens', 'temperature', 'eos_id',
                  'future', 'submit_time', 'first_token_time', 'tokens',
                  'next_pos', 'on_token', 'deadline', 'blocks',
-                 'prefilling', 'prefill_pos')
+                 'prefilling', 'prefill_pos', 'seq')
 
     def __init__(self, ids, max_new_tokens, temperature, eos_id, future,
                  on_token=None, deadline=None):
-        import time
+        self.seq = next(_REQ_SEQ)
         self.ids = list(ids)
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
@@ -416,7 +503,7 @@ class _Request:
         # monotonic: feeds ttft_s/total_s durations (and the TTFT/TPOT
         # histograms), which must not go negative on wall-clock steps.
         # The `deadline` below stays wall-clock by API contract.
-        self.submit_time = time.monotonic()
+        self.submit_time = time_lib.monotonic()
         self.first_token_time: Optional[float] = None
         self.tokens: list = []
         self.next_pos = 0  # cache position the NEXT input token writes to
@@ -469,10 +556,10 @@ class ContinuousBatchingEngine:
                  watchdog_timeout: Optional[float] = None,
                  paged_block_size: int = 0,
                  paged_num_blocks: Optional[int] = None,
-                 prefill_chunk: int = 0) -> None:
+                 prefill_chunk: int = 0,
+                 async_depth: int = 0) -> None:
         import queue as queue_lib
         import threading
-        import time as time_lib
         self.cfg, self.params = _resolve_cfg_and_params(
             cfg, params, max_seq_len, rng_seed, quantize, kv_quant)
         self.num_slots = num_slots
@@ -543,10 +630,46 @@ class ContinuousBatchingEngine:
             self.prefill_chunk = 0
         self.paged_stats = {'cow_copies': 0, 'blocks_reused': 0,
                             'prefill_chunks': 0, 'prefix_evictions': 0}
+        # -------- async decode pipeline (docs/performance.md) --------
+        # async_depth=1 ⇒ one-step lookahead: the next decode step is
+        # dispatched off the previous step's DEVICE output before the
+        # host has even seen the tokens (JAX async dispatch queues it);
+        # copy_to_host_async lands step N while the device computes
+        # N+1, and all host work — deadlines, queue purge, admission,
+        # _emit, metrics — overlaps device compute. EOS/termination is
+        # detected one step late; the overshoot column is discarded
+        # (causally masked stale cache, same argument as speculative
+        # rejects). 0 = synchronous ticks (current behavior).
+        self.async_depth = max(0, async_depth)
+        if self.async_depth > 1:
+            raise ValueError('async_depth > 1 is not wired; only '
+                             'one-step lookahead (async_depth=1) pays '
+                             'before per-step compute shrinks below '
+                             'host-loop cost')
         # Decode-tick block-table cache (see _tick): rebuilt only when
         # the per-slot fingerprint changes.
         self._table_sig: Optional[tuple] = None
         self._table_cache = None
+        # Device-resident decode feed: every dispatch returns, IN
+        # GRAPH, the next step's (tokens, positions) so a steady-state
+        # tick feeds the device from the device — no np.asarray on the
+        # critical path, no host→device re-upload of tokens/positions.
+        # `sig` keys the feed to the exact host state it predicts
+        # ((req.seq, next_pos) per active slot); any churn —
+        # admission, finish, deadline kill, spec tick — misses and
+        # rebuilds from host. Temps change only with slot occupancy, so
+        # they cache under their own value signature (the _table_sig
+        # pattern). Steady state uploads nothing (pinned by test).
+        self._feed: Optional[tuple] = None          # (tok, pos, sig)
+        self._temps_sig: Optional[tuple] = None
+        self._temps_cache = None
+        self._inflight: Optional[_Inflight] = None  # lookahead dispatch
+        # Host-gap accounting: monotonic stamp of the last consumed
+        # dispatch result; None after idle/admission ticks so the
+        # histogram records steady-state decode gaps only.
+        self._last_ready: Optional[float] = None
+        self.tick_stats = {'dispatches': 0, 'chained': 0, 'flushes': 0,
+                           'host_gap_s': 0.0, 'gap_samples': 0}
         self._prefix_entries = self._new_prefix_index()
         self.model = Transformer(self.cfg)
         self._rng = jax.random.PRNGKey(rng_seed)
@@ -555,9 +678,12 @@ class ContinuousBatchingEngine:
         self._prefill_continue = jax.jit(self._prefill_continue_impl)
         self._insert = jax.jit(self._insert_impl,
                                donate_argnames=('cache',))
-        self._decode = jax.jit(self._decode_impl,
+        # Both decode steps return the NEXT step's device feed in-graph
+        # (sampled tokens + advanced positions) — the device-resident
+        # feedback loop behind zero-upload ticks and async lookahead.
+        self._decode = jax.jit(self._decode_step_impl,
                                donate_argnames=('cache',))
-        self._decode_multi = jax.jit(self._decode_multi_impl,
+        self._decode_multi = jax.jit(self._decode_multi_feed_impl,
                                      donate_argnames=('cache',))
         self._verify = jax.jit(self._verify_impl,
                                donate_argnames=('cache',))
@@ -741,6 +867,32 @@ class ContinuousBatchingEngine:
             body, (cache, tokens, positions), rngs)
         return toks.swapaxes(0, 1), cache
 
+    def _decode_step_impl(self, params, cache, tokens, positions, temps,
+                          rng, tables=None):
+        """One all-slots step from 1-D feed arrays; returns
+        ((num_slots, 1) emit columns, the NEXT step's (tokens,
+        positions) feed, cache). The feed is computed in-graph — the
+        sampled tokens become the next input and positions advance by
+        +1 on device — so a steady-state tick never round-trips either
+        through the host. Inert rows (empty/prefilling slots) ride
+        along with advancing positions: their writes clamp into
+        harmless cache (contiguous: their own row, overwritten whole by
+        the next _insert; paged: the scratch block) and are never
+        read."""
+        out, cache = self._decode_impl(params, cache, tokens[:, None],
+                                       positions[:, None], temps, rng,
+                                       tables)
+        return out[:, None], (out, positions + 1), cache
+
+    def _decode_multi_feed_impl(self, params, cache, tokens, positions,
+                                temps, rngs, tables=None):
+        """K-step variant of _decode_step_impl (K = rngs' leading dim):
+        ((num_slots, K) columns, next feed, cache)."""
+        toks, cache = self._decode_multi_impl(params, cache, tokens,
+                                              positions, temps, rngs,
+                                              tables)
+        return toks, (toks[:, -1], positions + rngs.shape[0]), cache
+
     def _prefill_chunk_impl(self, params, cache, tokens, tables, start,
                             true_n):
         """One chunked-prefill step on the PAGED pool: process the
@@ -881,11 +1033,10 @@ class ContinuousBatchingEngine:
         self._rng, rng = jax.random.split(self._rng)
         out, accepted, cache = self._verify(
             self.params, self._cache,
-            jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(positions, jnp.int32),
-            jnp.asarray(temps, jnp.float32), rng)
+            _upload(tokens, jnp.int32),
+            _upload(positions, jnp.int32),
+            _upload(temps, jnp.float32), rng)
         self._commit_gen(gen, lambda: setattr(self, '_cache', cache))
-        import numpy as np
         out = np.asarray(out)
         accepted = np.asarray(accepted)
         # Acceptance-rate bookkeeping counts only slots that contributed
@@ -903,7 +1054,6 @@ class ContinuousBatchingEngine:
 
     def _ensure_thread(self) -> None:
         import threading
-        import time as time_lib
         with self._thread_lock:
             if self._thread is None or not self._thread.is_alive():
                 self._stop.clear()
@@ -932,7 +1082,6 @@ class ContinuousBatchingEngine:
         or dead engine thread and recovers: in-flight futures fail with
         a clean EngineWedgedError and the next submit starts a fresh
         engine thread over fresh state."""
-        import time as time_lib
         interval = max(0.01, min(self.watchdog_timeout / 4, 1.0))
         while not self._stop.is_set():
             self._stop.wait(interval)
@@ -965,7 +1114,6 @@ class ContinuousBatchingEngine:
 
     def _recover_from_wedge(self, why: str) -> None:
         import queue as queue_lib
-        import time as time_lib
         with self._thread_lock:
             self._generation += 1
             old_slots = self._slots
@@ -975,6 +1123,19 @@ class ContinuousBatchingEngine:
             # The wedged thread may hold (or have donated) the old
             # cache mid-dispatch; the successor re-initializes its own.
             self._cache = None
+            # Pipeline state dies with the generation: an in-flight
+            # lookahead dispatch (and any device feed chained off it)
+            # belongs to requests that are being failed right here —
+            # the successor must never emit or chain from it. (The
+            # stale thread also re-checks generation before emitting,
+            # so this is belt and braces.)
+            self._inflight = None
+            self._feed = None
+            self._temps_sig = None
+            self._temps_cache = None
+            self._table_sig = None
+            self._table_cache = None
+            self._last_ready = None
             if self.paged_block_size:
                 # Fresh pool/prefix objects (not clears): the abandoned
                 # thread keeps mutating ITS objects harmlessly, same
@@ -1097,13 +1258,12 @@ class ContinuousBatchingEngine:
         clipped pad-token writes — point at the scratch block (0).
         `None` rows (empty/prefilling slots in a decode tick) are all
         scratch."""
-        import numpy as np
         width = self._blocks_per_seq + 1
         table = np.zeros((len(reqs), width), np.int32)
         for row, req in enumerate(reqs):
             if req is not None and req.blocks:
                 table[row, :len(req.blocks)] = req.blocks
-        return jnp.asarray(table)
+        return _upload(table)
 
     def _admit_paged(self, slot: int, req: '_Request',
                      gen: int = -1) -> None:
@@ -1147,9 +1307,8 @@ class ContinuousBatchingEngine:
                     blocks.clear()   # shed path must not double-release
                     raise
                 pool_arr = self._cow_fn(self._cache,
-                                        jnp.asarray(entry[full],
-                                                    jnp.int32),
-                                        jnp.asarray(dst, jnp.int32))
+                                        _upload(entry[full], jnp.int32),
+                                        _upload(dst, jnp.int32))
                 if gen >= 0:
                     self._commit_gen(
                         gen, lambda: setattr(self, '_cache', pool_arr))
@@ -1201,7 +1360,6 @@ class ContinuousBatchingEngine:
         final chunk's logits seed the first sampled token (TTFT) and
         flip the slot to decoding; the prompt's blocks publish to the
         prefix LRU."""
-        import time as time_lib
         self._check_gen(gen)  # don't let a stale thread leak blocks
                               # from a successor's pool
         for slot in prefilling:
@@ -1224,10 +1382,10 @@ class ContinuousBatchingEngine:
                 [0] * (self.prefill_chunk - n)
             logits, pool_arr = self._prefill_chunk_fn(
                 self.params, self._cache,
-                jnp.asarray([chunk], jnp.int32),
+                _upload([chunk], jnp.int32),
                 self._table_array([req]),
-                jnp.asarray(start, jnp.int32),
-                jnp.asarray(n, jnp.int32))
+                _upload(start, jnp.int32),
+                _upload(n, jnp.int32))
             self._commit_gen(gen,
                              lambda: setattr(self, '_cache', pool_arr))
             req.prefill_pos = start + n
@@ -1261,7 +1419,6 @@ class ContinuousBatchingEngine:
         }
 
     def _admit(self, slot: int, req: '_Request', gen: int = -1) -> None:
-        import time
         if self.paged_block_size:
             self._admit_paged(slot, req, gen)
             return
@@ -1274,12 +1431,12 @@ class ContinuousBatchingEngine:
             # Continue from the cached prefix: only the suffix prefills.
             suffix = req.ids[plen:]
             bucket = self._bucket(len(suffix))
-            tokens = jnp.asarray([suffix + [0] * (bucket - len(suffix))],
-                                 jnp.int32)
+            tokens = _upload([suffix + [0] * (bucket - len(suffix))],
+                             jnp.int32)
             logits, cache1 = self._prefill_continue(
                 self.params, pcache, tokens,
-                jnp.asarray(plen, jnp.int32),
-                jnp.asarray(len(suffix), jnp.int32))
+                _upload(plen, jnp.int32),
+                _upload(len(suffix), jnp.int32))
             self.prefix_stats['hits'] += 1
             self.prefix_stats['tokens_reused'] += plen
             _PREFIX_HIT.inc()
@@ -1287,9 +1444,9 @@ class ContinuousBatchingEngine:
         else:
             bucket = self._bucket(true_len)
             padded = req.ids + [0] * (bucket - true_len)
-            tokens = jnp.asarray([padded], jnp.int32)
+            tokens = _upload([padded], jnp.int32)
             logits, cache1 = self._prefill(
-                self.params, tokens, jnp.asarray(true_len, jnp.int32))
+                self.params, tokens, _upload(true_len, jnp.int32))
             if self.prefix_cache:
                 self.prefix_stats['misses'] += 1
                 _PREFIX_MISS.inc()
@@ -1301,14 +1458,14 @@ class ContinuousBatchingEngine:
             # holding it is safe.
             self._store_prefix(req.ids, cache1)
         first = self._sample(logits, req.temperature)
-        req.first_token_time = time.monotonic()
+        req.first_token_time = time_lib.monotonic()
         _TTFT_HIST.observe(req.first_token_time - req.submit_time)
         req.tokens.append(first)
         _TOKENS_TOTAL.inc()  # the first token lands here, not in _emit
         self._notify(req, first)
         req.next_pos = true_len
         cache = self._insert(self._cache, cache1,
-                             jnp.asarray(slot, jnp.int32))
+                             _upload(slot, jnp.int32))
 
         def _commit():
             self._cache = cache
@@ -1332,13 +1489,12 @@ class ContinuousBatchingEngine:
             req.on_token = None
 
     def _finish(self, slots, slot: int) -> None:
-        import time
         req = slots[slot]
         slots[slot] = None
         # Paged: return block refs; blocks shared with a prefix entry
         # stay alive (refcount > 0), private suffix blocks free now.
         self._release_blocks(req)
-        now = time.monotonic()
+        now = time_lib.monotonic()
         stats = {
             'ttft_s': req.first_token_time - req.submit_time,
             'total_s': now - req.submit_time,
@@ -1363,7 +1519,6 @@ class ContinuousBatchingEngine:
 
     def _loop(self) -> None:
         import contextlib
-        import time as time_lib
         gen = self._generation
         ctx = self.mesh if self.mesh is not None else \
             contextlib.nullcontext()
@@ -1406,6 +1561,13 @@ class ContinuousBatchingEngine:
 
                     def _reset_state(fresh_cache=fresh_cache):
                         self._cache = fresh_cache
+                        # The failed tick's pipeline state is untrusted:
+                        # a pending lookahead dispatch (and the device
+                        # feed chained off it) must never be emitted —
+                        # its requests were just failed above.
+                        self._inflight = None
+                        self._feed = None
+                        self._last_ready = None
                         if self.paged_block_size:
                             # Fresh pool + prefix index: the failed
                             # tick's block bookkeeping is untrusted.
@@ -1424,7 +1586,6 @@ class ContinuousBatchingEngine:
                     self._warm_tick = True
 
     def _tick(self, gen: int) -> None:
-        import time as time_lib
         self._check_gen(gen)
         # Snapshot the slot table AND the queue: every read/write in
         # this tick goes to THESE objects. If the watchdog abandons the
@@ -1561,6 +1722,12 @@ class ContinuousBatchingEngine:
         # not freshen the heartbeat and mask a successor's wedge.
         if self._generation == gen:
             self._heartbeat = time_lib.monotonic()
+        if self._admitting_tick:
+            # Admission/prefill work (and its possible compiles) sits
+            # between result consumption and this tick's dispatch:
+            # exclude the tick from the steady-state host-gap
+            # histogram rather than record a bring-up outlier.
+            self._last_ready = None
         self._admitting_tick = False
         active = [i for i, r in enumerate(slots)
                   if r is not None and not r.prefilling]
@@ -1574,10 +1741,24 @@ class ContinuousBatchingEngine:
             # while recording is disabled is a no-op.
             _PAGED_CAPACITY.set(self._pool.num_blocks)
             _PAGED_USED.set(self._pool.used)
+        infl = self._inflight
+        if infl is not None and infl.gen != gen:
+            # A recovery swapped engine state since that dispatch was
+            # issued: its requests were already failed — nothing from
+            # it may ever be emitted.
+            infl = None
+            self._inflight = None
         if not active:
-            if not prefilling:
+            if infl is not None:
+                # Lookahead overshoot for requests that all finished
+                # (or were killed) at the previous emit: consume the
+                # columns so nothing dangles, discarding by identity.
+                self._consume_inflight(slots, gen)
+            elif not prefilling:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
+            _DISPATCH_AHEAD.set(0)
+            self._last_ready = None
             return
         # Chaos harness: tests/SKYTPU_FAULTS can fail or wedge the
         # decode step here; disarmed this is a single boolean check.
@@ -1588,6 +1769,16 @@ class ContinuousBatchingEngine:
         # emit one token per slot — use the plain/chunked path instead.
         any_greedy = any(slots[i].temperature <= 0 for i in active)
         if self.speculative > 0 and any_greedy:
+            if infl is not None:
+                # Spec ticks sample and emit in the same tick: the
+                # pending lookahead's tokens must land first or the
+                # per-request stream would reorder.
+                self._consume_inflight(slots, gen)
+                infl = None
+                self.tick_stats['flushes'] += 1
+                active = [i for i in active if slots[i] is not None]
+                if not active:
+                    return
             spec = self._spec_tick(slots, active, gen)
             if spec is not None:
                 out, valid = spec
@@ -1613,28 +1804,68 @@ class ContinuousBatchingEngine:
                 >= self.decode_chunk for i in active)
             if window_ok:
                 k = self.decode_chunk
-        # Prefilling slots have no sampled token yet: they ride the
-        # dispatch as inert rows (scratch-table writes, outputs
-        # discarded), exactly like empty slots.
+        if infl is not None:
+            if self._can_chain(infl, slots, active, k):
+                # Steady state: dispatch step N+1 off step N's in-graph
+                # feed BEFORE consuming N — the device queues it behind
+                # N while every line of host work below (emit, metrics,
+                # and the next tick's deadline/queue/admission scan)
+                # overlaps its compute.
+                self._dispatch(slots, active, k, gen, chain=infl)
+                self._consume_inflight(slots, gen, infl)
+                return
+            # Perturbation (admission/finish/EOS churn, window edge,
+            # predictable termination): drain the pipeline, then
+            # dispatch this tick normally off host state.
+            self._consume_inflight(slots, gen)
+            self.tick_stats['flushes'] += 1
+            # The flushed emit may have finished slots / advanced
+            # positions: recompute the dispatch set.
+            active = [i for i in active if slots[i] is not None]
+            if not active:
+                _DISPATCH_AHEAD.set(0)
+                return
+            if k > 1 and not all(
+                    self.cfg.max_seq_len - slots[i].next_pos >= k
+                    for i in active):
+                k = 1
+        out_dev = self._dispatch(slots, active, k, gen)
+        if self.async_depth:
+            # Pipeline fill: this dispatch is consumed (and emitted)
+            # one tick late; its host copy is already in flight.
+            return
+        out_cols = np.asarray(out_dev)
+        self._last_ready = time_lib.monotonic()
+        self._emit(slots, active, out_cols, None)
+
+    def _dispatch(self, slots, active, k, gen,
+                  chain: 'Optional[_Inflight]' = None):
+        """Issue one k-step decode dispatch for `active` slots and
+        return its device output columns (num_slots, k).
+
+        Inputs are device-resident whenever possible: with `chain`
+        (the still-unconsumed previous dispatch) the feed arrays it
+        returned in-graph are used directly — zero uploads; otherwise
+        the cached feed is reused when its signature matches the host
+        state, else rebuilt from host lists (slot churn). The temps
+        array caches under a value signature the same way. In async
+        mode the result is recorded as the new in-flight lookahead
+        with its host copy started."""
+        # `base` = tokens already dispatched but not yet emitted for
+        # every active slot: positions in this dispatch start at
+        # next_pos + base.
+        base = 0 if chain is None else chain.k
         active_set = set(active)
-        tokens = [(slots[i].tokens[-1]
-                   if i in active_set else 0)
-                  for i in range(self.num_slots)]
-        positions = [(slots[i].next_pos
-                      if i in active_set else 0)
-                     for i in range(self.num_slots)]
-        temps = [(slots[i].temperature
-                  if i in active_set else 0.0)
-                 for i in range(self.num_slots)]
         tables = None
         if self.paged_block_size:
-            # Cover every position this dispatch writes (k steps) so
-            # the table stays fixed across the scanned chunk.
+            # Cover every position this dispatch writes (k steps past
+            # the pending columns) so the table stays fixed across the
+            # scanned chunk — and across the lookahead step.
             try:
                 for i in active:
                     self._ensure_blocks(req=slots[i],
                                         upto_pos=min(
-                                            slots[i].next_pos + k,
+                                            slots[i].next_pos + base + k,
                                             self.cfg.max_seq_len))
             except kv_cache_lib.PoolExhaustedError as e:
                 # Can only happen with an undersized explicit pool:
@@ -1658,27 +1889,117 @@ class ContinuousBatchingEngine:
                      for i in range(self.num_slots)])
                 self._table_sig = sig
             tables = self._table_cache
+        tsig = tuple(slots[i].temperature if i in active_set else 0.0
+                     for i in range(self.num_slots))
+        if tsig != self._temps_sig:
+            self._temps_cache = _upload(list(tsig), jnp.float32)
+            self._temps_sig = tsig
+        temps = self._temps_cache
+        if chain is not None:
+            tok_dev, pos_dev = chain.feed
+            gap = 0.0   # the device never ran dry: N+1 queued behind N
+            self.tick_stats['chained'] += 1
+        else:
+            cur_sig = tuple(
+                (slots[i].seq, slots[i].next_pos)
+                if i in active_set else None
+                for i in range(self.num_slots))
+            feed = self._feed
+            if feed is not None and feed[2] == cur_sig:
+                tok_dev, pos_dev = feed[0], feed[1]
+            else:
+                # Slot churn (or cold start): rebuild from host state —
+                # every value here is already host-resident, so this
+                # costs two small uploads, never a device sync.
+                tok_dev = _upload([(slots[i].tokens[-1]
+                                    if i in active_set else 0)
+                                   for i in range(self.num_slots)],
+                                  jnp.int32)
+                pos_dev = _upload([(slots[i].next_pos
+                                    if i in active_set else 0)
+                                   for i in range(self.num_slots)],
+                                  jnp.int32)
+            gap = (time_lib.monotonic() - self._last_ready
+                   if self._last_ready is not None else None)
         self._rng, rng = jax.random.split(self._rng)
-        import numpy as np
         if k == 1:
-            out_tokens, cache = self._decode(
-                self.params, self._cache,
-                jnp.asarray(tokens, jnp.int32)[:, None],
-                jnp.asarray(positions, jnp.int32)[:, None],
-                jnp.asarray(temps, jnp.float32), rng, tables)
-            out_cols = np.asarray(out_tokens)[:, None]
+            out_cols, feed_next, cache = self._decode(
+                self.params, self._cache, tok_dev, pos_dev, temps, rng,
+                tables)
         else:
             rngs = jax.random.split(rng, k)
-            out_tokens, cache = self._decode_multi(
-                self.params, self._cache,
-                jnp.asarray(tokens, jnp.int32),
-                jnp.asarray(positions, jnp.int32),
-                jnp.asarray(temps, jnp.float32), rngs, tables)
-            out_cols = np.asarray(out_tokens)     # (num_slots, k)
+            out_cols, feed_next, cache = self._decode_multi(
+                self.params, self._cache, tok_dev, pos_dev, temps,
+                rngs, tables)
         self._commit_gen(gen, lambda: setattr(self, '_cache', cache))
         self._decode_steps += k
         self.step_log.append((self._decode_steps, frozenset(active)))
-        self._emit(slots, active, out_cols, None)
+        # The feed predicts host state AFTER every pending emit lands:
+        # (seq, next_pos + base + k) per active slot.
+        pred_sig = tuple(
+            (slots[i].seq, slots[i].next_pos + base + k)
+            if i in active_set else None
+            for i in range(self.num_slots))
+        self._feed = (feed_next[0], feed_next[1], pred_sig)
+        self.tick_stats['dispatches'] += 1
+        if gap is not None:
+            _HOST_GAP_HIST.observe(gap)
+            self.tick_stats['host_gap_s'] += gap
+            self.tick_stats['gap_samples'] += 1
+        if self.async_depth:
+            out_cols.copy_to_host_async()
+            self._inflight = _Inflight(out_cols, feed_next,
+                                       tuple(slots), list(active), k,
+                                       gen)
+            _DISPATCH_AHEAD.set(1)
+        return out_cols
+
+    def _can_chain(self, infl: '_Inflight', slots, active,
+                   k: int) -> bool:
+        """True iff the pending lookahead's in-graph feed is a valid
+        input for the next dispatch: the slot population is exactly as
+        dispatched and no active request predictably terminates when
+        the pending columns land (max-tokens or window; EOS is
+        unpredictable by design and costs one discarded dispatch).
+        `k` is the NEXT dispatch's step count."""
+        if active != infl.active:
+            return False
+        msl = self.cfg.max_seq_len
+        for i in infl.active:
+            req = slots[i]
+            if req is not infl.reqs[i]:
+                return False    # finished/killed and maybe re-admitted
+            if len(req.tokens) + infl.k >= req.max_new_tokens:
+                return False    # finishes at the pending emit
+            if req.next_pos + infl.k + 1 >= msl:
+                return False    # window termination at the pending emit
+            if req.next_pos + infl.k + k > msl:
+                return False    # lookahead would write past the window
+        return True
+
+    def _consume_inflight(self, slots, gen: int,
+                          infl: 'Optional[_Inflight]' = None) -> None:
+        """Land the pending lookahead's tokens (its host copy started
+        at dispatch) and emit them. Columns whose slot changed hands
+        since dispatch — EOS overshoot after a finish, a deadline
+        kill, admission churn — are discarded by request IDENTITY,
+        never by position arithmetic. With `infl` passed explicitly
+        (the chained fast path) the CURRENT in-flight record — the
+        freshly chained dispatch — is left untouched."""
+        if infl is None:
+            infl = self._inflight
+            self._inflight = None
+            _DISPATCH_AHEAD.set(0)
+            if infl is None:
+                return
+        out_cols = np.asarray(infl.out)   # blocks until N is done
+        self._last_ready = time_lib.monotonic()
+        # The wait above may span a watchdog recovery: never emit into
+        # a successor's world.
+        self._check_gen(gen)
+        live = [i for i in infl.active if slots[i] is infl.reqs[i]]
+        if live:
+            self._emit(slots, live, out_cols, None)
 
     def _emit(self, slots, active, out_cols, valid) -> None:
         """Append per-slot output columns (up to valid[slot] of them —
@@ -1688,13 +2009,12 @@ class ContinuousBatchingEngine:
             req = slots[slot]
             limit = (out_cols.shape[1] if valid is None
                      else int(valid[slot]))
+            emitted = 0
             for c in range(limit):
                 req.next_pos += 1
                 token = int(out_cols[slot, c])
                 req.tokens.append(token)
-                # Per-token counter: with no exporter attached this is
-                # one boolean check, nothing more (acceptance-pinned).
-                _TOKENS_TOTAL.inc()
+                emitted += 1
                 self._notify(req, token)
                 done = (len(req.tokens) >= req.max_new_tokens or
                         (req.eos_id is not None
@@ -1707,6 +2027,10 @@ class ContinuousBatchingEngine:
                     # next admitted request's _insert.
                     self._finish(slots, slot)
                     break
+            # Coalesced per-slot-per-tick (was one inc() per token —
+            # even the disabled-path boolean check adds up in the
+            # hottest loop in the codebase).
+            _TOKENS_TOTAL.inc(emitted)
 
     # ---------------- public api ----------------
 
@@ -1803,7 +2127,6 @@ class ContinuousBatchingEngine:
         EngineDrainingError — a drain must never leave a caller blocked
         on a future nobody will resolve."""
         import queue as queue_lib
-        import time as time_lib
         with self._thread_lock:
             self._draining = True
         deadline = (time_lib.monotonic() + timeout

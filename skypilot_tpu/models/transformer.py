@@ -361,10 +361,17 @@ class Attention(nn.Module):
         in-tree engine behind serve replicas.)
 
         INVARIANT (caller-enforced — see InferenceEngine.generate's
-        length assert): per-row positions stay < max_seq_len and each
-        chunk is written contiguously from positions[:, 0]. Positions are
-        traced, so this cannot be checked here; past the window,
-        dynamic_update_slice clamps and silently overwrites old entries.
+        length assert): per-row positions stay < max_seq_len for every
+        row whose OUTPUT is consumed, and each chunk is written
+        contiguously from positions[:, 0]. Positions are traced, so
+        this cannot be checked here; past the window,
+        dynamic_update_slice clamps and silently overwrites old
+        entries. The continuous-batching engine's device-resident feed
+        leans on that clamp: inert rows (empty/prefilling slots) ride
+        decode dispatches with in-graph-advancing positions, their
+        writes land in their own row (contiguous — overwritten whole by
+        the next _insert) or the scratch block (paged), and their
+        outputs are never read (models/inference.py, async pipeline).
         """
         cfg = self.cfg
         batch, cur_len, _, _ = q.shape

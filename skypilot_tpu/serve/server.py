@@ -123,7 +123,8 @@ class InferenceServer:
                  watchdog_timeout: float = 0.0,
                  paged_block_size: int = 0,
                  paged_num_blocks: Optional[int] = None,
-                 prefill_chunk: int = 0) -> None:
+                 prefill_chunk: int = 0,
+                 async_depth: int = 0) -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
@@ -162,7 +163,8 @@ class InferenceServer:
                                                    watchdog_timeout or None),
                                                paged_block_size=paged_block_size,
                                                paged_num_blocks=paged_num_blocks,
-                                               prefill_chunk=prefill_chunk)
+                                               prefill_chunk=prefill_chunk,
+                                               async_depth=async_depth)
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
         if tokenizer.startswith('hf:'):
@@ -897,6 +899,16 @@ def main(argv=None) -> int:
                              'tick — ONE compiled prefill shape, long '
                              'prompts interleave with decode (default: '
                              'block size)')
+    parser.add_argument('--async-depth', type=int, default=0,
+                        choices=[0, 1],
+                        help='async decode pipeline: 1 dispatches each '
+                             'decode step one tick ahead off the '
+                             'previous step\'s device output, so host '
+                             'scheduling overlaps device compute (EOS '
+                             'detected one step late, overshoot '
+                             'discarded — token streams stay bit-'
+                             'identical; see docs/performance.md). '
+                             '0 = synchronous ticks')
     parser.add_argument('--max-queue', type=int, default=64,
                         help='admission control: queued-request cap; '
                              'beyond it requests are shed with 429/503 '
@@ -936,7 +948,8 @@ def main(argv=None) -> int:
                              watchdog_timeout=args.watchdog_timeout,
                              paged_block_size=args.paged_block_size,
                              paged_num_blocks=args.paged_num_blocks,
-                             prefill_chunk=args.prefill_chunk)
+                             prefill_chunk=args.prefill_chunk,
+                             async_depth=args.async_depth)
     logger.info('sampling filters: top_k=%s top_p=%s (0 = off)',
                 args.top_k, args.top_p)
     server.warmup()
