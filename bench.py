@@ -83,6 +83,11 @@ def _parse_args(argv=None):
                         help='serving engine int8 weight-only variant')
     parser.add_argument('--kv-quant', default=None, choices=['int8'],
                         help='serving engine int8 KV cache variant')
+    parser.add_argument('--int8-kv', action='store_true',
+                        help='shorthand for --kv-quant int8; composes '
+                             'with --paged-block-size (int8 block '
+                             'pool: the serve row reports the pool '
+                             'bytes saved) and --async-depth N')
     parser.add_argument('--decode-chunk', type=int, default=8,
                         help='decode steps per dispatch for the serve '
                              'row (amortizes tunnel round-trips)')
@@ -99,12 +104,12 @@ def _parse_args(argv=None):
                              'chunked prefill); the row reports pool '
                              'occupancy')
     parser.add_argument('--async-depth', type=int, default=0,
-                        choices=[0, 1],
-                        help='serve row: async decode pipeline — '
-                             'dispatch each decode step one tick ahead '
-                             'off the previous step\'s device output; '
+                        help='serve row: async decode pipeline — a '
+                             'ring of N in-flight decode dispatches '
+                             'chained off each other\'s device output; '
                              'the row reports the host-gap fraction '
-                             'the pipeline removes')
+                             'the pipeline removes and the chained-'
+                             'dispatch count (0 = synchronous ticks)')
     parser.add_argument('--tune-attn', action='store_true',
                         help='sweep flash-attention block sizes per '
                              'sequence length (fwd+bwd wall time) and '
@@ -256,6 +261,20 @@ def _attempt_loop(cmd, env, partial_path) -> int:
                     print(line)
                     return 0
             last_note = 'worker exited 0 but printed no JSON result line'
+        elif rc == 3:
+            # The worker itself emitted a structured skip (an
+            # unsupported flag combination — deterministic, not a
+            # flaky device): forward its {"skipped": true, ...} line
+            # verbatim; retrying cannot change the verdict.
+            for line in reversed(out.splitlines()):
+                try:
+                    parsed = json.loads(line)
+                except (json.JSONDecodeError, ValueError):
+                    continue
+                if isinstance(parsed, dict) and parsed.get('skipped'):
+                    print(line)
+                    return 3
+            last_note = 'worker exited rc=3 without a skip line'
         elif rc != -1:
             last_note = f'worker exited rc={rc}'
         # A later row died — salvage the rows that completed.
@@ -296,6 +315,13 @@ def _append_partial(row: dict) -> None:
         pass
 
 
+class _UnsupportedServeCombo(Exception):
+    """Engine CONSTRUCTION rejected the flag combination — a
+    deterministic verdict worth a structured skip (never retried).
+    Errors raised after construction are real failures and propagate
+    as themselves."""
+
+
 def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
                   kv_quant=None, speculative=0, prefix_cache=0,
                   paged_block_size=0, async_depth=0) -> dict:
@@ -305,11 +331,14 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
     import time as time_lib
 
     from skypilot_tpu.models import inference as inference_lib
-    engine = inference_lib.ContinuousBatchingEngine(
-        cfg, num_slots=4, mesh=mesh, quantize=quantize,
-        decode_chunk=decode_chunk, kv_quant=kv_quant,
-        speculative=speculative, prefix_cache=prefix_cache,
-        paged_block_size=paged_block_size, async_depth=async_depth)
+    try:
+        engine = inference_lib.ContinuousBatchingEngine(
+            cfg, num_slots=4, mesh=mesh, quantize=quantize,
+            decode_chunk=decode_chunk, kv_quant=kv_quant,
+            speculative=speculative, prefix_cache=prefix_cache,
+            paged_block_size=paged_block_size, async_depth=async_depth)
+    except (ValueError, NotImplementedError) as e:
+        raise _UnsupportedServeCombo(str(e)) from e
     prompt = list(range(1, 33))
     # Warmup: compile prefill + decode (and the verify step, if on).
     engine.generate(prompt, max_new_tokens=4)
@@ -384,6 +413,12 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
         row['paged_blocks_reused'] = occupancy['blocks_reused']
         row['paged_cow_copies'] = occupancy['cow_copies']
         row['paged_prefill_chunks'] = occupancy['prefill_chunks']
+        if engine.paged_int8_bytes_saved:
+            # int8 block pool: HBM the quantized pool saves vs the
+            # float pool (models/kv_cache.int8_pool_bytes_saved).
+            row['paged_int8_bytes_saved'] = engine.paged_int8_bytes_saved
+            row['paged_int8_mb_saved'] = round(
+                engine.paged_int8_bytes_saved / 2**20, 1)
     return row
 
 
@@ -501,6 +536,8 @@ def _worker(args) -> int:
     from skypilot_tpu.models import get_config
     from skypilot_tpu.parallel import build_mesh, infer_mesh_config
 
+    if args.int8_kv:
+        args.kv_quant = 'int8'   # --int8-kv is shorthand for this
     init_start = time.time()
     try:
         devices = jax.devices()
@@ -535,13 +572,29 @@ def _worker(args) -> int:
 
     if args.serve:
         serve_cfg = get_config(model_name, param_dtype='bfloat16')
-        ttft = _measure_ttft(serve_cfg, mesh, quantize=args.quantize,
-                             decode_chunk=args.decode_chunk,
-                             kv_quant=args.kv_quant,
-                             speculative=args.speculative,
-                             prefix_cache=args.prefix_cache,
-                             paged_block_size=args.paged_block_size,
-                             async_depth=args.async_depth)
+        try:
+            ttft = _measure_ttft(serve_cfg, mesh, quantize=args.quantize,
+                                 decode_chunk=args.decode_chunk,
+                                 kv_quant=args.kv_quant,
+                                 speculative=args.speculative,
+                                 prefix_cache=args.prefix_cache,
+                                 paged_block_size=args.paged_block_size,
+                                 async_depth=args.async_depth)
+        except _UnsupportedServeCombo as e:
+            # An unrunnable flag combination (block size not dividing
+            # the window, an unknown quant mode, ...) must still honor
+            # the one-JSON-line contract: a structured skip naming the
+            # combo, not a stack trace with nothing to parse. Only
+            # CONSTRUCTION failures qualify — a ValueError raised
+            # mid-measurement is a real failure and must propagate,
+            # not masquerade as a deterministic skip.
+            _emit_skip(
+                f'unsupported serve combination: {e}',
+                combo={'kv_quant': args.kv_quant or 'none',
+                       'speculative': args.speculative,
+                       'paged_block_size': args.paged_block_size,
+                       'async_depth': args.async_depth})
+            return 3
         print(f'serve: {ttft}', file=sys.stderr)
         tags = [t for t in (args.quantize,
                             f'kv-{args.kv_quant}' if args.kv_quant

@@ -146,6 +146,11 @@ class ModelConfig:
     # the engine's scratch block (pad/inactive-row writes land there).
     # HBM then scales with TOKENS HELD, not slots × max_seq_len — see
     # docs/performance.md. 0 ⇒ the contiguous reference layout.
+    # Composes with kv_cache_quant='int8' (the pool stores int8 K/V
+    # plus per-token scale rows laid out per block — the HBM wins
+    # multiply) and with multi-token chunks at arbitrary per-row
+    # positions (chunked prefill AND speculative verification read the
+    # logical window through the same block-table gather).
     paged_block_size: int = 0
     paged_num_blocks: int = 0
 

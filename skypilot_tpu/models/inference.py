@@ -96,6 +96,20 @@ _PAGED_COW = obs.counter(
 _CHUNKED_PREFILL = obs.counter(
     'skytpu_engine_chunked_prefill_ticks_total',
     'Prefill chunks processed (interleaved between decode ticks)')
+_PAGED_INT8_SAVED = obs.gauge(
+    'skytpu_engine_paged_int8_bytes_saved',
+    'HBM bytes the int8-quantized paged pool saves vs the same pool '
+    'at the float dtype (payload fp->1 byte minus the fp32 scale rows, '
+    'both K and V, all layers)')
+_SPEC_PAGED_ACCEPTED = obs.counter(
+    'skytpu_engine_spec_paged_accepted_total',
+    'Speculative drafts accepted by verification through paged '
+    'block-table gathers (the paged x speculative composition)')
+_DISPATCH_AHEAD_DEPTH = obs.histogram(
+    'skytpu_engine_dispatch_ahead_depth',
+    'In-flight decode dispatches (ring depth) observed as each '
+    'dispatch is issued — how deep the async lookahead actually runs',
+    buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0))
 _HOST_GAP_HIST = obs.histogram(
     'skytpu_engine_tick_host_gap_seconds',
     'Per decode dispatch: host time between consuming the previous '
@@ -149,14 +163,18 @@ _REQ_SEQ = itertools.count()
 class _Inflight:
     """One dispatched-but-not-yet-consumed decode step (async_depth>0).
 
-    `out` is the device array of sampled columns (num_slots, k) with
+    Lives in the engine's lookahead RING (oldest first, at most
+    async_depth entries after each tick consumes one): every entry was
+    chained in-graph off the previous one's feed, so all entries share
+    one slot snapshot — churn flushes the whole ring. `out` is the
+    device array of sampled columns (num_slots, k) with
     copy_to_host_async already started; `feed` is the NEXT step's
     device-resident input (tokens, positions) returned in-graph by the
     dispatch; `reqs` snapshots slot→request identity at dispatch time so
-    emission one tick later can discard columns whose slot changed hands
-    (EOS overshoot, deadline kills, admission churn); `gen` ties the
-    dispatch to the engine generation that issued it — a watchdog
-    recovery discards the record wholesale."""
+    emission up to async_depth ticks later can discard columns whose
+    slot changed hands (EOS overshoot, deadline kills, admission
+    churn); `gen` ties the dispatch to the engine generation that
+    issued it — a watchdog recovery discards the whole ring."""
 
     __slots__ = ('out', 'feed', 'reqs', 'active', 'k', 'gen')
 
@@ -602,12 +620,6 @@ class ContinuousBatchingEngine:
                 raise ValueError(
                     f'max_seq_len {self.cfg.max_seq_len} not divisible '
                     f'by paged_block_size {self.paged_block_size}')
-            if self.speculative:
-                raise ValueError('paged KV cache + speculative decoding '
-                                 'is not wired; pick one')
-            if self.cfg.kv_cache_quant:
-                raise ValueError('paged KV cache + int8 KV quantization '
-                                 'is not wired; pick one')
             self._blocks_per_seq = (self.cfg.max_seq_len //
                                     self.paged_block_size)
             # Default pool: every slot can reach max_seq_len plus full
@@ -629,23 +641,38 @@ class ContinuousBatchingEngine:
             self._pool = None
             self.prefill_chunk = 0
         self.paged_stats = {'cow_copies': 0, 'blocks_reused': 0,
-                            'prefill_chunks': 0, 'prefix_evictions': 0}
+                            'prefill_chunks': 0, 'prefix_evictions': 0,
+                            'spec_trimmed_blocks': 0}
+        # int8 block pool (the paged x int8-KV composition): the HBM
+        # win multiplies — the pool holds ~(fp_bytes x head_dim) /
+        # (head_dim + 4) times the tokens per byte on top of paged's
+        # tokens-held (not slots x max_seq_len) scaling.
+        self.paged_int8_bytes_saved = 0
+        if self.paged_block_size and self.cfg.kv_cache_quant == 'int8':
+            self.paged_int8_bytes_saved = \
+                kv_cache_lib.int8_pool_bytes_saved(
+                    self.cfg.paged_num_blocks, self.paged_block_size,
+                    self.cfg.num_kv_heads, self.cfg.head_dim,
+                    self.cfg.num_layers,
+                    jnp.dtype(self.cfg.dtype).itemsize)
+            _PAGED_INT8_SAVED.set(self.paged_int8_bytes_saved)
         # -------- async decode pipeline (docs/performance.md) --------
-        # async_depth=1 ⇒ one-step lookahead: the next decode step is
-        # dispatched off the previous step's DEVICE output before the
-        # host has even seen the tokens (JAX async dispatch queues it);
-        # copy_to_host_async lands step N while the device computes
-        # N+1, and all host work — deadlines, queue purge, admission,
-        # _emit, metrics — overlaps device compute. EOS/termination is
-        # detected one step late; the overshoot column is discarded
-        # (causally masked stale cache, same argument as speculative
-        # rejects). 0 = synchronous ticks (current behavior).
+        # async_depth=N ⇒ a RING of up to N in-flight decode
+        # dispatches: each chains in-graph off the previous one's
+        # device feed before the host has seen any of their tokens
+        # (JAX async dispatch queues them back to back);
+        # copy_to_host_async lands the oldest while the device computes
+        # the rest, and all host work — deadlines, queue purge,
+        # admission, _emit, metrics — overlaps device compute.
+        # EOS/termination is detected up to N steps late; overshoot
+        # columns are discarded by request identity (causally masked
+        # stale cache, same argument as speculative rejects). Any
+        # churn flushes the whole ring — one sync tick per churn
+        # event. 0 = synchronous ticks. Deeper rings pay on
+        # remote/tunneled chips where one host round-trip spans
+        # several device steps; they also multiply EOS-overshoot
+        # waste (docs/performance.md: when deeper lookahead pays).
         self.async_depth = max(0, async_depth)
-        if self.async_depth > 1:
-            raise ValueError('async_depth > 1 is not wired; only '
-                             'one-step lookahead (async_depth=1) pays '
-                             'before per-step compute shrinks below '
-                             'host-loop cost')
         # Decode-tick block-table cache (see _tick): rebuilt only when
         # the per-slot fingerprint changes.
         self._table_sig: Optional[tuple] = None
@@ -663,7 +690,9 @@ class ContinuousBatchingEngine:
         self._feed: Optional[tuple] = None          # (tok, pos, sig)
         self._temps_sig: Optional[tuple] = None
         self._temps_cache = None
-        self._inflight: Optional[_Inflight] = None  # lookahead dispatch
+        # Lookahead ring: dispatched-but-unconsumed decode steps,
+        # oldest first (≤ async_depth after each tick consumes one).
+        self._ring: 'collections.deque[_Inflight]' = collections.deque()
         # Host-gap accounting: monotonic stamp of the last consumed
         # dispatch result; None after idle/admission ticks so the
         # histogram records steady-state decode gaps only.
@@ -932,7 +961,8 @@ class ContinuousBatchingEngine:
 
         return jax.tree.map(cp, cache)
 
-    def _verify_impl(self, params, cache, tokens, positions, temps, rng):
+    def _verify_impl(self, params, cache, tokens, positions, temps, rng,
+                     tables=None):
         """Speculative verification: ONE forward over (num_slots, K+1)
         chunks [last_token, draft_1..draft_K] at per-row positions.
 
@@ -945,10 +975,19 @@ class ContinuousBatchingEngine:
         identical to a normal decode tick. Cache entries written for
         rejected positions sit at-or-after every future query position
         (causal-masked) until the following ticks overwrite them —
-        the same stale-entry argument as finished-slot overshoot."""
+        the same stale-entry argument as finished-slot overshoot.
+
+        Paged mode (`tables` given): the multi-token verify reads each
+        row's logical KV window through its block table — the same
+        gather-then-contiguous-math path chunked prefill uses — and
+        the engine pre-reserves blocks covering all K+1 write
+        positions, so the verify chunk never writes through an
+        unmapped table entry. Rejected drafts roll the block table
+        back host-side (_trim_blocks) instead of a contiguous cache
+        truncation."""
         logits, mutated = self.model.apply(
             {'params': params, 'cache': cache}, tokens, positions,
-            mutable=['cache'])
+            block_tables=tables, mutable=['cache'])
         logits = logits.astype(jnp.float32)        # (B, K+1, V)
         greedy = jnp.argmax(logits, axis=-1)       # (B, K+1)
         match = tokens[:, 1:] == greedy[:, :-1]    # (B, K) draft hits
@@ -1005,6 +1044,25 @@ class ContinuousBatchingEngine:
             req = slots[i]
             if self.cfg.max_seq_len - req.next_pos <= k:
                 return None
+        if self.paged_block_size:
+            # Reserve blocks covering every verify write position
+            # (next_pos .. next_pos+k) BEFORE dispatching, so the
+            # K+1-token chunk never writes through an unmapped table
+            # entry. Pool pressure degrades gracefully: fall back to
+            # the plain single-step path this tick.
+            try:
+                for i in active:
+                    self._ensure_blocks(
+                        slots[i], min(slots[i].next_pos + k + 1,
+                                      self.cfg.max_seq_len))
+            except kv_cache_lib.PoolExhaustedError:
+                # Roll back whatever the loop DID reserve before it
+                # hit the wall: holding unused verify-span blocks
+                # would deepen the very exhaustion that forced the
+                # single-step fallback.
+                for i in active:
+                    self._trim_blocks(slots[i])
+                return None
         tokens, positions = [], []
         real_draft_slots = set()
         for slot in range(self.num_slots):
@@ -1025,17 +1083,23 @@ class ContinuousBatchingEngine:
         if not real_draft_slots:
             # Every greedy slot drew a lookup blank: a verify tick would
             # emit 1 token/slot at (K+1)x forward cost — let the
-            # plain/chunked path take this round instead.
+            # plain/chunked path take this round instead (it reserves
+            # its own, shallower span — the verify-span blocks go back).
+            if self.paged_block_size:
+                for i in active:
+                    self._trim_blocks(slots[i])
             return None
         temps = [(slots[i].temperature
                   if slots[i] is not None else 0.0)
                  for i in range(self.num_slots)]
+        tables = (self._tables_for(slots, set(active))
+                  if self.paged_block_size else None)
         self._rng, rng = jax.random.split(self._rng)
         out, accepted, cache = self._verify(
             self.params, self._cache,
             _upload(tokens, jnp.int32),
             _upload(positions, jnp.int32),
-            _upload(temps, jnp.float32), rng)
+            _upload(temps, jnp.float32), rng, tables)
         self._commit_gen(gen, lambda: setattr(self, '_cache', cache))
         out = np.asarray(out)
         accepted = np.asarray(accepted)
@@ -1049,6 +1113,8 @@ class ContinuousBatchingEngine:
         self.spec_stats['accepted'] += int(accepted[drafted_active].sum())
         _SPEC_DRAFTED.inc(k * len(drafted_active))
         _SPEC_ACCEPTED.inc(int(accepted[drafted_active].sum()))
+        if self.paged_block_size:
+            _SPEC_PAGED_ACCEPTED.inc(int(accepted[drafted_active].sum()))
         valid = accepted + 1          # emit accepted drafts + 1 bonus
         return out, valid
 
@@ -1123,13 +1189,14 @@ class ContinuousBatchingEngine:
             # The wedged thread may hold (or have donated) the old
             # cache mid-dispatch; the successor re-initializes its own.
             self._cache = None
-            # Pipeline state dies with the generation: an in-flight
-            # lookahead dispatch (and any device feed chained off it)
-            # belongs to requests that are being failed right here —
-            # the successor must never emit or chain from it. (The
-            # stale thread also re-checks generation before emitting,
-            # so this is belt and braces.)
-            self._inflight = None
+            # Pipeline state dies with the generation: every in-flight
+            # lookahead dispatch in the ring (and any device feed
+            # chained off it) belongs to requests that are being
+            # failed right here — the successor must never emit or
+            # chain from any of them. (The stale thread also re-checks
+            # generation before emitting, so this is belt and braces.)
+            self._ring.clear()
+            _DISPATCH_AHEAD.set(0)
             self._feed = None
             self._temps_sig = None
             self._temps_cache = None
@@ -1264,6 +1331,38 @@ class ContinuousBatchingEngine:
             if req is not None and req.blocks:
                 table[row, :len(req.blocks)] = req.blocks
         return _upload(table)
+
+    def _trim_blocks(self, req: '_Request') -> None:
+        """Roll the block table back after a speculative tick: rejected
+        drafts' tail blocks (allocated to cover the K+1 verify span but
+        holding only causally-masked stale writes) return to the pool
+        NOW instead of riding the request to completion — the paged
+        analogue of the contiguous path's implicit cache truncation.
+        Keeps the block holding the next write position, so steady
+        acceptance never thrashes alloc/free. Trimmed blocks are always
+        private suffix blocks (published prefix entries cover at most
+        ceil(len(ids)/bs) ≤ ceil(next_pos/bs) blocks), so the decref
+        frees them outright."""
+        keep = -(-(req.next_pos + 1) // self.paged_block_size)
+        while len(req.blocks) > keep:
+            self._pool.decref(req.blocks.pop())
+            self.paged_stats['spec_trimmed_blocks'] += 1
+
+    def _tables_for(self, slots, active_set) -> jnp.ndarray:
+        """Per-slot block tables for a dispatch, cached under the
+        block-id fingerprint (tables only change at admission/finish/
+        block growth — steady-state ticks reuse the device array
+        instead of rebuilding + re-uploading it). Shared by the decode
+        and speculative-verify dispatch paths."""
+        sig = tuple(
+            tuple(slots[i].blocks) if i in active_set else None
+            for i in range(self.num_slots))
+        if sig != self._table_sig:
+            self._table_cache = self._table_array(
+                [slots[i] if i in active_set else None
+                 for i in range(self.num_slots)])
+            self._table_sig = sig
+        return self._table_cache
 
     def _admit_paged(self, slot: int, req: '_Request',
                      gen: int = -1) -> None:
@@ -1562,10 +1661,12 @@ class ContinuousBatchingEngine:
                     def _reset_state(fresh_cache=fresh_cache):
                         self._cache = fresh_cache
                         # The failed tick's pipeline state is untrusted:
-                        # a pending lookahead dispatch (and the device
-                        # feed chained off it) must never be emitted —
-                        # its requests were just failed above.
-                        self._inflight = None
+                        # every pending lookahead dispatch in the ring
+                        # (and the device feed chained off it) must
+                        # never be emitted — its requests were just
+                        # failed above.
+                        self._ring.clear()
+                        _DISPATCH_AHEAD.set(0)
                         self._feed = None
                         self._last_ready = None
                         if self.paged_block_size:
@@ -1741,19 +1842,21 @@ class ContinuousBatchingEngine:
             # while recording is disabled is a no-op.
             _PAGED_CAPACITY.set(self._pool.num_blocks)
             _PAGED_USED.set(self._pool.used)
-        infl = self._inflight
-        if infl is not None and infl.gen != gen:
-            # A recovery swapped engine state since that dispatch was
-            # issued: its requests were already failed — nothing from
-            # it may ever be emitted.
-            infl = None
-            self._inflight = None
+            if self.paged_int8_bytes_saved:
+                _PAGED_INT8_SAVED.set(self.paged_int8_bytes_saved)
+        ring = self._ring
+        if ring and ring[0].gen != gen:
+            # A recovery swapped engine state since those dispatches
+            # were issued: their requests were already failed —
+            # nothing from the ring may ever be emitted.
+            ring.clear()
+            _DISPATCH_AHEAD.set(0)
         if not active:
-            if infl is not None:
+            if ring:
                 # Lookahead overshoot for requests that all finished
-                # (or were killed) at the previous emit: consume the
+                # (or were killed) at the previous emits: consume the
                 # columns so nothing dangles, discarding by identity.
-                self._consume_inflight(slots, gen)
+                self._flush_ring(slots, gen)
             elif not prefilling:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -1769,12 +1872,11 @@ class ContinuousBatchingEngine:
         # emit one token per slot — use the plain/chunked path instead.
         any_greedy = any(slots[i].temperature <= 0 for i in active)
         if self.speculative > 0 and any_greedy:
-            if infl is not None:
-                # Spec ticks sample and emit in the same tick: the
+            if ring:
+                # Spec ticks sample and emit in the same tick: every
                 # pending lookahead's tokens must land first or the
                 # per-request stream would reorder.
-                self._consume_inflight(slots, gen)
-                infl = None
+                self._flush_ring(slots, gen)
                 self.tick_stats['flushes'] += 1
                 active = [i for i in active if slots[i] is not None]
                 if not active:
@@ -1786,6 +1888,13 @@ class ContinuousBatchingEngine:
                 self.step_log.append((self._decode_steps,
                                       frozenset(active)))
                 self._emit(slots, active, out, valid)
+                if self.paged_block_size:
+                    # Rejected drafts: hand the over-reserved tail
+                    # blocks back instead of holding them to
+                    # completion.
+                    for i in active:
+                        if slots[i] is not None:
+                            self._trim_blocks(slots[i])
                 return
             # else: a slot is near the cache window — single-step tick.
         # All-slots decode: K scanned steps per dispatch when nothing is
@@ -1804,22 +1913,29 @@ class ContinuousBatchingEngine:
                 >= self.decode_chunk for i in active)
             if window_ok:
                 k = self.decode_chunk
-        if infl is not None:
-            if self._can_chain(infl, slots, active, k):
-                # Steady state: dispatch step N+1 off step N's in-graph
-                # feed BEFORE consuming N — the device queues it behind
-                # N while every line of host work below (emit, metrics,
-                # and the next tick's deadline/queue/admission scan)
-                # overlaps its compute.
-                self._dispatch(slots, active, k, gen, chain=infl)
-                self._consume_inflight(slots, gen, infl)
+        if ring:
+            if self._can_chain(slots, active, k):
+                # Steady state: top the ring up to async_depth+1
+                # chained dispatches off the newest in-graph feed
+                # BEFORE consuming the oldest — the device queues them
+                # back to back while every line of host work below
+                # (emit, metrics, and the next tick's deadline/queue/
+                # admission scan) overlaps its compute. _can_chain is
+                # re-checked per added dispatch: the pending horizon
+                # grows with each one.
+                while (len(ring) <= self.async_depth and
+                       self._can_chain(slots, active, k)):
+                    self._dispatch(slots, active, k, gen,
+                                   chain=ring[-1])
+                self._consume_oldest(slots, gen)
+                _DISPATCH_AHEAD.set(len(ring))
                 return
             # Perturbation (admission/finish/EOS churn, window edge,
-            # predictable termination): drain the pipeline, then
+            # predictable termination): drain the whole pipeline, then
             # dispatch this tick normally off host state.
-            self._consume_inflight(slots, gen)
+            self._flush_ring(slots, gen)
             self.tick_stats['flushes'] += 1
-            # The flushed emit may have finished slots / advanced
+            # The flushed emits may have finished slots / advanced
             # positions: recompute the dispatch set.
             active = [i for i in active if slots[i] is not None]
             if not active:
@@ -1831,8 +1947,12 @@ class ContinuousBatchingEngine:
                 k = 1
         out_dev = self._dispatch(slots, active, k, gen)
         if self.async_depth:
-            # Pipeline fill: this dispatch is consumed (and emitted)
-            # one tick late; its host copy is already in flight.
+            # Pipeline fill: chain straight up to depth — these
+            # dispatches are consumed (and emitted) up to async_depth
+            # ticks late; the oldest's host copy is already in flight.
+            while (len(ring) < self.async_depth and
+                   self._can_chain(slots, active, k)):
+                self._dispatch(slots, active, k, gen, chain=ring[-1])
             return
         out_cols = np.asarray(out_dev)
         self._last_ready = time_lib.monotonic()
@@ -1844,23 +1964,24 @@ class ContinuousBatchingEngine:
         return its device output columns (num_slots, k).
 
         Inputs are device-resident whenever possible: with `chain`
-        (the still-unconsumed previous dispatch) the feed arrays it
-        returned in-graph are used directly — zero uploads; otherwise
-        the cached feed is reused when its signature matches the host
-        state, else rebuilt from host lists (slot churn). The temps
-        array caches under a value signature the same way. In async
-        mode the result is recorded as the new in-flight lookahead
-        with its host copy started."""
+        (the newest still-unconsumed dispatch in the ring) the feed
+        arrays it returned in-graph are used directly — zero uploads;
+        otherwise the cached feed is reused when its signature matches
+        the host state, else rebuilt from host lists (slot churn). The
+        temps array caches under a value signature the same way. In
+        async mode the result is appended to the lookahead ring with
+        its host copy started."""
         # `base` = tokens already dispatched but not yet emitted for
-        # every active slot: positions in this dispatch start at
-        # next_pos + base.
-        base = 0 if chain is None else chain.k
+        # every active slot (the whole ring's pending columns):
+        # positions in this dispatch start at next_pos + base.
+        base = sum(e.k for e in self._ring)
         active_set = set(active)
         tables = None
         if self.paged_block_size:
             # Cover every position this dispatch writes (k steps past
-            # the pending columns) so the table stays fixed across the
-            # scanned chunk — and across the lookahead step.
+            # ALL pending columns — ahead of the deepest lookahead
+            # position) so the table stays fixed across the scanned
+            # chunk and across every chained step.
             try:
                 for i in active:
                     self._ensure_blocks(req=slots[i],
@@ -1880,15 +2001,7 @@ class ContinuousBatchingEngine:
             # ids themselves — a few dozen ints, far cheaper than a
             # numpy build + host-to-device transfer, and immune to
             # id()-recycling across request objects.
-            sig = tuple(
-                tuple(slots[i].blocks) if i in active_set else None
-                for i in range(self.num_slots))
-            if sig != self._table_sig:
-                self._table_cache = self._table_array(
-                    [slots[i] if i in active_set else None
-                     for i in range(self.num_slots)])
-                self._table_sig = sig
-            tables = self._table_cache
+            tables = self._tables_for(slots, active_set)
         tsig = tuple(slots[i].temperature if i in active_set else 0.0
                      for i in range(self.num_slots))
         if tsig != self._temps_sig:
@@ -1948,51 +2061,60 @@ class ContinuousBatchingEngine:
             self.tick_stats['gap_samples'] += 1
         if self.async_depth:
             out_cols.copy_to_host_async()
-            self._inflight = _Inflight(out_cols, feed_next,
-                                       tuple(slots), list(active), k,
-                                       gen)
-            _DISPATCH_AHEAD.set(1)
+            self._ring.append(_Inflight(out_cols, feed_next,
+                                        tuple(slots), list(active), k,
+                                        gen))
+            depth = len(self._ring)
+            _DISPATCH_AHEAD.set(depth)
+            _DISPATCH_AHEAD_DEPTH.observe(depth)
         return out_cols
 
-    def _can_chain(self, infl: '_Inflight', slots, active,
-                   k: int) -> bool:
-        """True iff the pending lookahead's in-graph feed is a valid
+    @property
+    def _inflight(self) -> 'Optional[_Inflight]':
+        """Newest in-flight lookahead dispatch, or None — the
+        compatibility view of the ring (depth-1 callers and tests
+        predate async_depth=N)."""
+        return self._ring[-1] if self._ring else None
+
+    def _can_chain(self, slots, active, k: int) -> bool:
+        """True iff the newest ring entry's in-graph feed is a valid
         input for the next dispatch: the slot population is exactly as
-        dispatched and no active request predictably terminates when
-        the pending columns land (max-tokens or window; EOS is
-        unpredictable by design and costs one discarded dispatch).
-        `k` is the NEXT dispatch's step count."""
-        if active != infl.active:
-            return False
+        dispatched for EVERY pending entry and no active request
+        predictably terminates anywhere in the pending horizon
+        (max-tokens or window; EOS is unpredictable by design and
+        costs up to async_depth discarded dispatches). `k` is the NEXT
+        dispatch's step count; the horizon is the sum of all pending
+        entries' step counts."""
+        ring = self._ring
+        pending = 0
+        for entry in ring:
+            if active != entry.active:
+                return False
+            pending += entry.k
         msl = self.cfg.max_seq_len
-        for i in infl.active:
+        for i in active:
             req = slots[i]
-            if req is not infl.reqs[i]:
-                return False    # finished/killed and maybe re-admitted
-            if len(req.tokens) + infl.k >= req.max_new_tokens:
-                return False    # finishes at the pending emit
-            if req.next_pos + infl.k + 1 >= msl:
-                return False    # window termination at the pending emit
-            if req.next_pos + infl.k + k > msl:
+            for entry in ring:
+                if req is not entry.reqs[i]:
+                    return False    # finished/killed, maybe re-admitted
+            if len(req.tokens) + pending >= req.max_new_tokens:
+                return False    # finishes within the pending emits
+            if req.next_pos + pending + 1 >= msl:
+                return False    # window termination within the horizon
+            if req.next_pos + pending + k > msl:
                 return False    # lookahead would write past the window
         return True
 
-    def _consume_inflight(self, slots, gen: int,
-                          infl: 'Optional[_Inflight]' = None) -> None:
-        """Land the pending lookahead's tokens (its host copy started
-        at dispatch) and emit them. Columns whose slot changed hands
-        since dispatch — EOS overshoot after a finish, a deadline
-        kill, admission churn — are discarded by request IDENTITY,
-        never by position arithmetic. With `infl` passed explicitly
-        (the chained fast path) the CURRENT in-flight record — the
-        freshly chained dispatch — is left untouched."""
-        if infl is None:
-            infl = self._inflight
-            self._inflight = None
-            _DISPATCH_AHEAD.set(0)
-            if infl is None:
-                return
-        out_cols = np.asarray(infl.out)   # blocks until N is done
+    def _consume_oldest(self, slots, gen: int) -> None:
+        """Land the OLDEST pending dispatch's tokens (its host copy
+        started at dispatch) and emit them. Columns whose slot changed
+        hands since dispatch — EOS overshoot after a finish, a
+        deadline kill, admission churn — are discarded by request
+        IDENTITY, never by position arithmetic; a request that
+        finishes while deeper entries are still pending sheds their
+        columns the same way, up to async_depth steps late."""
+        infl = self._ring.popleft()
+        out_cols = np.asarray(infl.out)   # blocks until that step lands
         self._last_ready = time_lib.monotonic()
         # The wait above may span a watchdog recovery: never emit into
         # a successor's world.
@@ -2000,6 +2122,15 @@ class ContinuousBatchingEngine:
         live = [i for i in infl.active if slots[i] is infl.reqs[i]]
         if live:
             self._emit(slots, live, out_cols, None)
+
+    def _flush_ring(self, slots, gen: int) -> None:
+        """Drain the whole pipeline oldest-first (churn, spec ticks,
+        all-finished overshoot): after this the ring is empty and every
+        surviving request's host state reflects every dispatched
+        token."""
+        while self._ring:
+            self._consume_oldest(slots, gen)
+        _DISPATCH_AHEAD.set(0)
 
     def _emit(self, slots, active, out_cols, valid) -> None:
         """Append per-slot output columns (up to valid[slot] of them —

@@ -34,6 +34,22 @@ class PoolExhaustedError(Exception):
     drops free their blocks) or shed the request."""
 
 
+def int8_pool_bytes_saved(num_blocks: int, block_size: int,
+                          kv_heads: int, head_dim: int,
+                          num_layers: int, fp_bytes: int) -> int:
+    """HBM the int8 block pool saves vs the same pool at the float
+    dtype: payload drops fp_bytes→1 per element, minus the 4-byte
+    fp32 scale row each (token, kv_head) gains — for both K and V,
+    per layer. Positive for any head_dim > 4/(fp_bytes-1); at bf16
+    with head_dim 128 the pool holds ~1.94x the tokens per byte
+    (docs/performance.md has the sizing table). The engine publishes
+    this as the skytpu_engine_paged_int8_bytes_saved gauge and
+    bench.py --serve reports it in the serve row."""
+    per_elem_saved = (fp_bytes - 1) * head_dim - 4
+    return (2 * num_layers * num_blocks * block_size * kv_heads
+            * per_elem_saved)
+
+
 class BlockPool:
     """Fixed-size pool of KV blocks with refcounts and a free list.
 

@@ -285,6 +285,20 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
     return out.astype(x.dtype)
 
 
+def _int8_quantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-token-per-kv-head absmax int8 quantization for KV-cache
+    writes. ONE definition shared by the contiguous and paged decode
+    paths: their bit-identity contract (tests/test_composition_matrix)
+    holds only while both layouts quantize with the exact same op
+    order, so any numerics change lands in both by construction.
+    x: (B, cur, KVH, D) → (int8 payload, fp32 scales (B, cur, KVH))."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-6) / 127.0
+    q8 = jnp.round(x.astype(jnp.float32) / scale[..., None]).astype(
+        jnp.int8)
+    return q8, scale
+
+
 class Attention(nn.Module):
     cfg: ModelConfig
 
@@ -430,14 +444,8 @@ class Attention(nn.Module):
             lambda cache, new, start: jax.lax.dynamic_update_slice(
                 cache, new, (start, 0, 0)))
         if kv_quant:
-            def quantize(x):
-                amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-                scale = jnp.maximum(amax, 1e-6) / 127.0   # (B, cur, KVH)
-                q8 = jnp.round(x.astype(jnp.float32)
-                               / scale[..., None]).astype(jnp.int8)
-                return q8, scale
-            k_q, k_s = quantize(k)
-            v_q, v_s = quantize(v)
+            k_q, k_s = _int8_quantize(k)
+            v_q, v_s = _int8_quantize(v)
             key_arr = write(key_arr, k_q, start_pos)
             value_arr = write(value_arr, v_q, start_pos)
             write_s = jax.vmap(
@@ -516,6 +524,18 @@ class Attention(nn.Module):
         whatever garbage they hold is causally masked to -1e30 before
         softmax, so it contributes exactly 0.
 
+        int8 KV (cfg.kv_cache_quant == 'int8') composes: the pool
+        stores int8 K/V plus per-token-per-kv-head scale ROWS laid out
+        per block — (nblocks, bs, kv_heads, 1), the trailing singleton
+        keeping the block axis at ndim-4 for EVERY pool leaf so the
+        engine's copy-on-write clone copies scale rows alongside data
+        with the same slice. Quantize-on-write / dequantize-on-gather
+        use the exact op order of the contiguous int8 path, so greedy
+        outputs stay bit-identical to contiguous int8 (pinned by
+        tests/test_composition_matrix.py) and the HBM win multiplies:
+        ~4x tokens held per pool byte for bf16 on top of paged's
+        tokens-held (not slots x max_seq_len) scaling.
+
         The capacity win: pool HBM scales with tokens actually held
         (shared prefix blocks are stored ONCE and referenced by many
         rows' tables), not slots × max_seq_len. Engine-side allocation,
@@ -524,14 +544,12 @@ class Attention(nn.Module):
         cfg = self.cfg
         if block_tables is None:
             raise ValueError('paged KV cache requires block_tables')
-        if cfg.kv_cache_quant:
-            raise NotImplementedError(
-                'paged KV cache + int8 KV quantization is not wired; '
-                'use one or the other')
         batch, cur_len, kv_heads, _ = k.shape
         bs = cfg.paged_block_size
         nblocks = cfg.paged_num_blocks
         bps = cfg.max_seq_len // bs          # logical blocks per row
+        kv_quant = cfg.kv_cache_quant == 'int8'
+        cache_dtype = jnp.int8 if kv_quant else k.dtype
         cache_shape = (nblocks, bs, kv_heads, cfg.head_dim)
         # No batch axis: the pool is shared across rows (that is the
         # point), so it shards on kv_heads (tp) only.
@@ -539,12 +557,25 @@ class Attention(nn.Module):
             'cache', 'cached_key',
             lambda: nn.with_logical_partitioning(
                 jnp.zeros, (None, None, 'kv_heads', None))(
-                    cache_shape, k.dtype))
+                    cache_shape, cache_dtype))
         cached_value = self.variable(
             'cache', 'cached_value',
             lambda: nn.with_logical_partitioning(
                 jnp.zeros, (None, None, 'kv_heads', None))(
-                    cache_shape, k.dtype))
+                    cache_shape, cache_dtype))
+        if kv_quant:
+            # Scale rows live per block next to the data they scale.
+            scale_shape = (nblocks, bs, kv_heads, 1)
+            key_scale = self.variable(
+                'cache', 'cached_key_scale',
+                lambda: nn.with_logical_partitioning(
+                    jnp.ones, (None, None, 'kv_heads', None))(
+                        scale_shape, jnp.float32))
+            value_scale = self.variable(
+                'cache', 'cached_value_scale',
+                lambda: nn.with_logical_partitioning(
+                    jnp.ones, (None, None, 'kv_heads', None))(
+                        scale_shape, jnp.float32))
 
         def unbox(var):
             box = var.value
@@ -566,10 +597,28 @@ class Attention(nn.Module):
         flat_idx = phys * bs + positions % bs          # (B, cur)
         kf = key_arr.reshape(nblocks * bs, kv_heads, cfg.head_dim)
         vf = value_arr.reshape(nblocks * bs, kv_heads, cfg.head_dim)
-        kf = kf.at[flat_idx.reshape(-1)].set(
-            k.reshape(-1, kv_heads, cfg.head_dim))
-        vf = vf.at[flat_idx.reshape(-1)].set(
-            v.reshape(-1, kv_heads, cfg.head_dim))
+        if kv_quant:
+            k_q, k_s = _int8_quantize(k)
+            v_q, v_s = _int8_quantize(v)
+            kf = kf.at[flat_idx.reshape(-1)].set(
+                k_q.reshape(-1, kv_heads, cfg.head_dim))
+            vf = vf.at[flat_idx.reshape(-1)].set(
+                v_q.reshape(-1, kv_heads, cfg.head_dim))
+            ks_arr, ks_box = unbox(key_scale)
+            vs_arr, vs_box = unbox(value_scale)
+            ksf = ks_arr.reshape(nblocks * bs, kv_heads, 1)
+            vsf = vs_arr.reshape(nblocks * bs, kv_heads, 1)
+            ksf = ksf.at[flat_idx.reshape(-1)].set(
+                k_s.reshape(-1, kv_heads, 1))
+            vsf = vsf.at[flat_idx.reshape(-1)].set(
+                v_s.reshape(-1, kv_heads, 1))
+            rebox(key_scale, ks_box, ksf.reshape(scale_shape))
+            rebox(value_scale, vs_box, vsf.reshape(scale_shape))
+        else:
+            kf = kf.at[flat_idx.reshape(-1)].set(
+                k.reshape(-1, kv_heads, cfg.head_dim))
+            vf = vf.at[flat_idx.reshape(-1)].set(
+                v.reshape(-1, kv_heads, cfg.head_dim))
         rebox(cached_key, key_box, kf.reshape(cache_shape))
         rebox(cached_value, value_box, vf.reshape(cache_shape))
         # ---- gather each row's logical window and attend ----
@@ -580,8 +629,17 @@ class Attention(nn.Module):
         n_rep = cfg.num_heads // kv_heads
         q_grouped = q.reshape(batch, cur_len, kv_heads, n_rep,
                               cfg.head_dim)
-        scores = jnp.einsum('bqkrd,bskd->bkrqs', q_grouped, k_full,
+        # int8 pool: the matmul reads the gathered int8 (astype fuses
+        # into the read); per-token scales factor out of the contracted
+        # head_dim and apply to the scores — exactly the contiguous
+        # int8 math over the gathered window.
+        key_in = (k_full.astype(q.dtype) if kv_quant else k_full)
+        scores = jnp.einsum('bqkrd,bskd->bkrqs', q_grouped, key_in,
                             preferred_element_type=jnp.float32)
+        if kv_quant:
+            ks_full = ksf[gidx][..., 0]                # (B, S, KV)
+            scores = scores * ks_full.transpose(0, 2, 1)[:, :, None,
+                                                         None, :]
         scores = scores * (cfg.head_dim**-0.5)
         if cfg.attn_logit_softcap:
             cap = cfg.attn_logit_softcap
@@ -593,8 +651,20 @@ class Attention(nn.Module):
             mask &= q_pos - k_pos < cfg.sliding_window
         scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
-        probs = probs.astype(v_full.dtype)
-        out = jnp.einsum('bkrqs,bskd->bqkrd', probs, v_full)
+        if kv_quant:
+            # V's per-token scale folds into the probabilities (it
+            # cannot factor out of the summed s dim) — masked
+            # positions carry exactly-zero probs, so stale scale rows
+            # in scratch/freed blocks contribute exactly 0.
+            vs_full = vsf[gidx][..., 0]                # (B, S, KV)
+            probs = probs * vs_full.transpose(0, 2, 1)[:, :, None,
+                                                       None, :]
+            probs = probs.astype(_dtype(cfg))
+            out = jnp.einsum('bkrqs,bskd->bqkrd', probs,
+                             v_full.astype(_dtype(cfg)))
+        else:
+            probs = probs.astype(v_full.dtype)
+            out = jnp.einsum('bkrqs,bskd->bqkrd', probs, v_full)
         return out.reshape(batch, cur_len, cfg.num_heads, cfg.head_dim)
 
 

@@ -69,6 +69,27 @@ void write_all(int fd, const char* buf, size_t n) {
   }
 }
 
+// One combined-log line = ONE write() syscall: prefix and payload are
+// assembled in a scratch buffer first. The combined fd is O_APPEND and
+// other writers share it (the gang driver appends its own "(driver)"
+// lines from Python while this thread pumps rank output) — with the
+// old two-write sequence (prefix, then payload) a concurrent append
+// could land BETWEEN them, splitting a rank's line mid-prefix. POSIX
+// O_APPEND writes are atomic with respect to each other, so a single
+// write per line makes cross-writer interleaving impossible.
+void write_prefixed(Mux* m, const std::string& prefix, const char* data,
+                    size_t n) {
+  if (prefix.empty()) {
+    write_all(m->combined_fd, data, n);
+    return;
+  }
+  std::string line;
+  line.reserve(prefix.size() + n);
+  line.append(prefix);
+  line.append(data, n);
+  write_all(m->combined_fd, line.data(), line.size());
+}
+
 // Emit [data, data+n): BOTH the rank file and the combined fd receive
 // only COMPLETE lines ('\n' or '\r' terminated), so streams sharing a
 // file never interleave mid-line. That matters even within one rank:
@@ -108,10 +129,7 @@ void emit(Mux* m, Stream* s, const char* data, size_t n) {
       }
     }
     write_all(s->rank_fd, s->carry.data() + start, end - start + 1);
-    if (!s->prefix.empty()) {
-      write_all(m->combined_fd, s->prefix.data(), s->prefix.size());
-    }
-    write_all(m->combined_fd, s->carry.data() + start, end - start + 1);
+    write_prefixed(m, s->prefix, s->carry.data() + start, end - start + 1);
     m->lines++;
     start = end + 1;
   }
@@ -122,10 +140,7 @@ void emit(Mux* m, Stream* s, const char* data, size_t n) {
     // other stream and must stay line-atomic) so memory stays bounded.
     s->carry.push_back('\n');
     write_all(s->rank_fd, s->carry.data(), s->carry.size());
-    if (!s->prefix.empty()) {
-      write_all(m->combined_fd, s->prefix.data(), s->prefix.size());
-    }
-    write_all(m->combined_fd, s->carry.data(), s->carry.size());
+    write_prefixed(m, s->prefix, s->carry.data(), s->carry.size());
     m->lines++;
     s->carry.clear();
   }
@@ -142,10 +157,7 @@ void flush_carry(Mux* m, Stream* s) {
   // over byte fidelity of a stream that already lost its terminator.
   s->carry.push_back('\n');
   write_all(s->rank_fd, s->carry.data(), s->carry.size());
-  if (!s->prefix.empty()) {
-    write_all(m->combined_fd, s->prefix.data(), s->prefix.size());
-  }
-  write_all(m->combined_fd, s->carry.data(), s->carry.size());
+  write_prefixed(m, s->prefix, s->carry.data(), s->carry.size());
   m->lines++;
   s->carry.clear();
 }
@@ -237,9 +249,22 @@ int logmux_add_stream(void* handle, int fd, const char* rank_log_path,
   Mux* m = static_cast<Mux*>(handle);
   if (m->started) return -1;
   Stream s;
-  s.fd = fd;
+  // Own a dup of the caller's fd. The r3-class race: Python closed its
+  // stream fds (proc.stdout.close()) while this thread still polled
+  // them — the stream retired on POLLNVAL with completed lines still
+  // sitting unread in the pipe (lost/undercounted), and a recycled fd
+  // number could even hand the poll loop a STRANGER's bytes, splicing
+  // foreign content mid-line into the logs. With a private dup, the
+  // caller closing its copy is a no-op here: the pipe stays readable
+  // until the WRITER closes, EOF drains everything, and no teardown
+  // ordering between Python and this thread can lose or split a line.
+  s.fd = dup(fd);
+  if (s.fd < 0) return -1;
   s.rank_fd = open(rank_log_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
-  if (s.rank_fd < 0) return -1;
+  if (s.rank_fd < 0) {
+    close(s.fd);
+    return -1;
+  }
   s.prefix = prefix ? prefix : "";
   m->streams.push_back(std::move(s));
   return static_cast<int>(m->streams.size()) - 1;
@@ -275,6 +300,7 @@ void logmux_destroy(void* handle) {
   Mux* m = static_cast<Mux*>(handle);
   logmux_wait(m);
   for (auto& s : m->streams) {
+    if (s.fd >= 0) close(s.fd);  // our dup (see logmux_add_stream)
     if (s.rank_fd >= 0) close(s.rank_fd);
   }
   if (m->combined_fd >= 0) close(m->combined_fd);

@@ -900,14 +900,15 @@ def main(argv=None) -> int:
                              'prompts interleave with decode (default: '
                              'block size)')
     parser.add_argument('--async-depth', type=int, default=0,
-                        choices=[0, 1],
-                        help='async decode pipeline: 1 dispatches each '
-                             'decode step one tick ahead off the '
-                             'previous step\'s device output, so host '
-                             'scheduling overlaps device compute (EOS '
-                             'detected one step late, overshoot '
-                             'discarded — token streams stay bit-'
-                             'identical; see docs/performance.md). '
+                        help='async decode pipeline: a ring of N '
+                             'in-flight decode dispatches, each '
+                             'chained off the previous one\'s device '
+                             'output, so host scheduling overlaps '
+                             'device compute (EOS detected up to N '
+                             'steps late, overshoot discarded — token '
+                             'streams stay bit-identical; composes '
+                             'with --paged-block-size, --kv-quant and '
+                             '--speculative, see docs/performance.md). '
                              '0 = synchronous ticks')
     parser.add_argument('--max-queue', type=int, default=64,
                         help='admission control: queued-request cap; '

@@ -10,6 +10,7 @@ resumed job on the same mesh shape restores without resharding traffic.
 """
 from __future__ import annotations
 
+import inspect
 import logging
 import os
 from typing import Any, Optional
@@ -160,11 +161,21 @@ def restore_params_only(cfg, checkpoint_dir: str):
                                            global_shape=s.shape,
                                            dtype=s.dtype),
             abstract)
+        # Partial restore (params subtree only, optimizer state skipped)
+        # across orbax API generations: newer releases spell it
+        # `partial_restore=True`; the release pinned here rejects that
+        # kwarg and instead treats an empty `transforms` dict as "item
+        # defines the output tree; checkpoint keys not in item are
+        # skipped" — the same semantics under the older name.
+        restore_kwargs = dict(item={'params': abstract},
+                              restore_args={'params': restore_args})
+        if 'partial_restore' in inspect.signature(
+                ocp.args.PyTreeRestore.__init__).parameters:
+            restore_kwargs['partial_restore'] = True
+        else:
+            restore_kwargs['transforms'] = {}
         restored = manager.restore(
-            step, args=ocp.args.PyTreeRestore(
-                item={'params': abstract},
-                restore_args={'params': restore_args},
-                partial_restore=True))
+            step, args=ocp.args.PyTreeRestore(**restore_kwargs))
     finally:
         manager.close()
     return restored['params']

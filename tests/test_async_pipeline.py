@@ -71,9 +71,21 @@ def ref_tokens(sync_engine):
 
 class TestAsyncBitIdentity:
 
-    def test_depth_validation(self):
-        with pytest.raises(ValueError):
-            _engine(async_depth=2)
+    def test_depth_n_constructs(self):
+        """async_depth>1 is no longer gated: a deep ring constructs
+        (decode behavior is pinned by tests/test_composition_matrix.py;
+        negative depths clamp to sync)."""
+        engine = _engine(async_depth=2)
+        try:
+            assert engine.async_depth == 2
+            assert engine._inflight is None  # pylint: disable=protected-access
+        finally:
+            engine.stop()
+        engine = _engine(async_depth=-1)
+        try:
+            assert engine.async_depth == 0
+        finally:
+            engine.stop()
 
     def test_max_tokens_termination(self, sync_engine, async_engine,
                                     ref_tokens):

@@ -83,6 +83,34 @@ class TestStructuredSkip:
         # would have been at the old fixed timeout.
         assert sum(result['probe_seconds']) < 30
 
+    def test_unrunnable_serve_combo_emits_structured_skip(self):
+        """A serve flag combination the engine cannot construct (block
+        size not dividing the window) must produce ONE machine-
+        parseable {"skipped": true, ...} line naming the combo — with
+        no retries (the verdict is deterministic) — not a stack trace
+        with nothing to parse."""
+        env = dict(os.environ,
+                   JAX_PLATFORMS='cpu',
+                   SKYTPU_BENCH_ATTEMPTS='2',
+                   SKYTPU_BENCH_BACKOFF='0.1')
+        proc = subprocess.run(
+            [sys.executable, _BENCH, '--quick', '--serve',
+             '--paged-block-size', '7', '--int8-kv',
+             '--async-depth', '3'],
+            capture_output=True, text=True, timeout=300, env=env,
+            check=False)
+        assert proc.returncode == 3, proc.stderr[-2000:]
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert result['skipped'] is True
+        assert 'unsupported serve combination' in result['reason']
+        assert 'divisible' in result['reason']
+        assert result['combo'] == {'kv_quant': 'int8',
+                                   'speculative': 0,
+                                   'paged_block_size': 7,
+                                   'async_depth': 3}
+        # Deterministic skip ⇒ exactly one worker attempt.
+        assert 'attempt 2/' not in proc.stderr
+
 
 class TestTuneAttn:
 

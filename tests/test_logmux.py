@@ -263,6 +263,41 @@ class TestLogMux:
         assert 'completed-line\n' in text
         assert 'partial\n' in text  # synthesized terminator
 
+    def test_fd_close_race_loses_no_lines(self, tmp_path):
+        """The line-atomicity race, reproduced deterministically: the
+        caller closes its stream fds the moment the writers exit —
+        while completed lines still sit unread in the pipes. The mux
+        must own dup'd fds, so the close is a no-op to its poll loop:
+        every line lands exactly once, whole, correctly prefixed (the
+        old behavior retired streams on POLLNVAL mid-pipe, losing
+        lines and splicing recycled-fd content mid-line)."""
+        n_lines = 5000
+        combined = tmp_path / 'run.log'
+        procs = [_spawn_writer(n_lines, f'w{i}') for i in range(3)]
+        with logmux_lib.LogMux(str(combined)) as mux:
+            for i, proc in enumerate(procs):
+                mux.add_stream(proc.stdout.fileno(),
+                               str(tmp_path / f'rank-{i}.log'), f'[{i}] ')
+            mux.start()
+            for proc in procs:
+                proc.wait()
+                # Close IMMEDIATELY: the pipes still hold a deep
+                # backlog the mux has not polled yet.
+                proc.stdout.close()
+            mux.wait()
+            assert mux.lines == 3 * n_lines
+        lines = combined.read_text().strip().split('\n')
+        assert len(lines) == 3 * n_lines
+        counts = {0: 0, 1: 0, 2: 0}
+        for line in lines:
+            assert line[0] == '[' and line[2] == ']', line
+            rank = int(line[1])
+            assert line == f'[{rank}] w{rank}-{counts[rank]}', line
+            counts[rank] += 1
+        for i in range(3):
+            assert (tmp_path / f'rank-{i}.log').read_text() == ''.join(
+                f'w{i}-{j}\n' for j in range(n_lines))
+
     def test_throughput_vs_python(self, tmp_path):
         """The point of going native: mux N chatty streams faster than
         line-looping Python threads. Sanity check, not a benchmark — just

@@ -416,14 +416,21 @@ class TestChunkedPrefill:
         finally:
             engine.stop()
 
-    def test_unsupported_combos_rejected(self):
+    def test_composed_combos_construct(self):
+        """The PR-3 gates are gone: paged composes with speculative AND
+        int8-KV (decode behavior pinned by test_composition_matrix.py).
+        Block size must still divide the window."""
         from skypilot_tpu.models.inference import ContinuousBatchingEngine
-        with pytest.raises(ValueError, match='speculative'):
-            ContinuousBatchingEngine(_cfg(), num_slots=1,
-                                     paged_block_size=8, speculative=2)
-        with pytest.raises(ValueError, match='int8'):
-            ContinuousBatchingEngine(_cfg(), num_slots=1,
-                                     paged_block_size=8, kv_quant='int8')
+        engine = ContinuousBatchingEngine(_cfg(), num_slots=1,
+                                          paged_block_size=8,
+                                          speculative=2,
+                                          kv_quant='int8')
+        try:
+            assert engine.speculative == 2
+            assert engine.cfg.kv_cache_quant == 'int8'
+            assert engine.paged_int8_bytes_saved > 0
+        finally:
+            engine.stop()
         with pytest.raises(ValueError, match='divisible'):
             ContinuousBatchingEngine(_cfg(), num_slots=1,
                                      paged_block_size=7)
