@@ -754,14 +754,31 @@ def serve_status(service_name, endpoint_only):
     if not records:
         click.echo('No services.')
         return
+    def _prewarm_cell(info):
+        pw = info.get('last_prewarm')
+        if not pw:
+            return '-'
+        if pw.get('status') == 'ok':
+            partial = '/partial' if pw.get('partial') else ''
+            return f"ok({pw.get('imported', 0)} pfx{partial})"
+        return pw.get('status', '-')
+
     for r in records:
         click.secho(f"{r['name']}  [{r['status'].value}]  "
                     f"endpoint: {r['endpoint'] or '-'}", bold=True)
+        # Preemption lifecycle is first-class here: a replica mid-drain
+        # shows DRAINING (not a generic NOT_READY), replacements carry
+        # their preemption lineage, and PREWARM shows whether the
+        # replacement came up with the fleet's hot prefixes restored
+        # (docs/resilience.md "Preemption lifecycle").
         rows = [[i['replica_id'], i['status'], i['url'] or '-',
-                 'spot' if i['is_spot'] else 'on-demand', i['version']]
+                 'spot' if i['is_spot'] else 'on-demand', i['version'],
+                 i.get('preemption_count', 0) or '-',
+                 _prewarm_cell(i)]
                 for i in r['replica_info']]
         _print_table(rows,
-                     ['REPLICA', 'STATUS', 'URL', 'CAPACITY', 'VERSION'])
+                     ['REPLICA', 'STATUS', 'URL', 'CAPACITY', 'VERSION',
+                      'PREEMPTS', 'PREWARM'])
 
 
 @serve.command('update')

@@ -18,7 +18,7 @@ import functools
 import itertools
 import logging
 import time as time_lib
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -122,6 +122,16 @@ _DISPATCH_AHEAD = obs.gauge(
     'skytpu_engine_dispatch_ahead',
     'Decode dispatches in flight beyond the last consumed result '
     '(the async lookahead depth currently in effect)')
+_PREFIX_EXPORT_BLOCKS = obs.counter(
+    'skytpu_prefix_export_blocks_total',
+    'KV blocks serialized into prefix artifacts on preemption notice')
+_PREFIX_PREWARM_BLOCKS = obs.counter(
+    'skytpu_prefix_prewarm_blocks_total',
+    'KV blocks restored into the pool from a prefix artifact')
+_PREFIX_PREWARM_HIT = obs.counter(
+    'skytpu_prefix_prewarm_hit_total',
+    'Admission prefix-cache hits served from a PRE-WARMED (imported) '
+    'entry — the TTFT saved across a preemption')
 
 # step_log cap: enough history for any interleaving assertion while
 # bounding a serve replica that decodes for weeks (the old unbounded
@@ -605,7 +615,12 @@ class ContinuousBatchingEngine:
         # blocks for a length-L prefix — N can be much larger for the
         # same HBM (docs/performance.md has the sizing math).
         self.prefix_cache = max(0, prefix_cache)
-        self.prefix_stats = {'hits': 0, 'misses': 0, 'tokens_reused': 0}
+        self.prefix_stats = {'hits': 0, 'misses': 0, 'tokens_reused': 0,
+                             'prewarm_hits': 0}
+        # Keys restored via import_prefixes (preemption pre-warm): a
+        # hit on one of these counts toward
+        # skytpu_prefix_prewarm_hit_total.
+        self._prewarmed_keys: set = set()
         # -------- paged KV cache (docs/performance.md) --------
         # Opt-in via paged_block_size=N: KV lives in a shared pool of
         # fixed-size blocks (kv_cache.BlockPool) indexed through
@@ -1210,6 +1225,8 @@ class ContinuousBatchingEngine:
                 self._pool = kv_cache_lib.BlockPool(
                     self.cfg.paged_num_blocks, self.paged_block_size)
                 self._prefix_entries = self._new_prefix_index()
+                # Pre-warmed entries died with the pool.
+                self._prewarmed_keys = set()
             self._thread = None
             self._heartbeat = time_lib.monotonic()
         logger.error('engine watchdog: %s; failing in-flight requests '
@@ -1278,8 +1295,11 @@ class ContinuousBatchingEngine:
 
     def _store_prefix(self, ids: list, cache1) -> None:
         # Displaced contiguous payloads are batch-1 device caches with
-        # no other owner — dropping the reference frees them.
-        self._prefix_entries.put(ids, cache1)
+        # no other owner — dropping the reference frees them. Evicted
+        # keys lose pre-warmed credit: the same prefix re-inserted by a
+        # local prefill is no longer the import's doing.
+        for key, _payload in self._prefix_entries.put(ids, cache1):
+            self._prewarmed_keys.discard(key)
 
     # ---------------- paged-KV host bookkeeping ----------------
 
@@ -1424,6 +1444,9 @@ class ContinuousBatchingEngine:
             self.prefix_stats['tokens_reused'] += plen
             _PREFIX_HIT.inc()
             _PREFIX_TOKENS.inc(plen)
+            if self._prefix_entries.last_key in self._prewarmed_keys:
+                self.prefix_stats['prewarm_hits'] += 1
+                _PREFIX_PREWARM_HIT.inc()
         elif self.prefix_cache:
             self.prefix_stats['misses'] += 1
             _PREFIX_MISS.inc()
@@ -1451,8 +1474,11 @@ class ContinuousBatchingEngine:
         for block in blocks:
             self._pool.incref(block)
         displaced = self._prefix_entries.put(req.ids, blocks)
-        for _key, old_blocks in displaced:
+        for key, old_blocks in displaced:
             self._pool.release(old_blocks)
+            # Same prefix re-inserted later by a local prefill must
+            # not keep crediting the import in the prewarm-hit metric.
+            self._prewarmed_keys.discard(key)
 
     def _prefill_tick(self, slots, prefilling, gen: int) -> None:
         """Advance every mid-prefill slot by ONE fixed-size chunk. The
@@ -1516,6 +1542,178 @@ class ContinuousBatchingEngine:
             'prefix_entries': len(self._prefix_entries),
             **self.paged_stats,
         }
+
+    # ---------------- prefix export / pre-warm (preemption path) -----
+    #
+    # docs/resilience.md "Preemption lifecycle". Both methods touch the
+    # pool tree directly, so they must run while no engine thread is
+    # mid-tick: export after drain() (the preemption-notice flow),
+    # import before the first request (replacement pre-warm) — the
+    # serve server sequences both.
+
+    @staticmethod
+    def _block_axis(leaf) -> int:
+        """Every pool leaf keeps its block axis at ndim-4 — scanned
+        layers prepend a layers dim, int8 scale rows keep a trailing
+        singleton (the _cow_copy_impl contract from PR 5)."""
+        return leaf.ndim - 4
+
+    def _pool_leaf_meta(self, leaves) -> list:
+        out = []
+        for leaf in leaves:
+            axis = self._block_axis(leaf)
+            shape = list(leaf.shape[:axis]) + list(leaf.shape[axis + 1:])
+            out.append({'shape': shape, 'dtype': str(leaf.dtype)})
+        return out
+
+    def export_prefixes(self, path: str,
+                        budget_s: Optional[float] = None,
+                        clock=time_lib.monotonic) -> Dict[str, Any]:
+        """Serialize the prefix LRU's blocks into a versioned artifact
+        at `path` (kv_cache.export_prefixes). `budget_s` bounds the
+        gather — under deadline pressure the NEWEST (hottest) prefixes
+        export first and the artifact is published partially; a fault
+        or kill mid-export publishes nothing (atomic rename).
+        Returns the kv_cache stats dict."""
+        empty = {'exported': 0, 'blocks': 0, 'skipped': 0,
+                 'truncated': False, 'path': path}
+        if not (self.paged_block_size and self.prefix_cache):
+            return dict(empty, reason='prefix export requires '
+                        'paged_block_size and prefix_cache')
+        if self._cache is None or not len(self._prefix_entries):
+            return dict(empty, reason='no cached prefixes')
+        leaves, _treedef = jax.tree.flatten(self._cache)
+        # One device→host transfer per leaf for the WHOLE export, not
+        # per prefix: np.asarray on a pool leaf copies the entire
+        # multi-GB pool, and paying that inside the per-prefix gather
+        # burns the notice budget after a handful of prefixes. Lazy so
+        # a deadline that fires before the first gather pays nothing.
+        host_leaves: List[Optional[np.ndarray]] = [None] * len(leaves)
+
+        def gather(blocks):
+            # Chaos seam: an armed 'storage.export' fault aborts the
+            # export mid-artifact — nothing is published.
+            fault_injection.point('storage.export')
+            idx = np.asarray(list(blocks), np.int32)
+            out = []
+            for i, leaf in enumerate(leaves):
+                if host_leaves[i] is None:
+                    host_leaves[i] = np.asarray(leaf)
+                axis = self._block_axis(leaf)
+                # Artifact layout: block axis FIRST, whatever its
+                # position in the pool leaf (scanned layers prepend a
+                # layers dim).
+                out.append(np.ascontiguousarray(np.moveaxis(
+                    np.take(host_leaves[i], idx, axis=axis), axis, 0)))
+            return out
+
+        deadline = clock() + budget_s if budget_s else None
+        should_stop = ((lambda: clock() > deadline)
+                       if deadline is not None else None)
+        stats = kv_cache_lib.export_prefixes(
+            self._prefix_entries, self._pool, gather, path,
+            should_stop=should_stop)
+        _PREFIX_EXPORT_BLOCKS.inc(stats['blocks'])
+        logger.info('exported %d prefixes (%d blocks%s) to %s',
+                    stats['exported'], stats['blocks'],
+                    ', truncated by deadline' if stats['truncated']
+                    else '', path)
+        return stats
+
+    def import_prefixes(self, path: str) -> Dict[str, Any]:
+        """Pre-warm the prefix LRU from an artifact: re-allocate pool
+        blocks, scatter the serialized KV into the device pool, rebuild
+        index entries, and mark the keys pre-warmed (hits on them count
+        toward skytpu_prefix_prewarm_hit_total). Per-prefix corruption
+        is skipped; a full pool stops the pre-warm partially; an
+        artifact from an incompatible pool (block_size / cache layout)
+        raises kv_cache.ArtifactError without mutating anything."""
+        if not (self.paged_block_size and self.prefix_cache):
+            raise ValueError('prefix import requires paged_block_size '
+                             'and prefix_cache')
+        if self._cache is None:
+            self._cache = self._init_cache_for_mode()
+        leaves, treedef = jax.tree.flatten(self._cache)
+        meta = self._pool_leaf_meta(leaves)
+        per_block_elems = [int(np.prod(m['shape'], dtype=np.int64))
+                           for m in meta]
+
+        # Scatters are STAGED on host and applied as ONE batched
+        # `.at[].set` per leaf: the functional update materializes a
+        # full pool-leaf copy on device, so doing it per prefix made
+        # pre-warm cost O(prefixes × pool) — directly delaying the
+        # replacement's /health-ready flip. Block ids are unique across
+        # prefixes (freshly allocated; double-import skips existing),
+        # so batching cannot collide.
+        pending_idx: List[List[np.ndarray]] = [[] for _ in leaves]
+        pending_arr: List[List[np.ndarray]] = [[] for _ in leaves]
+
+        def scatter(blocks, blob):
+            idx = np.asarray(list(blocks), np.int32)
+            off = 0
+            for i in range(len(leaves)):
+                dt = np.dtype(leaves[i].dtype)
+                count = len(blocks) * per_block_elems[i]
+                # Artifact layout is block-axis-first; kept that way
+                # until the batched apply below.
+                arr = np.frombuffer(blob, dtype=dt, count=count,
+                                    offset=off).reshape(
+                                        (len(blocks),) +
+                                        tuple(meta[i]['shape']))
+                pending_idx[i].append(idx)
+                pending_arr[i].append(arr)
+                off += count * dt.itemsize
+
+        def _apply_staged():
+            for i in range(len(leaves)):
+                if not pending_idx[i]:
+                    continue
+                axis = self._block_axis(leaves[i])
+                idx = np.concatenate(pending_idx[i])
+                arr = np.concatenate(pending_arr[i], axis=0)
+                # A later prefix may have re-used block ids an LRU
+                # eviction freed mid-import; `.at[].set` with duplicate
+                # indices has no defined winner, so keep only the LAST
+                # staged write per block id.
+                _, first_rev = np.unique(idx[::-1], return_index=True)
+                if len(first_rev) != len(idx):
+                    keep = np.sort(len(idx) - 1 - first_rev)
+                    idx, arr = idx[keep], arr[keep]
+                arr = np.moveaxis(arr, 0, axis)
+                sel = (slice(None),) * axis + (_upload(idx),)
+                leaves[i] = leaves[i].at[sel].set(
+                    _upload(np.ascontiguousarray(arr)))
+
+        try:
+            stats = kv_cache_lib.import_prefixes(
+                path, self._prefix_entries, self._pool, scatter,
+                expect_leaves=meta,
+                on_prefix=lambda: fault_injection.point('storage.import'))
+        finally:
+            # Commit whatever was staged even on a mid-import fault:
+            # the index/pool already reference those blocks, so the
+            # pool tree must hold their data. (A prefix whose fault
+            # fired before its scatter ran has no staged writes AND no
+            # index entry — nothing leaks.)
+            _apply_staged()
+            self._cache = jax.tree.unflatten(treedef, leaves)
+        self._prewarmed_keys.update(stats['keys'])
+        # The import itself can LRU-evict older entries (including
+        # previously pre-warmed ones) inside kv_cache.import_prefixes,
+        # where this engine cannot observe the eviction — reconcile
+        # against the live index so stale keys never inflate the
+        # prewarm-hit counter.
+        self._prewarmed_keys.intersection_update(
+            k for k, _ in self._prefix_entries.entries())
+        _PREFIX_PREWARM_BLOCKS.inc(stats['blocks'])
+        logger.info(
+            'pre-warmed %d prefixes (%d blocks) from %s '
+            '(%d corrupt skipped, %d already present%s)',
+            stats['imported'], stats['blocks'], path,
+            stats['skipped_corrupt'], stats['skipped_existing'],
+            ', stopped on full pool' if stats['stopped_pool_full']
+            else '')
+        return stats
 
     def _admit(self, slot: int, req: '_Request', gen: int = -1) -> None:
         if self.paged_block_size:
@@ -1677,6 +1875,7 @@ class ContinuousBatchingEngine:
                                 self.paged_block_size)
                             self._prefix_entries = \
                                 self._new_prefix_index()
+                            self._prewarmed_keys = set()
 
                     try:
                         self._commit_gen(gen, _reset_state)

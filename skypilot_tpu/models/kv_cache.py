@@ -21,12 +21,27 @@ side the continuous-batching engine drives:
 
 Everything here is plain host Python — no jax imports — so allocator
 invariants are testable without a device.
+
+Preemption-native serving adds block-granular serialize/restore
+(docs/resilience.md "Preemption lifecycle"): `export_prefixes` walks the
+index and snapshots each cached prefix's pool blocks (int8 or float —
+every pool leaf, scales included) into a versioned, per-prefix-
+checksummed artifact; `import_prefixes` re-allocates blocks in a fresh
+pool, rebuilds the trie entries, and skips anything it cannot VERIFY
+(wrong block_size / cache layout → whole artifact rejected; corrupt or
+truncated prefix → that prefix skipped; pool pressure → partial
+pre-warm with allocator invariants intact; repeated import → no-op).
 """
 from __future__ import annotations
 
+import io
+import json
+import os
+import struct
 import threading
+import zlib
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 
 class PoolExhaustedError(Exception):
@@ -179,6 +194,10 @@ class PrefixIndex:
         # tuple counts as `chunk` compares) — the satellite's O(prompt/
         # chunk) bound is pinned against this counter.
         self.last_compares = 0
+        # Full key of the entry the LAST lookup matched (None on miss).
+        # The engine uses it to attribute a hit to a pre-warmed
+        # (imported) entry vs. a locally-prefilled one.
+        self.last_key: Optional[tuple] = None
 
     # -- container protocol (tests iterate/len the entry table) --
 
@@ -191,11 +210,21 @@ class PrefixIndex:
     def __contains__(self, key) -> bool:
         return tuple(key) in self._lru
 
+    def entries(self) -> List[Tuple[tuple, Any]]:
+        """(key, payload) pairs in LRU order (oldest first)."""
+        return list(self._lru.items())
+
     # -- mutation --
 
     def _chunks(self, key: tuple) -> List[tuple]:
         c = self.chunk
         return [key[i:i + c] for i in range(0, len(key) - len(key) % c, c)]
+
+    def touch(self, ids) -> None:
+        """Mark an entry most-recently-used (no-op if absent)."""
+        key = tuple(ids)
+        if key in self._lru:
+            self._lru.move_to_end(key)
 
     def put(self, ids, payload) -> List[Tuple[tuple, Any]]:
         """Insert/refresh an entry; returns [(key, payload), ...] that
@@ -289,6 +318,7 @@ class PrefixIndex:
                         if key is not None:
                             best_len, best_key = limit, key
                             break
+        self.last_key = best_key
         if best_key is None:
             return 0, None
         # No recency refresh here: historically a hit refreshes via the
@@ -304,3 +334,229 @@ class PrefixIndex:
                 return cur.entries[0][1]
             stack.extend(cur.children.values())
         return None
+
+
+# ---------------------------------------------------------------------
+# Prefix artifact: block-granular serialize/restore (preemption path)
+# ---------------------------------------------------------------------
+#
+# Layout of one artifact file:
+#
+#     PREFIX_ARTIFACT_MAGIC
+#     u32 big-endian header length
+#     header JSON:
+#       {"version": 1, "block_size": N,
+#        "leaves": [{"shape": [per-block dims...], "dtype": "..."},...],
+#        "prefixes": [{"key": [...], "num_blocks": k,
+#                      "offset": o, "length": l, "crc": c}, ...]}
+#     payload: concatenated per-prefix blobs (each blob = the gathered
+#              block data of every pool leaf, C-order raw bytes)
+#
+# The header is written AFTER all blobs are gathered (everything is
+# built in memory, then published via write-to-temp + atomic rename),
+# so a killed export never leaves a half-written artifact under the
+# final name. Robustness is per-prefix: each blob carries a CRC over
+# (bytes, key, block_size, leaf signature) and import skips — never
+# trusts — any prefix whose blob is missing, short, or corrupt.
+
+PREFIX_ARTIFACT_MAGIC = b'SKYTPU-PREFIX\n'
+PREFIX_ARTIFACT_VERSION = 1
+
+
+class ArtifactError(Exception):
+    """The artifact as a WHOLE is unusable (bad magic/version/header,
+    or it was written by a pool with an incompatible layout)."""
+
+
+def _leaf_sig(leaves_meta: List[Dict[str, Any]]) -> str:
+    return json.dumps(leaves_meta, sort_keys=True)
+
+
+def _prefix_crc(blob: bytes, key: tuple, block_size: int,
+                sig: str) -> int:
+    crc = zlib.crc32(blob)
+    crc = zlib.crc32(repr(tuple(key)).encode(), crc)
+    crc = zlib.crc32(f'{block_size}|{sig}'.encode(), crc)
+    return crc & 0xffffffff
+
+
+def export_prefixes(index: PrefixIndex, pool: BlockPool,
+                    gather: Callable[[Sequence[int]], List[Any]],
+                    path: str,
+                    should_stop: Optional[Callable[[], bool]] = None
+                    ) -> Dict[str, Any]:
+    """Snapshot the index's cached prefixes into a versioned artifact.
+
+    `gather(blocks)` returns, per pool leaf, a numpy array of shape
+    (len(blocks), *per_block_shape) holding those blocks' data (the
+    engine closes over its device pool; tests hand in plain numpy).
+    Payloads must be block lists (paged mode) — entries whose payload
+    is not a list of ints are skipped (contiguous-mode caches are
+    device trees with no block identity to serialize).
+
+    Prefixes are written NEWEST FIRST so a deadline cutoff
+    (`should_stop`) keeps the hottest entries; a partial export is a
+    valid, smaller artifact. Publication is atomic (temp + rename):
+    either the complete file appears under `path` or nothing does.
+    Returns {'exported', 'blocks', 'skipped', 'truncated', 'path'}.
+    """
+    stats = {'exported': 0, 'blocks': 0, 'skipped': 0, 'truncated': False,
+             'path': path}
+    prefixes: List[Dict[str, Any]] = []
+    payload = io.BytesIO()
+    leaves_meta: Optional[List[Dict[str, Any]]] = None
+    sig = ''
+    for key, blocks in reversed(index.entries()):
+        if should_stop is not None and should_stop():
+            stats['truncated'] = True
+            break
+        if not isinstance(blocks, list) or not all(
+                isinstance(b, int) for b in blocks):
+            stats['skipped'] += 1
+            continue
+        arrays = gather(blocks)
+        if leaves_meta is None:
+            leaves_meta = [{'shape': list(a.shape[1:]), 'dtype': str(a.dtype)}
+                           for a in arrays]
+            sig = _leaf_sig(leaves_meta)
+        blob = b''.join(a.tobytes() for a in arrays)
+        offset = payload.tell()
+        payload.write(blob)
+        prefixes.append({
+            'key': list(key),
+            'num_blocks': len(blocks),
+            'offset': offset,
+            'length': len(blob),
+            'crc': _prefix_crc(blob, key, pool.block_size, sig),
+        })
+        stats['exported'] += 1
+        stats['blocks'] += len(blocks)
+    header = json.dumps({
+        'version': PREFIX_ARTIFACT_VERSION,
+        'block_size': pool.block_size,
+        'leaves': leaves_meta or [],
+        'prefixes': prefixes,
+    }).encode()
+    tmp = f'{path}.tmp.{os.getpid()}'
+    with open(tmp, 'wb') as f:
+        f.write(PREFIX_ARTIFACT_MAGIC)
+        f.write(struct.pack('>I', len(header)))
+        f.write(header)
+        # getbuffer(), not getvalue(): the payload is the bulk of the
+        # artifact and this runs inside the preemption notice window —
+        # a second full copy risks OOM-aborting the export.
+        f.write(payload.getbuffer())
+    os.replace(tmp, path)
+    return stats
+
+
+def read_artifact_header(path: str) -> Tuple[Dict[str, Any], int]:
+    """(header dict, payload byte offset). Raises ArtifactError when
+    the file is not a readable artifact of a known version."""
+    try:
+        with open(path, 'rb') as f:
+            magic = f.read(len(PREFIX_ARTIFACT_MAGIC))
+            if magic != PREFIX_ARTIFACT_MAGIC:
+                raise ArtifactError(f'{path}: not a prefix artifact')
+            (hlen,) = struct.unpack('>I', f.read(4))
+            header = json.loads(f.read(hlen).decode())
+    except ArtifactError:
+        raise
+    except Exception as e:
+        raise ArtifactError(f'{path}: unreadable artifact: {e}') from e
+    if header.get('version') != PREFIX_ARTIFACT_VERSION:
+        raise ArtifactError(
+            f'{path}: artifact version {header.get("version")!r} != '
+            f'{PREFIX_ARTIFACT_VERSION}')
+    return header, len(PREFIX_ARTIFACT_MAGIC) + 4 + hlen
+
+
+def import_prefixes(path: str, index: PrefixIndex, pool: BlockPool,
+                    scatter: Callable[[Sequence[int], bytes], None],
+                    expect_leaves: Optional[List[Dict[str, Any]]] = None,
+                    on_prefix: Optional[Callable[[], None]] = None
+                    ) -> Dict[str, Any]:
+    """Rebuild trie entries from an artifact into `index`/`pool`.
+
+    `scatter(blocks, blob)` writes one prefix's raw block bytes into
+    the freshly-allocated pool blocks. `expect_leaves` (the importing
+    pool's per-leaf {shape, dtype} list) guards against importing a
+    layout the pool cannot hold. Per-prefix failures SKIP that prefix
+    (checksum mismatch, truncated payload); pool exhaustion stops the
+    pre-warm partially with allocator invariants intact; keys already
+    present are left untouched (double-import is idempotent). Returns
+    {'imported', 'blocks', 'skipped_corrupt', 'skipped_existing',
+     'stopped_pool_full', 'keys'} — `keys` are the imported key tuples
+    (the engine marks them pre-warmed for hit attribution).
+
+    Raises ArtifactError only for whole-artifact problems: unreadable
+    header, version mismatch, different block_size, incompatible leaf
+    layout. Nothing is mutated in that case.
+    """
+    header, payload_off = read_artifact_header(path)
+    if header.get('block_size') != pool.block_size:
+        raise ArtifactError(
+            f'{path}: artifact block_size {header.get("block_size")} != '
+            f'pool block_size {pool.block_size}')
+    if expect_leaves is not None and header.get('prefixes') and \
+            _leaf_sig(header.get('leaves', [])) != _leaf_sig(expect_leaves):
+        raise ArtifactError(
+            f'{path}: artifact cache layout does not match this '
+            f'engine (model config / dtype / kv-quant mismatch)')
+    sig = _leaf_sig(header.get('leaves', []))
+    stats = {'imported': 0, 'blocks': 0, 'skipped_corrupt': 0,
+             'skipped_existing': 0, 'stopped_pool_full': False,
+             'keys': []}
+    with open(path, 'rb') as f:
+        for meta in header.get('prefixes', []):
+            if on_prefix is not None:
+                on_prefix()
+            key = tuple(meta['key'])
+            if key in index:
+                stats['skipped_existing'] += 1
+                continue
+            f.seek(payload_off + meta['offset'])
+            blob = f.read(meta['length'])
+            if len(blob) != meta['length'] or \
+                    _prefix_crc(blob, key, pool.block_size,
+                                sig) != meta['crc']:
+                # Corrupt or truncated: never trusted, never imported.
+                stats['skipped_corrupt'] += 1
+                continue
+            if meta['num_blocks'] != -(-len(key) // pool.block_size):
+                # num_blocks itself is not under the CRC, but key and
+                # block_size ARE — a prefix of len(key) tokens spans
+                # exactly ceil(len/block_size) blocks, so a corrupted
+                # num_blocks cannot smuggle in a short block table
+                # (the engine would later walk blocks that were never
+                # allocated).
+                stats['skipped_corrupt'] += 1
+                continue
+            blocks: List[int] = []
+            try:
+                for _ in range(meta['num_blocks']):
+                    blocks.append(pool.alloc())
+            except PoolExhaustedError:
+                pool.release(blocks)
+                stats['stopped_pool_full'] = True
+                break
+            try:
+                scatter(blocks, blob)
+            except BaseException:
+                # A failed device write must not leak this prefix's
+                # blocks (the pool invariant the chaos tests check()).
+                pool.release(blocks)
+                raise
+            for _old_key, old_blocks in index.put(key, blocks):
+                pool.release(old_blocks)
+            stats['imported'] += 1
+            stats['blocks'] += len(blocks)
+            stats['keys'].append(key)
+    # Entries were INSERTED newest-first (matching the artifact's
+    # order, so pool exhaustion keeps the hottest prefixes) — which
+    # leaves LRU recency inverted. Re-touch oldest-first so the first
+    # post-prewarm eviction takes the coldest prefix, as the original
+    # engine would have.
+    for key in reversed(stats['keys']):
+        index.touch(key)
+    return stats

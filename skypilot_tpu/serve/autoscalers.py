@@ -163,6 +163,13 @@ class RequestRateAutoscaler(Autoscaler):
     ) -> List[int]:
         """Least-useful-first: old-version replicas, then by FSM order
         (PENDING before READY), reference: _select_replicas_to_scale_down."""
+        # A DRAINING replica is already on its way out with a
+        # replacement in flight (preemption lifecycle) — it counts
+        # toward the fleet but must never be PICKED as a downscale
+        # victim (tearing it down would cut its drain/export short and
+        # double-handle the preemption).
+        infos = [i for i in infos
+                 if i.status != serve_state.ReplicaStatus.DRAINING]
         order = {
             status: i for i, status in enumerate(
                 serve_state.ReplicaStatus.scale_down_decision_order())

@@ -81,6 +81,28 @@ def probe_timeout_seconds() -> float:
     return _env_float('SKYTPU_SERVE_PROBE_TIMEOUT', 15.0)
 
 
+# ---- preemption lifecycle (serve/replica_managers.py + server.py) ----
+
+
+def preempt_notice_budget_seconds() -> float:
+    """How long a replica gets between the preemption notice and the
+    kill: drain in-flight work, then export hot prefixes. GCP spot TPUs
+    give ~30s; tests shrink it."""
+    return _env_float('SKYTPU_SERVE_PREEMPT_NOTICE_BUDGET', 30.0)
+
+
+def relaunch_attempts() -> int:
+    """Launch attempts for a preemption-replacement replica (the shared
+    utils/retry.py ladder — jittered backoff so a storm's replacements
+    do not thundering-herd the provisioner)."""
+    return max(1, int(_env_float('SKYTPU_SERVE_RELAUNCH_ATTEMPTS', 3)))
+
+
+def relaunch_backoff_seconds() -> float:
+    """Base backoff between replacement launch attempts."""
+    return _env_float('SKYTPU_SERVE_RELAUNCH_BACKOFF', 2.0)
+
+
 # Consecutive failed readiness probes before a replica is considered
 # unhealthy (after it has first turned READY).
 PROBE_FAILURE_THRESHOLD = 3

@@ -229,7 +229,11 @@ class SkyServeController:
         self.autoscaler.collect_request_information(timestamps)
         return web.json_response({
             'ready_replica_urls':
-                self.replica_manager.get_ready_replica_urls()
+                self.replica_manager.get_ready_replica_urls(),
+            # Preemption-draining replicas: the LB drops these from its
+            # rotation the moment it syncs — no breaker round-trips.
+            'draining_replica_urls':
+                self.replica_manager.get_draining_replica_urls(),
         })
 
     async def _handle_replica_info(self,

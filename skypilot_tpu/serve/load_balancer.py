@@ -62,6 +62,13 @@ class _CommittedStreamError(Exception):
     look like a clean completion)."""
 
 
+class _ReplicaDrainingError(Exception):
+    """The upstream answered 'I am draining for preemption'
+    (X-SkyTPU-Draining) before any body was relayed: the replica is
+    HEALTHY, just departing — do not charge its circuit breaker; an
+    idempotent request replays on a different replica immediately."""
+
+
 class ReplicaCircuitBreaker:
     """Per-replica consecutive-error ejection with half-open probing.
 
@@ -200,6 +207,11 @@ class SkyServeLoadBalancer:
         self._ts_lock = threading.Lock()
         self._stop = asyncio.Event()
         self._upstream_session: Optional[aiohttp.ClientSession] = None
+        # Replicas known to be preemption-draining: excluded from
+        # selection IMMEDIATELY (controller sync + learned in-band from
+        # X-SkyTPU-Draining answers) — no breaker round-trips while a
+        # departing replica sheds.
+        self._draining_urls: Set[str] = set()
 
     def _session(self) -> aiohttp.ClientSession:
         """One long-lived session → keep-alive connection reuse on the hot
@@ -225,6 +237,18 @@ class SkyServeLoadBalancer:
                 data = await resp.json()
                 urls = data.get('ready_replica_urls', [])
                 self.policy.set_ready_replicas(urls)
+                # Controller truth anchors the learned set, but a
+                # drain learned in-band (an X-SkyTPU-Draining answer
+                # from a replica the controller still reports READY —
+                # the cloud delivered the notice directly, and the
+                # controller lags by up to the probe interval) must
+                # survive the sync. A learned url the controller no
+                # longer lists as ready HAS been retired/replaced, so
+                # dropping it there keeps a drained-died-came-back
+                # replica from staying excluded forever.
+                self._draining_urls = set(
+                    data.get('draining_replica_urls', [])) | (
+                        self._draining_urls & set(urls))
                 # Torn-down replicas must not leak metric series (or
                 # advertise a stale open-breaker gauge) forever on a
                 # long-lived LB: drop per-replica children the
@@ -271,7 +295,8 @@ class SkyServeLoadBalancer:
         last_err: Optional[Exception] = None
         for _ in range(attempts):
             blocked = self.breaker.blocked(
-                self.policy.ready_replica_urls) | tried
+                self.policy.ready_replica_urls) | tried | \
+                self._draining_urls
             replica_url = self.policy.select_replica(exclude=blocked)
             if replica_url is None:
                 break
@@ -285,7 +310,19 @@ class SkyServeLoadBalancer:
             self.breaker.claim_probe(replica_url)
             try:
                 return await self._proxy_once(request, replica_url,
-                                              headers, body)
+                                              headers, body,
+                                              detect_draining=idempotent)
+            except _ReplicaDrainingError:
+                # Preemption drain learned in-band (ahead of the next
+                # controller sync): exclude the replica and replay this
+                # idempotent request elsewhere. The replica answered —
+                # it is healthy — so its breaker is NOT charged; any
+                # half-open probe claim is released undetermined.
+                self.breaker.clear_probe(replica_url)
+                self._draining_urls.add(replica_url)
+                tried.add(replica_url)
+                logger.info('upstream %s is draining for preemption; '
+                            'replaying on another replica', replica_url)
             except _CommittedStreamError:
                 # Closes the downstream connection: no retry is
                 # possible once headers/chunks went out. If this was a
@@ -318,25 +355,40 @@ class SkyServeLoadBalancer:
                                 text=f'Upstream replica error: {last_err}')
         _LB_NO_REPLICA.inc()
         if tried or self.policy.ready_replica_urls:
-            # Replicas exist but every one is ejected/tried: shed load
-            # with a hint instead of hammering known-bad backends.
+            # Replicas exist but every one is ejected/draining/tried:
+            # shed load with a hint instead of hammering known-bad (or
+            # departing) backends.
             return web.Response(
                 status=503, headers={'Retry-After': '1'},
-                text='All replicas are unhealthy (circuit breaker '
-                     'open); retry shortly.')
+                text='All replicas are unhealthy or draining (circuit '
+                     'breaker open / preemption drain); retry shortly.')
         return web.Response(
             status=503,
             text='No ready replicas. The service may be starting or '
                  'scaled to zero; retry shortly.')
 
     async def _proxy_once(self, request: web.Request, replica_url: str,
-                          headers, body) -> web.StreamResponse:
+                          headers, body,
+                          detect_draining: bool = False
+                          ) -> web.StreamResponse:
         target = replica_url + str(request.rel_url)
         async with self._session().request(
                 request.method, target, headers=headers,
                 data=body if body else None,
                 timeout=aiohttp.ClientTimeout(
                     total=None, sock_connect=10)) as upstream:
+            if upstream.headers.get('X-SkyTPU-Draining') == '1':
+                # Learn the drain in-band on EVERY response carrying
+                # the header — serving traffic is POST, so without
+                # this the LB keeps round-robining a cloud-notified
+                # (controller-lagging) draining replica until the next
+                # sync, surfacing a 503 per pick.
+                self._draining_urls.add(replica_url)
+                if detect_draining:
+                    # Nothing relayed yet: safe to replay this
+                    # idempotent request on another replica instead of
+                    # surfacing the drain 503 to the client.
+                    raise _ReplicaDrainingError(replica_url)
             response = web.StreamResponse(
                 status=upstream.status,
                 headers={

@@ -31,11 +31,13 @@ import requests
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.observability import metrics as obs
 from skypilot_tpu.serve import constants
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.status_lib import ClusterStatus
 from skypilot_tpu.utils import fault_injection
+from skypilot_tpu.utils import retry as retry_lib
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu import task as task_lib
@@ -44,6 +46,11 @@ if typing.TYPE_CHECKING:
 logger = logging.getLogger(__name__)
 
 _DEFAULT_REPLICA_PORT = 8080
+
+_REPLICA_PREEMPTIONS = obs.counter(
+    'skytpu_replica_preemptions_total',
+    'Replica preemptions handled (notice-drained or detected dead)',
+    ('service',))
 
 
 class ReplicaInfo:
@@ -63,6 +70,14 @@ class ReplicaInfo:
         self.failure_reason: Optional[str] = None
         self.port: Optional[int] = None
         self.ip: Optional[str] = None
+        # Preemption lineage: how many preemptions led to this replica
+        # (a replacement inherits its predecessor's count + 1) — `serve
+        # status` shows churn per replica instead of a flat NOT_READY.
+        self.preemption_count = 0
+        # Last pre-warm outcome the replica reported via /health
+        # (dict: status/key/imported/blocks), captured by the
+        # readiness probe.
+        self.last_prewarm: Optional[Dict[str, Any]] = None
 
     @property
     def url(self) -> Optional[str]:
@@ -81,6 +96,9 @@ class ReplicaInfo:
             'launched_at': self.launched_at,
             'first_ready_time': self.first_ready_time,
             'failure_reason': self.failure_reason,
+            # getattr: rows pickled by older builds lack these fields.
+            'preemption_count': getattr(self, 'preemption_count', 0),
+            'last_prewarm': getattr(self, 'last_prewarm', None),
         }
 
     def __repr__(self) -> str:
@@ -116,28 +134,50 @@ class SkyPilotReplicaManager:
         if ports:
             base_port = int(str(ports[0]).split('-', maxsplit=1)[0])
         self._base_port = base_port
+        # Preemption accounting (skytpu_replica_preemptions_total has
+        # the cross-restart truth; this is the in-process view).
+        self.total_preemptions = 0
+        # Injectable retry plumbing for the replacement launch ladder:
+        # chaos tests swap in a collected sleep + seeded rng so storms
+        # run on a fake clock.
+        self._retry_sleep = time.sleep
+        self._retry_rng = None
+        # Replica ids whose preemption already produced a replacement
+        # (_handle_preemption's atomic check-and-claim).
+        self._preemptions_claimed: set = set()
 
     # ---------------- scaling entry points ----------------
 
     def scale_up(self,
-                 resources_override: Optional[Dict[str, Any]] = None
-                 ) -> int:
+                 resources_override: Optional[Dict[str, Any]] = None,
+                 preemption_lineage: int = 0) -> int:
         """Async: spawns a launch worker; returns the new replica id
-        (reference: scale_up → _launch_replica, replica_managers.py:671)."""
+        (reference: scale_up → _launch_replica, replica_managers.py:671).
+
+        `preemption_lineage` > 0 marks this replica as the replacement
+        of a preempted one: it inherits the preemption count (surfaced
+        by `serve status`) and its launch rides the shared retry ladder
+        (utils/retry.py) so a preemption storm's replacements back off
+        with jitter instead of thundering-herding the provisioner."""
         with self.lock:
             replica_id = self._next_replica_id
             self._next_replica_id += 1
             cluster_name = constants.replica_cluster_name(
                 self.service_name, replica_id)
-            is_spot = bool((resources_override or {}).get('use_spot'))
-            if not is_spot:
+            if resources_override and 'use_spot' in resources_override:
+                # An explicit override decides spot-ness either way —
+                # {'use_spot': False} must pin on-demand, not fall
+                # through to the task default.
+                is_spot = bool(resources_override['use_spot'])
+            else:
                 is_spot = any(r.use_spot for r in self.task.resources)
             info = ReplicaInfo(replica_id, cluster_name, self.version,
                                is_spot)
+            info.preemption_count = preemption_lineage
             self.replicas[replica_id] = info
             self._persist(info)
         self._spawn(self._launch_replica, replica_id,
-                    resources_override or {})
+                    resources_override or {}, preemption_lineage > 0)
         return replica_id
 
     def scale_down(self, replica_id: int, purge: bool = False,
@@ -210,20 +250,37 @@ class SkyPilotReplicaManager:
         return task
 
     def _launch_replica(self, replica_id: int,
-                        resources_override: Dict[str, Any]) -> None:
+                        resources_override: Dict[str, Any],
+                        retry_ladder: bool = False) -> None:
         from skypilot_tpu import execution
         with self.lock:
             info = self.replicas[replica_id]
             info.status = ReplicaStatus.PROVISIONING
             self._persist(info)
         task = self._replica_task(replica_id, resources_override)
-        try:
-            job_id, handle = execution.launch(
+
+        def _do_launch():
+            return execution.launch(
                 task,
                 cluster_name=info.cluster_name,
                 detach_run=True,
                 stream_logs=False,
                 quiet_optimizer=True)
+
+        try:
+            if retry_ladder:
+                # Preemption replacement: the shared jittered-backoff
+                # ladder instead of ad-hoc sleeps — N simultaneous
+                # replacements (a storm) spread their attempts.
+                job_id, handle = retry_lib.call_with_retry(
+                    _do_launch,
+                    attempts=constants.relaunch_attempts(),
+                    base=constants.relaunch_backoff_seconds(),
+                    cap=30.0,
+                    sleep=self._retry_sleep,
+                    rng=self._retry_rng)
+            else:
+                job_id, handle = _do_launch()
             assert job_id is not None
         except Exception as e:  # pylint: disable=broad-except
             logger.warning('Replica %d launch failed: %s', replica_id, e)
@@ -275,18 +332,20 @@ class SkyPilotReplicaManager:
 
     # ---------------- probing ----------------
 
-    def _probe_one(self, info: ReplicaInfo) -> bool:
+    def _probe_one(self, info: ReplicaInfo) -> str:
         """HTTP readiness probe (reference: probe, replica_managers.py:487).
-        Returns readiness."""
+        Returns 'ready', 'draining' (the replica is draining ITSELF —
+        a cloud-delivered preemption notice the manager never saw), or
+        'down'."""
         url = info.url
         if url is None:
-            return False
+            return 'down'
         try:
             # Chaos harness: an armed 'replica.probe' fault reads as a
             # failed probe, driving the NOT_READY/threshold machinery.
             fault_injection.point('replica.probe')
         except fault_injection.InjectedFault:
-            return False
+            return 'down'
         probe_url = url + self.spec.readiness_path
         try:
             if self.spec.post_data is not None:
@@ -300,9 +359,22 @@ class SkyPilotReplicaManager:
                     probe_url,
                     headers=self.spec.readiness_headers,
                     timeout=constants.probe_timeout_seconds())
-            return resp.status_code == 200
+            if resp.status_code == 200:
+                # In-tree servers report their last prefix pre-warm in
+                # the health payload; record it so `serve status` can
+                # show whether the replacement came up warm.
+                try:
+                    prewarm = resp.json().get('prewarm')
+                    if prewarm is not None:
+                        info.last_prewarm = prewarm
+                except (ValueError, AttributeError):
+                    pass
+                return 'ready'
+            if resp.headers.get('X-SkyTPU-Draining') == '1':
+                return 'draining'
+            return 'down'
         except requests.RequestException:
-            return False
+            return 'down'
 
     def _cluster_status(self, info: ReplicaInfo
                         ) -> Optional[ClusterStatus]:
@@ -324,9 +396,33 @@ class SkyPilotReplicaManager:
                  ReplicaStatus.NOT_READY)
             ]
         for info in infos:
-            ready = self._probe_one(info)
+            verdict = self._probe_one(info)
             with self.lock:
-                if ready:
+                if self.replicas.get(info.replica_id) is not info or \
+                        info.status not in (ReplicaStatus.STARTING,
+                                            ReplicaStatus.READY,
+                                            ReplicaStatus.NOT_READY):
+                    # Status changed while the probe was in flight — a
+                    # preemption notice flipped it to DRAINING, or a
+                    # teardown removed it. The sweep's stale verdict
+                    # must not clobber that state (a DRAINING replica
+                    # answers /health 503 by design).
+                    continue
+                if verdict == 'draining':
+                    # The replica is draining ITSELF: the cloud
+                    # delivered a SIGTERM notice directly and the
+                    # server is running the drain+export body on its
+                    # own. Hold DRAINING (visible to `serve status`,
+                    # shipped to the LB, counted toward the fleet) for
+                    # the notice window, then replace — don't let
+                    # three of these by-design 503s flip a healthy
+                    # drain to FAILED_PROBING.
+                    info.status = ReplicaStatus.DRAINING
+                    self._persist(info)
+                    self._spawn(self._finish_self_drain,
+                                info.replica_id)
+                    continue
+                if verdict == 'ready':
                     if info.first_ready_time is None:
                         info.first_ready_time = time.time()
                     info.consecutive_failure_count = 0
@@ -366,11 +462,127 @@ class SkyPilotReplicaManager:
                     info.status = ReplicaStatus.NOT_READY
                     self._persist(info)
 
+    # ---------------- preemption lifecycle ----------------
+    # (docs/resilience.md "Preemption lifecycle": notice → drain →
+    # KV-block export → delete → retry-laddered replacement → the
+    # replacement pre-warms its PrefixIndex from the newest artifact
+    # before its readiness probe ever passes.)
+
+    def handle_preemption_notice(self, replica_id: int,
+                                 deadline_s: Optional[float] = None
+                                 ) -> Optional[Dict[str, Any]]:
+        """A preemption NOTICE arrived for a still-alive replica (cloud
+        spot warning; tests): drain it and export its hot prefixes
+        within the notice budget, then delete and replace. Returns the
+        replica's /preempt response (None when the notice could not be
+        delivered — the lifecycle still proceeds as delete-and-
+        replace)."""
+        with self.lock:
+            info = self.replicas.get(replica_id)
+        if info is None:
+            return None
+        outcome = self._deliver_preempt_notice(info, deadline_s)
+        self._handle_preemption(replica_id)
+        return outcome
+
+    def _deliver_preempt_notice(self, info: ReplicaInfo,
+                                deadline_s: Optional[float]
+                                ) -> Optional[Dict[str, Any]]:
+        """Best-effort POST /preempt: flip the replica to DRAINING (the
+        LB routes away on its next sync, without breaker round-trips)
+        and let it drain + export. Any failure degrades to the
+        delete-and-replace path — never blocks the lifecycle."""
+        budget = (deadline_s if deadline_s is not None else
+                  constants.preempt_notice_budget_seconds())
+        if info.url is None:
+            return None
+        try:
+            # Chaos seam: an armed fault is a notice that never reaches
+            # the replica (it was already gone / network partitioned).
+            fault_injection.point('replica.preempt_notice')
+        except fault_injection.InjectedFault:
+            logger.warning(
+                'Preemption notice to replica %d undeliverable '
+                '(injected); falling back to delete-and-replace.',
+                info.replica_id)
+            return None
+        with self.lock:
+            if info.status == ReplicaStatus.SHUTTING_DOWN or \
+                    info.status.is_failed():
+                # A teardown is already in flight (autoscaler
+                # downscale, earlier notice): flipping it back to
+                # DRAINING would defeat scale_down's double-teardown
+                # guard. Nothing to drain.
+                return None
+            info.status = ReplicaStatus.DRAINING
+            self._persist(info)
+        try:
+            resp = requests.post(info.url + '/preempt',
+                                 json={'deadline_s': budget},
+                                 timeout=budget + 5.0)
+            if resp.status_code == 200:
+                return resp.json()
+            logger.warning('Replica %d /preempt answered %d.',
+                           info.replica_id, resp.status_code)
+        except (requests.RequestException, ValueError) as e:
+            logger.warning(
+                'Preemption notice to replica %d failed (%s); falling '
+                'back to delete-and-replace.', info.replica_id, e)
+        return None
+
+    def _finish_self_drain(self, replica_id: int) -> None:
+        """Companion to the probe sweep's 'draining' verdict: the
+        replica is running its own drain+export off a cloud-delivered
+        SIGTERM, so it holds DRAINING — the same observable window the
+        POST /preempt path produces — until it stops answering or the
+        notice budget lapses, and only then is deleted and replaced."""
+        deadline = time.time() + constants.preempt_notice_budget_seconds()
+        while time.time() < deadline:
+            with self.lock:
+                info = self.replicas.get(replica_id)
+                if info is None or \
+                        info.status != ReplicaStatus.DRAINING:
+                    return  # already handled elsewhere
+            if self._probe_one(info) == 'down':
+                break  # drain body finished; the process exited
+            time.sleep(min(2.0, max(0.1, deadline - time.time())))
+        with self.lock:
+            info = self.replicas.get(replica_id)
+            if info is None or info.status != ReplicaStatus.DRAINING:
+                return
+        self._handle_preemption(replica_id)
+
     def _handle_preemption(self, replica_id: int) -> None:
         """Preempted slices are deleted and replaced (TPU slices cannot
-        restart in place; the autoscaler sees the fleet shrink and scales
-        back up on its next tick)."""
+        restart in place). The replacement launches IMMEDIATELY with
+        the shared retry ladder and inherits the preemption lineage;
+        by the time its readiness probe passes it has pre-warmed its
+        prefix index from the newest export (server-side, before
+        /health flips ready)."""
+        with self.lock:
+            info = self.replicas.get(replica_id)
+            if info is None or \
+                    info.status == ReplicaStatus.SHUTTING_DOWN or \
+                    replica_id in self._preemptions_claimed:
+                # Another path already claimed this preemption (the
+                # notice thread and the self-drain worker can race,
+                # and both can pass a status check while the replica
+                # is still DRAINING — the claim set makes the
+                # check-and-claim atomic under the lock): exactly ONE
+                # replacement per preempted replica.
+                return
+            self._preemptions_claimed.add(replica_id)
+            lineage = getattr(info, 'preemption_count', 0) + 1
+            # The replacement must keep the preempted replica's
+            # capacity type: on a mixed fleet (spot workers over an
+            # on-demand base) relaunching with the task default would
+            # silently swap e.g. the guaranteed base for another spot.
+            override = {'use_spot': info.is_spot}
+        self.total_preemptions += 1
+        _REPLICA_PREEMPTIONS.labels(service=self.service_name).inc()
         self.scale_down(replica_id, purge=True)
+        self.scale_up(resources_override=override,
+                      preemption_lineage=lineage)
 
     # ---------------- views / persistence ----------------
 
@@ -387,6 +599,17 @@ class SkyPilotReplicaManager:
             return [
                 i.url for i in self.replicas.values()
                 if i.status == ReplicaStatus.READY and i.url is not None
+            ]
+
+    def get_draining_replica_urls(self) -> List[str]:
+        """Replicas mid-preemption-drain: the LB excludes these the
+        moment it learns of them (no breaker round-trips) and replays
+        idempotent in-flight requests elsewhere."""
+        with self.lock:
+            return [
+                i.url for i in self.replicas.values()
+                if i.status == ReplicaStatus.DRAINING and
+                i.url is not None
             ]
 
     # ---------------- version updates ----------------

@@ -29,6 +29,11 @@ class ReplicaStatus(enum.Enum):
     FAILED_PROVISION = 'FAILED_PROVISION'
     FAILED_CLEANUP = 'FAILED_CLEANUP'
     PREEMPTED = 'PREEMPTED'
+    # Preemption notice received: the replica stopped admitting, is
+    # finishing in-flight work and exporting its hot prefix blocks
+    # within the notice budget (docs/resilience.md "Preemption
+    # lifecycle"). The LB routes away from it immediately.
+    DRAINING = 'DRAINING'
     SHUTTING_DOWN = 'SHUTTING_DOWN'
 
     def is_failed(self) -> bool:
@@ -39,12 +44,16 @@ class ReplicaStatus(enum.Enum):
 
     def counts_toward_fleet(self) -> bool:
         """Whether the autoscaler should count this replica when sizing
-        the fleet: dying (SHUTTING_DOWN/PREEMPTED) and failed replicas do
-        NOT count, so their replacements launch immediately rather than
-        after the (minutes-long) slice teardown completes."""
+        the fleet: dying (SHUTTING_DOWN/PREEMPTED) and failed replicas
+        do NOT count, so their replacements launch immediately rather
+        than after the (minutes-long) slice teardown completes.
+        DRAINING DOES count: the preemption handler launches the
+        replacement itself (with lineage + retry ladder) the moment
+        the drain ends, and the drain window lasts long enough for an
+        autoscaler tick to otherwise double-provision."""
         return self in (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
                         ReplicaStatus.STARTING, ReplicaStatus.READY,
-                        ReplicaStatus.NOT_READY)
+                        ReplicaStatus.NOT_READY, ReplicaStatus.DRAINING)
 
     @classmethod
     def scale_down_decision_order(cls) -> List['ReplicaStatus']:
@@ -73,7 +82,11 @@ class ServiceStatus(enum.Enum):
         if any(s == ReplicaStatus.READY for s in statuses):
             return cls.READY
         if any(s in (ReplicaStatus.PROVISIONING, ReplicaStatus.STARTING,
-                     ReplicaStatus.PENDING) for s in statuses):
+                     ReplicaStatus.PENDING, ReplicaStatus.DRAINING)
+               for s in statuses):
+            # DRAINING here: mid-preemption-storm the fleet is between
+            # replicas (old ones draining, replacements provisioning) —
+            # that is initialization churn, not NO_REPLICA.
             return cls.REPLICA_INIT
         if any(s.is_failed() for s in statuses):
             return cls.FAILED

@@ -35,15 +35,20 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import sys
+import tempfile
+import threading
 import time
 from typing import List, Optional
 
 from aiohttp import web
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.serve import constants as serve_constants
 from skypilot_tpu.observability import exposition
 from skypilot_tpu.observability import metrics as obs
+from skypilot_tpu.utils import fault_injection
 
 logger = logging.getLogger(__name__)
 
@@ -62,6 +67,16 @@ _SHED_TOTAL = obs.counter(
 _DRAINING_GAUGE = obs.gauge(
     'skytpu_server_draining',
     '1 while the server drains for shutdown, else 0')
+_PREEMPT_DRAIN_HIST = obs.histogram(
+    'skytpu_server_preempt_drain_seconds',
+    'Preemption notice → in-flight work drained: how much of the '
+    'notice budget the drain consumed (the remainder funds the '
+    'prefix export)',
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 30.0, 60.0))
+_PREEMPT_NOTICES = obs.counter(
+    'skytpu_server_preempt_notices_total',
+    'Preemption notices handled (POST /preempt or SIGTERM-with-'
+    'deadline)')
 
 
 @web.middleware
@@ -105,6 +120,13 @@ class InferenceServer:
     ready = False
     draining = False
     request_timeout = 0.0
+    # Preemption lifecycle (docs/resilience.md): where prefix artifacts
+    # go on notice / come from at pre-warm, the default notice budget,
+    # and the last pre-warm outcome (surfaced via /health → serve
+    # status).
+    prefix_store: Optional[str] = None
+    preempt_drain_timeout = 10.0
+    last_prewarm: Optional[dict] = None
 
     def __init__(self, model: str, max_seq_len: Optional[int] = None,
                  tokenizer: str = 'byte',
@@ -124,7 +146,9 @@ class InferenceServer:
                  paged_block_size: int = 0,
                  paged_num_blocks: Optional[int] = None,
                  prefill_chunk: int = 0,
-                 async_depth: int = 0) -> None:
+                 async_depth: int = 0,
+                 prefix_store: Optional[str] = None,
+                 preempt_drain_timeout: float = 10.0) -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
@@ -179,6 +203,17 @@ class InferenceServer:
         # Retry-After while in-flight ones finish; /health flips to 503
         # so LBs pull this replica from their ready set.
         self.draining = False
+        self.prefix_store = prefix_store
+        self.preempt_drain_timeout = preempt_drain_timeout
+        self.last_prewarm = None
+        # The notice body (_drain_and_export) runs EXACTLY ONCE, under
+        # this lock, and caches its outcome: a SIGTERM that lands
+        # while a notice is mid-flight waits for it; one that lands in
+        # the gap between `draining = True` and the executor starting
+        # the body runs the body itself; one that lands after a
+        # completed POST /preempt gets the cached outcome and exits.
+        self._notice_lock = threading.Lock()
+        self._notice_result: Optional[dict] = None
 
     # -- tokenizer --
 
@@ -197,11 +232,18 @@ class InferenceServer:
     async def handle_health(self, request: web.Request) -> web.Response:
         del request
         if self.draining:
-            return web.json_response({'status': 'draining'}, status=503,
-                                     headers={'Retry-After': '5'})
+            return web.json_response(
+                {'status': 'draining'}, status=503,
+                headers={'Retry-After': '5',
+                         'X-SkyTPU-Draining': '1'})
         if not self.ready:
             return web.json_response({'status': 'warming'}, status=503)
-        return web.json_response({'status': 'ok'})
+        payload = {'status': 'ok'}
+        if self.last_prewarm is not None:
+            # Surfaced to the replica manager's readiness probe, which
+            # records it on the ReplicaInfo (serve status shows it).
+            payload['prewarm'] = self.last_prewarm
+        return web.json_response(payload)
 
     # -- graceful degradation helpers --
 
@@ -210,11 +252,16 @@ class InferenceServer:
                      retry_after: int = 1,
                      reason: str = 'overloaded') -> web.Response:
         """Load-shedding response: overload/drain return 429/503 WITH
-        Retry-After instead of piling onto the batch queue."""
+        Retry-After instead of piling onto the batch queue. Draining
+        responses carry X-SkyTPU-Draining so the LB replays idempotent
+        requests on another replica immediately instead of charging
+        this (healthy, just departing) replica's circuit breaker."""
         _SHED_TOTAL.labels(reason=reason).inc()
+        headers = {'Retry-After': str(retry_after)}
+        if reason == 'draining':
+            headers['X-SkyTPU-Draining'] = '1'
         return web.json_response({'error': message}, status=status,
-                                 headers={'Retry-After':
-                                          str(retry_after)})
+                                 headers=headers)
 
     def _check_admission(self) -> Optional[web.Response]:
         if self.draining:
@@ -482,6 +529,185 @@ class InferenceServer:
         self.ready = True
         logger.info('engine warm in %.1fs', time.monotonic() - t0)
 
+    # -- preemption lifecycle (docs/resilience.md) --
+    #
+    # Notice paths: POST /preempt (the replica manager / tests) and
+    # SIGTERM-with-deadline (the cloud). Both stop admission, drain
+    # in-flight work under the existing graceful-drain machinery
+    # (which flushes the async ring and fails anything left with a
+    # RETRYABLE error — request identity is never silently lost), then
+    # export hot prefixes to the configured store within what remains
+    # of the notice budget. A replacement replica pre-warms from the
+    # newest artifact BEFORE flipping /health to ready.
+
+    def _can_export_prefixes(self) -> bool:
+        return bool(self.prefix_store and
+                    getattr(self.engine, 'paged_block_size', 0) and
+                    getattr(self.engine, 'prefix_cache', 0))
+
+    def _artifact_prefix(self) -> str:
+        service = os.environ.get('SKYTPU_SERVICE_NAME', '')
+        return f'{service}/' if service else ''
+
+    def _artifact_key(self) -> str:
+        rid = os.environ.get('SKYTPU_REPLICA_ID', '0')
+        # Zero-padded nanosecond stamp: "newest" == lexicographically
+        # last under list_keys' ascending sort.
+        return (f'{self._artifact_prefix()}'
+                f'prefix-{time.time_ns():020d}-r{rid}.skypfx')
+
+    def _export_to_store(self, budget_s: Optional[float]) -> dict:
+        """Export hot prefixes to the prefix store; returns the export
+        stats (+ 'key' when an artifact was published)."""
+        from skypilot_tpu.data import storage as storage_lib
+        store = storage_lib.artifact_store_from_url(self.prefix_store)
+        with tempfile.TemporaryDirectory(prefix='skytpu-pfx-') as tmp:
+            path = os.path.join(tmp, 'artifact.skypfx')
+            stats = self.engine.export_prefixes(path, budget_s=budget_s)
+            if stats.get('exported'):
+                key = self._artifact_key()
+                store.put_file(path, key)
+                stats['key'] = key
+                # Bound the store under preemption churn: pre-warm
+                # only ever walks the newest 3 artifacts, so anything
+                # older than the newest 5 is dead weight growing the
+                # bucket (and every replacement's listing) forever.
+                # Best-effort — a prune failure must not fail the
+                # export.
+                try:
+                    keys = store.list_keys(self._artifact_prefix())
+                    for old in keys[:-5]:
+                        store.delete_key(old)
+                    if len(keys) > 5:
+                        stats['pruned'] = len(keys) - 5
+                except Exception:  # pylint: disable=broad-except
+                    logger.warning('prefix-artifact prune failed',
+                                   exc_info=True)
+        return stats
+
+    def _drain_and_export(self, budget_s: float) -> dict:
+        """The synchronous notice body (runs off the event loop):
+        drain within most of the budget, then export with whatever
+        remains. Partial export under deadline is fine; a kill landing
+        mid-export publishes nothing (the artifact rename is atomic)."""
+        with self._notice_lock:
+            if self._notice_result is None:
+                self._notice_result = self._drain_and_export_impl(
+                    budget_s)
+            return dict(self._notice_result)
+
+    def _drain_and_export_impl(self, budget_s: float) -> dict:
+        _PREEMPT_NOTICES.inc()
+        t0 = time.monotonic()
+        deadline = t0 + budget_s
+        # Reserve a slice of the budget for the export itself.
+        export_reserve = min(2.0, budget_s * 0.3) \
+            if self._can_export_prefixes() else 0.0
+        drained = self.engine.drain(
+            timeout=max(0.1, budget_s - export_reserve))
+        _PREEMPT_DRAIN_HIST.observe(time.monotonic() - t0)
+        result: dict = {'drained': drained, 'export': None}
+        if not self._can_export_prefixes():
+            return result
+        if not drained:
+            # A timed-out drain can leave the engine thread mid-tick;
+            # export_prefixes requires a quiesced engine, and a
+            # snapshot raced by a live tick could publish a CRC-valid
+            # artifact holding stale KV. Losing the artifact is fine —
+            # the replacement just comes up cold; poisoning it is not.
+            result['error'] = 'drain timed out; export skipped'
+            return result
+        try:
+            # Chaos seam: the kill landing between drain and export.
+            fault_injection.point('replica.preempt_kill')
+            result['export'] = self._export_to_store(
+                budget_s=max(0.1, deadline - time.monotonic()))
+        except fault_injection.InjectedFault as e:
+            result['error'] = f'killed mid-export: {e}'
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('prefix export failed: %s', e)
+            result['error'] = str(e)
+        return result
+
+    async def handle_preempt(self, request: web.Request) -> web.Response:
+        """POST /preempt — the preemption-notice hook: stop admission
+        NOW, drain + export within the notice budget, answer with the
+        outcome. The process stays up (the actual kill comes from the
+        cloud); /health keeps answering 503-draining so the fleet
+        routes away."""
+        try:
+            data = await request.json()
+        except Exception:  # pylint: disable=broad-except
+            data = {}
+        if not isinstance(data, dict):
+            return web.json_response(
+                {'error': 'body must be a JSON object'}, status=400)
+        raw = data.get('deadline_s')
+        try:
+            # None → default; 0/negative/non-numeric → 400, never
+            # silently swapped for the default.
+            budget = (self.preempt_drain_timeout if raw is None
+                      else float(raw))
+            if budget <= 0:
+                raise ValueError('deadline_s must be > 0')
+        except (TypeError, ValueError) as e:
+            return web.json_response({'error': str(e)}, status=400)
+        if self.draining:
+            return web.json_response({'status': 'already-draining'})
+        self.draining = True
+        _DRAINING_GAUGE.set(1)
+        loop = asyncio.get_event_loop()
+        result = await loop.run_in_executor(
+            None, self._drain_and_export, budget)
+        result['status'] = 'drained'
+        return web.json_response(result)
+
+    def prewarm_from_store(self) -> Optional[dict]:
+        """Pre-warm the engine's PrefixIndex from the newest artifact
+        in the prefix store (walking back across up to 3 artifacts when
+        the newest is rejected wholesale). Failures never block
+        serving — the replica just comes up cold. Returns (and records
+        in self.last_prewarm) the outcome dict."""
+        if not self._can_export_prefixes():
+            return None
+        from skypilot_tpu.data import storage as storage_lib
+        from skypilot_tpu.models import kv_cache as kv_cache_lib
+        try:
+            store = storage_lib.artifact_store_from_url(self.prefix_store)
+            keys = store.list_keys(self._artifact_prefix())
+        except Exception as e:  # pylint: disable=broad-except
+            self.last_prewarm = {'status': 'failed', 'error': str(e)}
+            return self.last_prewarm
+        if not keys:
+            self.last_prewarm = {'status': 'no-artifact'}
+            return self.last_prewarm
+        for key in list(reversed(keys))[:3]:
+            try:
+                with tempfile.TemporaryDirectory(
+                        prefix='skytpu-pfx-') as tmp:
+                    path = os.path.join(tmp, 'artifact.skypfx')
+                    store.get_file(key, path)
+                    stats = self.engine.import_prefixes(path)
+                self.last_prewarm = {
+                    'status': 'ok', 'key': key,
+                    'imported': stats['imported'],
+                    'blocks': stats['blocks'],
+                    'skipped_corrupt': stats['skipped_corrupt'],
+                    'partial': stats['stopped_pool_full'],
+                }
+                return self.last_prewarm
+            except kv_cache_lib.ArtifactError as e:
+                # Whole artifact untrusted: try the next-newest.
+                logger.warning('pre-warm artifact %s rejected: %s',
+                               key, e)
+                self.last_prewarm = {'status': 'rejected',
+                                     'key': key, 'error': str(e)}
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning('pre-warm from %s failed: %s', key, e)
+                self.last_prewarm = {'status': 'failed',
+                                     'key': key, 'error': str(e)}
+        return self.last_prewarm
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
         """Prometheus text exposition of the process-wide registry:
         engine TTFT/TPOT histograms, queue depth, shed counters, and
@@ -529,6 +755,9 @@ class InferenceServer:
                     'server_error')
         headers = ({'Retry-After': str(retry_after)}
                    if retry_after is not None else None)
+        if shed_reason == 'draining':
+            headers = dict(headers or {})
+            headers['X-SkyTPU-Draining'] = '1'
         if shed_reason is not None:
             _SHED_TOTAL.labels(reason=shed_reason).inc()
         return web.json_response(
@@ -816,6 +1045,7 @@ class InferenceServer:
         app = web.Application(middlewares=[_metrics_middleware])
         app.router.add_get('/health', self.handle_health)
         app.router.add_get('/metrics', self.handle_metrics)
+        app.router.add_post('/preempt', self.handle_preempt)
         app.router.add_post('/generate', self.handle_generate)
         app.router.add_post('/v1/completions', self.handle_v1_completions)
         app.router.add_post('/v1/chat/completions', self.handle_v1_chat)
@@ -928,6 +1158,27 @@ def main(argv=None) -> int:
                         help='graceful shutdown (SIGTERM): stop '
                              'admitting, wait up to this long for '
                              'in-flight requests, then exit')
+    parser.add_argument('--prefix-store',
+                        default=os.environ.get('SKYTPU_PREFIX_STORE'),
+                        help='preemption-native serving: store URL for '
+                             'hot-prefix artifacts (gs://bucket, '
+                             'local://bucket, or a directory). On a '
+                             'preemption notice (POST /preempt or '
+                             'SIGTERM) cached prefixes export here; at '
+                             'startup the newest artifact pre-warms '
+                             'the prefix index BEFORE /health goes '
+                             'ready. Requires --paged-block-size and '
+                             '--prefix-cache. Default: '
+                             '$SKYTPU_PREFIX_STORE')
+    parser.add_argument('--preempt-drain-timeout', type=float,
+                        default=serve_constants
+                        .preempt_notice_budget_seconds(),
+                        help='default notice budget (seconds) for '
+                             'POST /preempt when the notice does not '
+                             'carry its own deadline_s (same env knob '
+                             'and default the replica manager uses: '
+                             '$SKYTPU_SERVE_PREEMPT_NOTICE_BUDGET, '
+                             'docs/resilience.md)')
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -950,9 +1201,17 @@ def main(argv=None) -> int:
                              paged_block_size=args.paged_block_size,
                              paged_num_blocks=args.paged_num_blocks,
                              prefill_chunk=args.prefill_chunk,
-                             async_depth=args.async_depth)
+                             async_depth=args.async_depth,
+                             prefix_store=args.prefix_store,
+                             preempt_drain_timeout=args.preempt_drain_timeout)
     logger.info('sampling filters: top_k=%s top_p=%s (0 = off)',
                 args.top_k, args.top_p)
+    # Preemption pre-warm BEFORE ready: a replacement replica restores
+    # the fleet's hot prefixes so its first shared-prefix request is a
+    # cache hit, not a TTFT cliff.
+    prewarm = server.prewarm_from_store()
+    if prewarm is not None:
+        logger.info('prefix pre-warm: %s', prewarm)
     server.warmup()
 
     # Graceful drain on SIGTERM: stop admitting (health flips to 503 so
@@ -967,16 +1226,40 @@ def main(argv=None) -> int:
         raise web.GracefulExit()
 
     def _drain_and_exit():
+        # SIGTERM-with-deadline IS a preemption notice: same drain +
+        # prefix-export body as POST /preempt, then exit.
         logger.info('SIGTERM: draining (finishing in-flight requests, '
-                    'timeout %.0fs)...', args.drain_timeout)
-        finished = server.engine.drain(timeout=args.drain_timeout)
-        logger.info('drain %s; shutting down.',
-                    'complete' if finished else 'timed out')
-        loop.call_soon_threadsafe(_graceful_exit)
+                    'budget %.0fs)...', args.drain_timeout)
+        result = server._drain_and_export(args.drain_timeout)  # pylint: disable=protected-access
+        logger.info('drain %s; export: %s; shutting down.',
+                    'complete' if result['drained'] else 'timed out',
+                    result.get('export') or result.get('error'))
+        _schedule_exit()
+
+    exit_scheduled = threading.Event()
+
+    def _schedule_exit():
+        if not exit_scheduled.is_set():
+            exit_scheduled.set()
+            loop.call_soon_threadsafe(_graceful_exit)
+
+    def _await_notice_then_exit():
+        # Already draining when the kill signal landed. The notice
+        # body is run-once-and-cached, so this call covers every
+        # interleaving: a POST /preempt that finished earlier returns
+        # its cached outcome immediately; one mid-flight is waited
+        # for; one scheduled but not yet started loses the race and
+        # THIS thread performs the drain+export instead. Then ALWAYS
+        # exit: swallowing the SIGTERM here used to leave the process
+        # running until SIGKILL.
+        server._drain_and_export(args.drain_timeout)  # pylint: disable=protected-access
+        _schedule_exit()
 
     def _on_sigterm(signum, frame):
         del signum, frame
         if server.draining:
+            threading.Thread(target=_await_notice_then_exit,
+                             daemon=True, name='drain-exit').start()
             return
         server.draining = True
         _DRAINING_GAUGE.set(1)

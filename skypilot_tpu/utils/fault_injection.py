@@ -13,6 +13,18 @@ on the framework's failure-critical paths:
     replica.probe   serve/replica_managers._probe_one — the replica
                     readiness probe
     storage.chunk   data/data_transfer — per transferred object/chunk
+    replica.preempt_notice
+                    serve/replica_managers — before the preemption
+                    notice (POST /preempt) is delivered to a replica;
+                    a failure simulates a notice that never arrives
+                    (fall back to delete-and-replace)
+    replica.preempt_kill
+                    serve/server preempt path — between drain and
+                    prefix export; a failure simulates the slice dying
+                    mid-notice (kill lands before the export publishes)
+    storage.export  prefix-artifact export — per exported prefix
+    storage.import  prefix-artifact import / pre-warm — per imported
+                    prefix
 
 Disarmed (the default, always in production) a point is a single
 module-level boolean check: no allocation, no locks, no behavior change
@@ -51,6 +63,10 @@ KNOWN_POINTS = (
     'engine.decode',
     'replica.probe',
     'storage.chunk',
+    'replica.preempt_notice',
+    'replica.preempt_kill',
+    'storage.export',
+    'storage.import',
 )
 
 

@@ -44,6 +44,45 @@ def pytest_configure(config):
     config.addinivalue_line(
         'markers', 'chaos: fault-injection resilience tests '
         '(deterministic, tier-1 — NOT slow)')
+    config.addinivalue_line(
+        'markers', 'deadline(seconds): hard per-test wall-clock bound '
+        'enforced with SIGALRM — a wedged e2e test FAILS with a '
+        'TimeoutError (and its children get reaped) instead of hanging '
+        'the suite until the outer kill loses every result')
+
+
+@pytest.fixture(autouse=True)
+def _test_deadline(request):
+    """Per-test deadline for tests carrying @pytest.mark.deadline(N).
+
+    The fake-cloud e2e loops (serve up/probe/down, benchmark runs)
+    block in subprocess waits and HTTP polls; under full-suite load a
+    wedged child used to stall the whole run. SIGALRM interrupts any
+    blocking syscall on the main thread, turning the stall into an
+    ordinary test failure — the _isolate_state teardown then reaps the
+    test's orphaned processes."""
+    import signal
+    import threading
+    marker = request.node.get_closest_marker('deadline')
+    if marker is None or not hasattr(signal, 'SIGALRM') or \
+            threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    seconds = float(marker.args[0])
+
+    def _expired(signum, frame):  # pylint: disable=unused-argument
+        raise TimeoutError(
+            f'{request.node.nodeid} exceeded its {seconds:.0f}s '
+            f'deadline (fake-cloud e2e wedge?); failing fast instead '
+            f'of hanging the suite')
+
+    old_handler = signal.signal(signal.SIGALRM, _expired)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old_handler)
 
 
 @pytest.fixture(autouse=True)

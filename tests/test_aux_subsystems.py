@@ -241,7 +241,12 @@ class TestCheckpoints:
 
 
 @pytest.mark.slow
+@pytest.mark.deadline(600)
 class TestBenchmarkEndToEnd:
+    """Hard per-test deadline (conftest SIGALRM): these fake-cloud
+    benchmark loops launch real subprocess fleets and historically
+    wedged under full-suite load instead of failing — the deadline
+    turns a stall into a fast, reaped failure."""
 
     def test_bench_two_candidates(self, tmp_path):
         """Two candidate slice shapes run the same 'training' task (which

@@ -166,11 +166,11 @@ class TestFaultInjectionFramework:
         # Nothing listens on this url: disarmed, the probe fails via the
         # ordinary RequestException path...
         info = types.SimpleNamespace(url='http://127.0.0.1:9')
-        assert SkyPilotReplicaManager._probe_one(fake, info) is False
+        assert SkyPilotReplicaManager._probe_one(fake, info) == 'down'
         # ...armed, the injected fault reads as a failed probe without
         # any network I/O.
         fault_injection.arm('replica.probe', 'fail')
-        assert SkyPilotReplicaManager._probe_one(fake, info) is False
+        assert SkyPilotReplicaManager._probe_one(fake, info) == 'down'
         assert fault_injection.trip_count('replica.probe') == 1
         fault_injection.disarm_all()
 

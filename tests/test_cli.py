@@ -178,8 +178,54 @@ class TestEnvFile:
         assert captured['envs']['MODEL'] == 'llama3-8b'
 
 
+class TestServeStatusPreemption:
+
+    def test_status_surfaces_draining_preemptions_and_prewarm(
+            self, runner, monkeypatch):
+        """Satellite: `serve status` shows the preemption lifecycle
+        per replica — DRAINING state, preemption lineage, last
+        pre-warm result — instead of a generic NOT_READY."""
+        import skypilot_tpu as sky_mod
+        from skypilot_tpu.serve.serve_state import ServiceStatus
+        records = [{
+            'name': 'svc', 'status': ServiceStatus.READY,
+            'endpoint': 'http://127.0.0.1:1',
+            'replica_info': [
+                {'replica_id': 1, 'status': 'DRAINING',
+                 'url': 'http://127.0.0.1:2', 'is_spot': True,
+                 'version': 1, 'preemption_count': 0,
+                 'last_prewarm': None},
+                {'replica_id': 2, 'status': 'READY',
+                 'url': 'http://127.0.0.1:3', 'is_spot': True,
+                 'version': 1, 'preemption_count': 2,
+                 'last_prewarm': {'status': 'ok', 'imported': 3,
+                                  'partial': False}},
+                # A row from an older build (no lifecycle keys) still
+                # renders.
+                {'replica_id': 3, 'status': 'READY',
+                 'url': 'http://127.0.0.1:4', 'is_spot': False,
+                 'version': 1},
+            ],
+        }]
+        monkeypatch.setattr(sky_mod.serve, 'status',
+                            lambda name=None: records)
+        result = _invoke(runner, ['serve', 'status', 'svc'])
+        assert result.exit_code == 0, result.output
+        assert 'DRAINING' in result.output
+        assert 'PREEMPTS' in result.output and 'PREWARM' in result.output
+        assert 'ok(3 pfx)' in result.output
+        line2 = [l for l in result.output.splitlines()
+                 if l.strip().startswith('2')][0]
+        assert ' 2 ' in line2  # the preemption lineage column
+
+
 @pytest.mark.slow
+@pytest.mark.deadline(600)
 class TestCliEndToEnd:
+    """Each test carries a hard wall-clock deadline: these fake-cloud
+    e2e loops historically WEDGED under full-suite load (orphaned
+    replica servers, half-run teardowns) and hung the run; now they
+    fail fast with a TimeoutError and their children get reaped."""
 
     def test_launch_status_queue_logs_down(self, runner, capfd):
         result = _invoke(runner, [

@@ -221,6 +221,7 @@ class TestServiceStatusAggregation:
 
 
 @pytest.mark.slow
+@pytest.mark.deadline(600)
 class TestServeEndToEnd:
 
     def _service_task(self, replicas=1, run=None):
