@@ -77,6 +77,22 @@ def _parse_args(argv=None):
                         help='tiny model, few steps (smoke)')
     parser.add_argument('--serve', action='store_true',
                         help='measure ONLY inference p50 TTFT')
+    parser.add_argument('--tp', type=int, default=0,
+                        help='serve row: tensor-parallel degree — '
+                             'shard the engine (weights + KV pool on '
+                             'the kv-head axis) over the first N local '
+                             'devices via parallel.decode_mesh; the '
+                             'row reports per-device weight/pool HBM '
+                             'and the compiled-HLO all-reduce count '
+                             '(0/1 = single-chip, the historical row)')
+    parser.add_argument('--dryrun-serve-sharded', action='store_true',
+                        help='emit the MULTICHIP_serve proxy row on 8 '
+                             'fake CPU devices (no chip needed): tp=N '
+                             '(--tp, default 2) sharded engine vs its '
+                             'single-chip twin — bit-identical greedy, '
+                             'per-device weights+pool <= (1/N + eps), '
+                             'collective count from the compiled-HLO '
+                             'probe (parallel/hlo_probe)')
     parser.add_argument('--no-serve-row', action='store_true',
                         help='skip the serve row in the default sweep')
     parser.add_argument('--quantize', default=None, choices=['int8'],
@@ -327,7 +343,11 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
                   paged_block_size=0, async_depth=0) -> dict:
     """p50/p99 time-to-first-token + aggregate decode throughput under
     concurrent requests on the local chip(s) via the continuous-batching
-    engine (models/inference.py) — the BASELINE.md serving row."""
+    engine (models/inference.py) — the BASELINE.md serving row.
+
+    `mesh` with tp>1 (parallel.decode_mesh) measures the SHARDED
+    engine: the row gains per-device weight/pool HBM and the
+    compiled-HLO all-reduce proxy next to the usual TTFT numbers."""
     import time as time_lib
 
     from skypilot_tpu.models import inference as inference_lib
@@ -361,6 +381,19 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
     occupancy = engine.paged_occupancy()
     tick_stats = dict(engine.tick_stats)
     host_gap_s = tick_stats['host_gap_s'] - gap0
+    tp_row = {}
+    if getattr(engine, '_tp', 1) > 1:
+        mem = engine.memory_footprint()
+        hlo = engine.decode_hlo_stats()
+        tp_row = {
+            'tp': mem['tp'],
+            'per_device_weight_mb': round(
+                mem['weight_bytes_per_device'] / 2**20, 2),
+            'per_device_kv_mb': round(
+                mem['kv_bytes_per_device'] / 2**20, 2),
+            'tp_collectives': hlo['total'],
+            'tp_allreduce_bytes_per_step': hlo['all_reduce_bytes'],
+        }
     engine.stop()
     ttfts = sorted(st['ttft_s'] for st in stats)
     total_new = sum(st['new_tokens'] for st in stats)
@@ -387,6 +420,7 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
     # the async pipeline (--async-depth 1) exists to remove.
     row['host_gap_frac'] = round(min(1.0, host_gap_s / max(wall, 1e-9)),
                                  4)
+    row.update(tp_row)
     row['async_depth'] = async_depth
     row['chained_dispatches'] = tick_stats['chained'] - chained0
     if speculative:
@@ -419,7 +453,118 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
             row['paged_int8_bytes_saved'] = engine.paged_int8_bytes_saved
             row['paged_int8_mb_saved'] = round(
                 engine.paged_int8_bytes_saved / 2**20, 1)
+        if 'pool_bytes_per_device' in occupancy:
+            # tp>1: every device holds its kv-head shard of EVERY
+            # block — bytes, not block counts, divide by tp.
+            row['paged_pool_bytes_per_device'] = \
+                occupancy['pool_bytes_per_device']
     return row
+
+
+def _dryrun_serve_sharded(args) -> int:
+    """MULTICHIP_serve: the sharded-serving proxy row on 8 fake CPU
+    devices (runs with the chip unreachable — the BENCH_r03+ compile/
+    transfer-count-pin pattern, extended to sharding).
+
+    Builds a tp=N ContinuousBatchingEngine (paged + int8 pool — the
+    full composed substrate) next to a single-chip twin and pins:
+    bit-identical greedy output, per-device weights+pool bytes
+    <= (1/N + eps) of the single-chip footprint, and >0 all-reduces in
+    the compiled decode step (parallel/hlo_probe). Emits ONE JSON row
+    mirroring the MULTICHIP_r0x dryrun contract."""
+    from __graft_entry__ import _force_cpu_devices
+    _force_cpu_devices(8)
+    import dataclasses
+
+    import jax
+
+    from skypilot_tpu.models import get_config
+    from skypilot_tpu.models import inference as inference_lib
+    from skypilot_tpu.parallel import decode_mesh
+
+    tp = args.tp if args.tp and args.tp > 1 else 2
+    cfg = dataclasses.replace(
+        get_config('test-tiny'), dtype='float32', param_dtype='float32',
+        max_seq_len=64, remat=False)
+    prompt = list(range(1, 17))
+    kw = dict(num_slots=4, paged_block_size=8, kv_quant='int8')
+
+    base = inference_lib.ContinuousBatchingEngine(cfg, **kw)
+    ref, _ = base.generate(prompt, max_new_tokens=12)
+    mem0 = base.memory_footprint()
+    base.stop()
+
+    engine = inference_lib.ContinuousBatchingEngine(
+        cfg, mesh=decode_mesh(tp), **kw)
+    got, _ = engine.generate(prompt, max_new_tokens=12)
+    mem = engine.memory_footprint()
+    hlo = engine.decode_hlo_stats()
+    occupancy = engine.paged_occupancy()
+    engine.stop()
+
+    eps = 0.05
+    frac = mem['total_bytes_per_device'] / max(1, mem0['total_bytes'])
+    ok = bool(got == ref and frac <= 1.0 / tp + eps
+              and hlo['all_reduce'] > 0)
+    row = {
+        'metric': 'MULTICHIP_serve dryrun',
+        'value': float(tp),
+        'unit': 'tp',
+        'vs_baseline': 1.0,
+        'n_devices': len(jax.devices()),
+        'tp': tp,
+        'ok': ok,
+        'skipped': False,
+        'greedy_bit_identical': got == ref,
+        'per_device_weight_bytes': mem['weight_bytes_per_device'],
+        'per_device_pool_bytes': mem['kv_bytes_per_device'],
+        'per_device_bytes': mem['total_bytes_per_device'],
+        'single_chip_bytes': mem0['total_bytes'],
+        'per_device_frac': round(frac, 4),
+        'max_frac': round(1.0 / tp + eps, 4),
+        'collectives': hlo['total'],
+        'allreduce_count': hlo['all_reduce'],
+        'allreduce_bytes_per_step': hlo['all_reduce_bytes'],
+        'pool_blocks_capacity': occupancy['blocks_capacity'],
+        'pool_bytes_per_device': occupancy.get('pool_bytes_per_device'),
+    }
+    print(json.dumps(row))
+    return 0 if ok else 1
+
+
+def _supervise_dryrun(argv) -> int:
+    """Run the sharded-serving dryrun in a subprocess with the fake
+    8-CPU-device environment — NO TPU preflight (the dryrun exists
+    precisely for when the chip is unreachable) and no retry ladder
+    (it is deterministic)."""
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    flags = env.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in flags:
+        env['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=8').strip()
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    cmd = [sys.executable, '-u', os.path.abspath(__file__),
+           '--worker'] + argv
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, text=True,
+                              timeout=_TIMEOUT_S, env=env, check=False)
+    except subprocess.TimeoutExpired:
+        _emit_skip(f'sharded serve dryrun timed out after '
+                   f'{_TIMEOUT_S:.0f}s')
+        return 1
+    for line in reversed((proc.stdout or '').splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict) and (
+                'metric' in parsed or parsed.get('skipped')):
+            print(line)
+            return proc.returncode
+    _emit_skip(f'sharded serve dryrun worker rc={proc.returncode} '
+               f'printed no JSON row')
+    return 1
 
 
 def _measure_train(cfg, mesh, n, batch, seq, steps, warmup) -> dict:
@@ -531,6 +676,11 @@ def _tune_attn(args) -> dict:
 
 
 def _worker(args) -> int:
+    if args.dryrun_serve_sharded:
+        # CPU-only by design; forces its own fake-device backend
+        # BEFORE any jax.devices() call.
+        return _dryrun_serve_sharded(args)
+
     import jax
 
     from skypilot_tpu.models import get_config
@@ -572,6 +722,20 @@ def _worker(args) -> int:
 
     if args.serve:
         serve_cfg = get_config(model_name, param_dtype='bfloat16')
+        if args.tp and args.tp > 1:
+            # Tensor-parallel serve row: tp innermost over the first N
+            # local chips (parallel.decode_mesh) instead of the
+            # training default (fsdp over everything). A tp exceeding
+            # the local device count is as deterministic a verdict as
+            # an engine-construction rejection — same structured skip,
+            # never the retry ladder.
+            from skypilot_tpu.parallel import decode_mesh
+            try:
+                mesh = decode_mesh(args.tp)
+            except ValueError as e:
+                _emit_skip(f'unsupported serve combination: {e}',
+                           combo={'tp': args.tp, 'n_devices': n})
+                return 3
         try:
             ttft = _measure_ttft(serve_cfg, mesh, quantize=args.quantize,
                                  decode_chunk=args.decode_chunk,
@@ -597,6 +761,8 @@ def _worker(args) -> int:
             return 3
         print(f'serve: {ttft}', file=sys.stderr)
         tags = [t for t in (args.quantize,
+                            f'tp-{args.tp}'
+                            if args.tp and args.tp > 1 else None,
                             f'kv-{args.kv_quant}' if args.kv_quant
                             else None,
                             f'spec-{args.speculative}'
@@ -681,6 +847,8 @@ def main() -> int:
     if args.worker:
         return _worker(args)
     argv = [a for a in sys.argv[1:] if a != '--worker']
+    if args.dryrun_serve_sharded:
+        return _supervise_dryrun(argv)
     return _supervise(argv)
 
 

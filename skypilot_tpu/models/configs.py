@@ -158,6 +158,28 @@ class ModelConfig:
     def head_dim(self) -> int:
         return self.head_dim_override or self.d_model // self.num_heads
 
+    def assert_tp_compatible(self, tp: int) -> None:
+        """Raise ValueError when a tensor-parallel degree cannot shard
+        this architecture evenly. Every dimension the `tp` rules in
+        parallel/sharding.py touch must divide: attention heads and KV
+        heads (QKV/O projections and the KV cache's kv-head axis), the
+        MLP hidden dim, and the (un)embedding vocab. GSPMD would pad an
+        uneven dim silently — wasted HBM and a broken per-device
+        footprint guarantee — so serving refuses it up front."""
+        if tp <= 1:
+            return
+        dims = {'num_heads': self.num_heads,
+                'num_kv_heads': self.num_kv_heads,
+                'd_mlp': self.d_mlp,
+                'vocab_size': self.vocab_size}
+        bad = {k: v for k, v in dims.items() if v % tp}
+        if bad:
+            raise ValueError(
+                f'{self.name}: tp={tp} does not divide '
+                + ', '.join(f'{k}={v}' for k, v in sorted(bad.items()))
+                + ' (pick tp dividing all of num_heads/num_kv_heads/'
+                  'd_mlp/vocab_size)')
+
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0

@@ -13,10 +13,12 @@ and the TTFT benchmark.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import functools
 import itertools
 import logging
+import math
 import time as time_lib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -30,6 +32,7 @@ from skypilot_tpu.models import kv_cache as kv_cache_lib
 from skypilot_tpu.models.configs import ModelConfig, get_config
 from skypilot_tpu.models.transformer import Transformer
 from skypilot_tpu.observability import metrics as obs
+from skypilot_tpu.parallel import sharding as sharding_lib
 from skypilot_tpu.utils import fault_injection
 
 logger = logging.getLogger(__name__)
@@ -132,6 +135,29 @@ _PREFIX_PREWARM_HIT = obs.counter(
     'skytpu_prefix_prewarm_hit_total',
     'Admission prefix-cache hits served from a PRE-WARMED (imported) '
     'entry — the TTFT saved across a preemption')
+_TP_SIZE = obs.gauge(
+    'skytpu_engine_tp_size',
+    'Tensor-parallel degree of the serving mesh (1 = single-chip)')
+_TP_COLLECTIVES = obs.gauge(
+    'skytpu_engine_tp_collectives',
+    'Collective ops in the compiled all-slots decode step '
+    '(compiled-HLO probe, parallel/hlo_probe; 0 until probed)')
+_TP_ALLREDUCE_BYTES = obs.gauge(
+    'skytpu_engine_tp_allreduce_bytes',
+    'Bytes one compiled decode step moves through all-reduce (the '
+    'per-layer tensor-parallel activation reductions over ICI; '
+    'compiled-HLO probe, 0 until probed or single-chip)')
+_PAGED_USED_PER_DEV = obs.gauge(
+    'skytpu_engine_paged_blocks_used_per_device',
+    'Paged KV pool blocks referenced, per mesh device. Block tables '
+    'are replicated host-side so counts match across devices; the '
+    'BYTES each block costs per device differ with tp — see '
+    'skytpu_engine_paged_pool_bytes_per_device', ('device',))
+_POOL_BYTES_PER_DEV = obs.gauge(
+    'skytpu_engine_paged_pool_bytes_per_device',
+    'HBM bytes of the paged KV pool resident on each mesh device '
+    '(every device holds its kv-head shard of every block: '
+    'pool bytes / tp)', ('device',))
 
 # step_log cap: enough history for any interleaving assertion while
 # bounding a serve replica that decodes for weeks (the old unbounded
@@ -155,19 +181,139 @@ class _StaleEngineError(Exception):
     the (already replaced) slots/queue/cache of its successor."""
 
 
-def _upload(value, dtype=None):
+def _upload(value, dtype=None, sharding=None):
     """The engine's single host→device upload funnel. Every hot-path
     host-list/scalar → device-array conversion routes through here so
     the tier-1 transfer-counting test can shim ONE symbol and pin the
     steady-state zero-upload property (a steady decode tick feeds the
-    previous dispatch's output arrays straight back — see _tick)."""
-    return jnp.asarray(value, dtype)
+    previous dispatch's output arrays straight back — see _tick).
+
+    `sharding` (a NamedSharding; tensor-parallel engines pass their
+    replicated placement) commits the array to every mesh device —
+    feeds, block tables and temps are tiny and every device needs them
+    whole, so replication is THE right layout and pinning it here keeps
+    jit signatures stable (no resharding, no recompiles when a feed
+    alternates between host-built and in-graph-chained)."""
+    arr = jnp.asarray(value, dtype)
+    if sharding is not None:
+        arr = jax.device_put(arr, sharding)
+    return arr
 
 
 # Monotone per-request ids: the device-feed / lookahead signatures key
 # on (seq, next_pos) so a finished request and its slot's next occupant
 # can never alias (unlike id(), which recycles).
 _REQ_SEQ = itertools.count()
+
+
+# ---------------- tensor-parallel serving helpers ----------------
+#
+# The sharding RULES live in parallel/sharding.py (the same table
+# training consumes); everything here is placement plumbing: validate
+# the mesh, translate the model's logical axis names into per-leaf
+# NamedShardings, and account bytes per device.
+
+
+def _mesh_tp(mesh) -> int:
+    """Tensor-parallel degree of a mesh (1 for None / axis absent)."""
+    if mesh is None:
+        return 1
+    try:
+        return int(dict(mesh.shape).get('tp', 1))
+    except (AttributeError, TypeError):
+        return 1
+
+
+def _validate_serving_mesh(cfg: ModelConfig, mesh) -> None:
+    """Serving meshes are tensor-parallel only (for now): kv-heads/
+    heads/mlp/vocab shard on `tp`, everything else stays replicated.
+    dp/fsdp-sharded decode batches are the fleet-scale roadmap item —
+    refuse them explicitly instead of letting GSPMD pad a 4-slot batch
+    over an 8-way fsdp axis."""
+    extra = {a: s for a, s in dict(mesh.shape).items()
+             if a != 'tp' and int(s) > 1}
+    if extra:
+        raise ValueError(
+            f'serving mesh supports tensor parallelism only; got extra '
+            f'axes {extra} (build it with parallel.decode_mesh(tp))')
+    cfg.assert_tp_compatible(_mesh_tp(mesh))
+
+
+def _abstract_init(model: Transformer, cfg: ModelConfig, batch: int):
+    """Boxed eval_shape of model.init in decode mode: the logical-axis
+    metadata source for param AND cache placement (paged cfgs thread a
+    dummy block table so Attention takes the paged path)."""
+    kw = {}
+    if cfg.paged_block_size:
+        width = cfg.max_seq_len // cfg.paged_block_size + 1
+        kw['block_tables'] = jnp.zeros((batch, width), jnp.int32)
+    return jax.eval_shape(lambda: model.init(
+        jax.random.PRNGKey(0), jnp.ones((batch, 1), jnp.int32),
+        jnp.zeros((batch, 1), jnp.int32), **kw))
+
+
+def _place_params(model: Transformer, cfg: ModelConfig, params,
+                  mesh):
+    """Shard a param tree onto the mesh per the shared logical-axis
+    rules: QKV/O on heads/kv_heads, MLP hidden on mlp, (un)embedding
+    on vocab — all mapped to `tp`. A random-init tree is already born
+    sharded (_resolve_cfg_and_params), so this is a no-op for it;
+    checkpoint-restored and quantized trees get the real reshard."""
+    boxed = _abstract_init(model, cfg, 1)['params']
+    shardings = nn.unbox(sharding_lib.tree_shardings(mesh, boxed))
+    return jax.device_put(params, shardings)
+
+
+def _zeros_from_shapes(boxed_shapes, mesh=None):
+    """Zeroed tree for eval_shape'd (boxed) cache shapes. With a mesh,
+    the zeros are BORN sharded (jit out_shardings from the logical
+    metadata: kv_heads → tp) — the pool never materializes whole on one
+    device, which is the entire point of sharding it."""
+    plain = nn.unbox(boxed_shapes)
+
+    def mk():
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                            plain, is_leaf=lambda x: hasattr(x, 'shape'))
+
+    if mesh is None:
+        return mk()
+    shardings = nn.unbox(sharding_lib.tree_shardings(mesh, boxed_shapes))
+    return jax.jit(mk, out_shardings=shardings)()
+
+
+def _tree_bytes(tree) -> Tuple[int, int]:
+    """(global_bytes, per_device_bytes) over a tree's array leaves.
+    Per-device sums each leaf's shard shape under its sharding;
+    replicated (or unsharded) leaves count whole on every device."""
+    total = per_dev = 0
+    for leaf in jax.tree.leaves(tree):
+        if not hasattr(leaf, 'nbytes'):
+            continue
+        total += int(leaf.nbytes)
+        sharding = getattr(leaf, 'sharding', None)
+        if sharding is None:
+            per_dev += int(leaf.nbytes)
+        else:
+            per_dev += (math.prod(sharding.shard_shape(leaf.shape))
+                        * leaf.dtype.itemsize)
+    return total, per_dev
+
+
+def infer_serving_tp(cfg: ModelConfig, n_devices: int) -> int:
+    """Largest tp that divides the local device count AND every
+    tp-sharded model dimension — the auto choice get_engine makes, so
+    a model too big for one chip serves over all of them without a
+    flag."""
+    best = 1
+    for t in range(1, n_devices + 1):
+        if n_devices % t:
+            continue
+        try:
+            cfg.assert_tp_compatible(t)
+        except ValueError:
+            continue
+        best = t
+    return best
 
 
 class _Inflight:
@@ -253,11 +399,21 @@ def _resolve_cfg_and_params(cfg: 'ModelConfig | str',
                             max_seq_len: Optional[int],
                             rng_seed: int,
                             quantize: Optional[str] = None,
-                            kv_quant: Optional[str] = None):
+                            kv_quant: Optional[str] = None,
+                            mesh: Optional[Any] = None):
     """Shared engine bring-up: normalize config to decode mode, init
     random weights when no checkpoint is given (bring-up / load-testing;
     real deployments restore via train/checkpoints.py), and optionally
-    quantize the float params for weight-only int8 serving."""
+    quantize the float params for weight-only int8 serving.
+
+    `mesh` with tp>1: random init runs with sharded out_shardings (the
+    trainer's create_sharded_state pattern), so the weight tree is BORN
+    split across devices — a model too big for one chip must never
+    materialize whole on device 0 on its way to being sharded.
+    Checkpoint params arrive however the caller restored them; the
+    engine's _place_params reshards those (sharded orbax restore onto
+    the serving mesh is the remaining follow-up for 70B-class
+    restores)."""
     if quantize not in (None, 'int8'):
         raise ValueError(f'unknown quantize mode {quantize!r}; '
                          f"supported: 'int8'")
@@ -270,6 +426,10 @@ def _resolve_cfg_and_params(cfg: 'ModelConfig | str',
         cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
     cfg = dataclasses.replace(cfg, decode=True, remat=False,
                               kv_cache_quant=kv_quant or '')
+    if mesh is not None and _mesh_tp(mesh) > 1:
+        # Fail with the divisibility/axis message BEFORE a sharded init
+        # can die inside XLA with an opaque partitioning error.
+        _validate_serving_mesh(cfg, mesh)
     if params is None:
         logger.info('Initializing random weights for %s', cfg.name)
         init_cfg = dataclasses.replace(cfg, decode=False,
@@ -277,10 +437,18 @@ def _resolve_cfg_and_params(cfg: 'ModelConfig | str',
         # jit the whole init: unjitted flax init dispatches hundreds of
         # small ops one by one — on a remote/tunneled device each pays a
         # round trip and a 1B-model bring-up stretches to many minutes.
-        params = nn.unbox(
-            jax.jit(Transformer(init_cfg).init)(
-                jax.random.PRNGKey(rng_seed),
-                jnp.ones((1, 8), jnp.int32)))['params']
+        model0 = Transformer(init_cfg)
+        rng = jax.random.PRNGKey(rng_seed)
+        dummy = jnp.ones((1, 8), jnp.int32)
+        if mesh is not None and _mesh_tp(mesh) > 1:
+            abstract = jax.eval_shape(lambda: model0.init(rng, dummy))
+            variables = jax.jit(
+                lambda r: model0.init(r, dummy),
+                out_shardings=sharding_lib.tree_shardings(
+                    mesh, abstract))(rng)
+        else:
+            variables = jax.jit(model0.init)(rng, dummy)
+        params = nn.unbox(variables)['params']
     if quantize:
         from skypilot_tpu.models.quantize import quantize_params
         cfg = dataclasses.replace(cfg, weight_quant='int8')
@@ -306,9 +474,11 @@ class InferenceEngine:
                  decode_chunk: int = 1,
                  kv_quant: Optional[str] = None,
                  top_k: int = 0,
-                 top_p: float = 0.0) -> None:
+                 top_p: float = 0.0,
+                 mesh: Optional[Any] = None) -> None:
         self.cfg, self.params = _resolve_cfg_and_params(
-            cfg, params, max_seq_len, rng_seed, quantize, kv_quant)
+            cfg, params, max_seq_len, rng_seed, quantize, kv_quant,
+            mesh=mesh)
         self.batch_size = batch_size
         # Engine-level sampling filters (jit-static: one compile).
         self.top_k, self.top_p = top_k, top_p
@@ -321,6 +491,17 @@ class InferenceEngine:
         self.decode_chunk = max(1, decode_chunk)
         self.model = Transformer(self.cfg)
         self._rng = jax.random.PRNGKey(rng_seed)
+        # Tensor-parallel serving (parallel.decode_mesh): weights and
+        # the KV cache shard on `tp` per the shared rule table; one
+        # engine then serves a model too big for one chip. tp=1 (or no
+        # mesh) is the historical single-chip path, bit for bit.
+        self.mesh = mesh
+        self._tp = _mesh_tp(mesh)
+        if self._tp > 1:
+            # Mesh already validated by _resolve_cfg_and_params.
+            self.params = _place_params(self.model, self.cfg,
+                                        self.params, mesh)
+            _TP_SIZE.set(self._tp)
 
         self._prefill = jax.jit(self._prefill_impl,
                                 static_argnames=('prompt_len',))
@@ -333,16 +514,12 @@ class InferenceEngine:
     # ---------------- cache ----------------
 
     def init_cache(self) -> Any:
-        """Fresh zeroed KV cache for one batch."""
-        shapes = jax.eval_shape(
-            lambda: self.model.init(
-                jax.random.PRNGKey(0),
-                jnp.ones((self.batch_size, 1), jnp.int32),
-                jnp.zeros((self.batch_size, 1), jnp.int32),
-            )['cache'])
-        return nn.unbox(
-            jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes,
-                         is_leaf=lambda x: hasattr(x, 'shape')))
+        """Fresh zeroed KV cache for one batch (born sharded on the
+        kv-head axis under a tp mesh)."""
+        shapes = _abstract_init(self.model, self.cfg,
+                                self.batch_size)['cache']
+        return _zeros_from_shapes(
+            shapes, self.mesh if self._tp > 1 else None)
 
     # ---------------- steps ----------------
 
@@ -406,6 +583,17 @@ class InferenceEngine:
                  ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
         """prompt: (B, prompt_len) int32. Returns
         ((B, <=max_new_tokens) generated ids, stats)."""
+        with (self.mesh if self.mesh is not None
+              else contextlib.nullcontext()):
+            return self._generate_under_mesh(prompt, max_new_tokens,
+                                             temperature, eos_id)
+
+    def _generate_under_mesh(self, prompt, max_new_tokens, temperature,
+                             eos_id):
+        """generate() body; runs inside the mesh context so the model's
+        logical sharding constraints resolve (XLA inserts the per-layer
+        tp all-reduces; a trivial no-mesh context leaves the historical
+        single-chip program untouched)."""
         assert prompt.ndim == 2 and prompt.shape[0] == self.batch_size, (
             f'prompt must be ({self.batch_size}, L); got {prompt.shape}')
         prompt_len = int(prompt.shape[1])
@@ -589,7 +777,8 @@ class ContinuousBatchingEngine:
         import queue as queue_lib
         import threading
         self.cfg, self.params = _resolve_cfg_and_params(
-            cfg, params, max_seq_len, rng_seed, quantize, kv_quant)
+            cfg, params, max_seq_len, rng_seed, quantize, kv_quant,
+            mesh=mesh)
         self.num_slots = num_slots
         self.mesh = mesh
         self.top_k, self.top_p = top_k, top_p
@@ -717,6 +906,35 @@ class ContinuousBatchingEngine:
         self._prefix_entries = self._new_prefix_index()
         self.model = Transformer(self.cfg)
         self._rng = jax.random.PRNGKey(rng_seed)
+        # -------- tensor-parallel serving (docs/performance.md) -----
+        # mesh with tp>1 (parallel.decode_mesh): weights shard per the
+        # SAME logical-axis rules training uses (heads/kv_heads/mlp/
+        # vocab → tp), the KV substrate — contiguous cache or paged
+        # block pool — splits on the kv-head axis per device, feeds
+        # and block tables stay replicated, and XLA inserts the
+        # per-layer all-reduce over ICI. Dispatch SHAPES are identical
+        # to single-chip, only layouts change, so the async ring /
+        # speculative / chunked-prefill paths compose unchanged.
+        self._tp = _mesh_tp(self.mesh)
+        self._repl = None
+        self._per_dev_gauges: list = []
+        self._pool_dev_bytes: Optional[int] = None
+        # Last decode_hlo_stats() result: the tick re-publishes its
+        # gauges (exporters usually enable AFTER engine construction
+        # and warmup — a probe-time-only set would read 0 forever, the
+        # PR-5 int8-gauge lesson).
+        self._hlo_probe_cache: Optional[Dict[str, Any]] = None
+        if self._tp > 1:
+            # Mesh already validated by _resolve_cfg_and_params.
+            self._repl = sharding_lib.replicated(self.mesh)
+            self.params = _place_params(self.model, self.cfg,
+                                        self.params, self.mesh)
+            _TP_SIZE.set(self._tp)
+            if self.paged_block_size:
+                self._per_dev_gauges = [
+                    (_PAGED_USED_PER_DEV.labels(device=str(i)),
+                     _POOL_BYTES_PER_DEV.labels(device=str(i)))
+                    for i in range(self._tp)]
 
         self._prefill = jax.jit(self._prefill_impl)
         self._prefill_continue = jax.jit(self._prefill_continue_impl)
@@ -783,29 +1001,26 @@ class ContinuousBatchingEngine:
                 jnp.zeros((1, 1), jnp.int32))['cache'])
 
     def _init_slot_cache(self) -> Any:
-        """Zeroed cache with batch == num_slots."""
+        """Zeroed cache with batch == num_slots (kv-head axis sharded
+        per device under a tp mesh)."""
         shapes = jax.eval_shape(
             lambda: self.model.init(
                 jax.random.PRNGKey(0),
                 jnp.ones((self.num_slots, 1), jnp.int32),
                 jnp.zeros((self.num_slots, 1), jnp.int32))['cache'])
-        return nn.unbox(
-            jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes,
-                         is_leaf=lambda x: hasattr(x, 'shape')))
+        return _zeros_from_shapes(
+            shapes, self.mesh if self._tp > 1 else None)
 
     def _init_paged_cache(self) -> Any:
         """Zeroed BLOCK POOL — batch-free (num_blocks, block, kv_heads,
         head_dim) leaves shared by prefill (batch 1) and decode
-        (batch num_slots) dispatches alike."""
-        width = self._blocks_per_seq + 1
-        shapes = jax.eval_shape(
-            lambda: self.model.init(
-                jax.random.PRNGKey(0), jnp.ones((1, 1), jnp.int32),
-                jnp.zeros((1, 1), jnp.int32),
-                block_tables=jnp.zeros((1, width), jnp.int32))['cache'])
-        return nn.unbox(
-            jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes,
-                         is_leaf=lambda x: hasattr(x, 'shape')))
+        (batch num_slots) dispatches alike. Under a tp mesh every leaf
+        (int8 scale rows included — same kv_heads axis) is born split
+        on the kv-head dim: each device holds 1/tp of every block, the
+        host-side block tables stay replicated."""
+        shapes = _abstract_init(self.model, self.cfg, 1)['cache']
+        return _zeros_from_shapes(
+            shapes, self.mesh if self._tp > 1 else None)
 
     def _init_cache_for_mode(self) -> Any:
         return (self._init_paged_cache() if self.paged_block_size
@@ -926,7 +1141,9 @@ class ContinuousBatchingEngine:
         out, cache = self._decode_impl(params, cache, tokens[:, None],
                                        positions[:, None], temps, rng,
                                        tables)
-        return out[:, None], (out, positions + 1), cache
+        out = self._repl_constrain(out)
+        return (out[:, None],
+                (out, self._repl_constrain(positions + 1)), cache)
 
     def _decode_multi_feed_impl(self, params, cache, tokens, positions,
                                 temps, rngs, tables=None):
@@ -935,7 +1152,21 @@ class ContinuousBatchingEngine:
         toks, cache = self._decode_multi_impl(params, cache, tokens,
                                               positions, temps, rngs,
                                               tables)
-        return toks, (toks[:, -1], positions + rngs.shape[0]), cache
+        toks = self._repl_constrain(toks)
+        return toks, (toks[:, -1],
+                      self._repl_constrain(positions + rngs.shape[0])), \
+            cache
+
+    def _repl_constrain(self, x):
+        """Pin an in-graph feed/emit array to REPLICATED under a tp
+        mesh: the feedback loop (sampled tokens + advanced positions
+        re-entering the next dispatch) must present the same sharding
+        as a host-built feed (_upload with self._repl), or the first
+        chained dispatch would compile a second program and every
+        host↔chain alternation would reshard. No-op single-chip."""
+        if self._tp <= 1:
+            return x
+        return jax.lax.with_sharding_constraint(x, self._repl)
 
     def _prefill_chunk_impl(self, params, cache, tokens, tables, start,
                             true_n):
@@ -1112,9 +1343,9 @@ class ContinuousBatchingEngine:
         self._rng, rng = jax.random.split(self._rng)
         out, accepted, cache = self._verify(
             self.params, self._cache,
-            _upload(tokens, jnp.int32),
-            _upload(positions, jnp.int32),
-            _upload(temps, jnp.float32), rng, tables)
+            _upload(tokens, jnp.int32, self._repl),
+            _upload(positions, jnp.int32, self._repl),
+            _upload(temps, jnp.float32, self._repl), rng, tables)
         self._commit_gen(gen, lambda: setattr(self, '_cache', cache))
         out = np.asarray(out)
         accepted = np.asarray(accepted)
@@ -1350,7 +1581,7 @@ class ContinuousBatchingEngine:
         for row, req in enumerate(reqs):
             if req is not None and req.blocks:
                 table[row, :len(req.blocks)] = req.blocks
-        return _upload(table)
+        return _upload(table, sharding=self._repl)
 
     def _trim_blocks(self, req: '_Request') -> None:
         """Roll the block table back after a speculative tick: rejected
@@ -1425,9 +1656,10 @@ class ContinuousBatchingEngine:
                     self._pool.release(blocks)
                     blocks.clear()   # shed path must not double-release
                     raise
-                pool_arr = self._cow_fn(self._cache,
-                                        _upload(entry[full], jnp.int32),
-                                        _upload(dst, jnp.int32))
+                pool_arr = self._cow_fn(
+                    self._cache,
+                    _upload(entry[full], jnp.int32, self._repl),
+                    _upload(dst, jnp.int32, self._repl))
                 if gen >= 0:
                     self._commit_gen(
                         gen, lambda: setattr(self, '_cache', pool_arr))
@@ -1507,10 +1739,10 @@ class ContinuousBatchingEngine:
                 [0] * (self.prefill_chunk - n)
             logits, pool_arr = self._prefill_chunk_fn(
                 self.params, self._cache,
-                _upload([chunk], jnp.int32),
+                _upload([chunk], jnp.int32, self._repl),
                 self._table_array([req]),
-                _upload(start, jnp.int32),
-                _upload(n, jnp.int32))
+                _upload(start, jnp.int32, self._repl),
+                _upload(n, jnp.int32, self._repl))
             self._commit_gen(gen,
                              lambda: setattr(self, '_cache', pool_arr))
             req.prefill_pos = start + n
@@ -1534,7 +1766,7 @@ class ContinuousBatchingEngine:
         pin ceil(L/block_size) prefix-entry costs against it)."""
         if not self.paged_block_size:
             return {}
-        return {
+        occ = {
             'block_size': self.paged_block_size,
             'blocks_capacity': self._pool.num_blocks,
             'blocks_used': self._pool.used,
@@ -1542,6 +1774,71 @@ class ContinuousBatchingEngine:
             'prefix_entries': len(self._prefix_entries),
             **self.paged_stats,
         }
+        if self._tp > 1 and self._cache is not None:
+            # Per-device view: each device holds its kv-head shard of
+            # every block, so bytes — not block counts — divide by tp.
+            total, per_dev = _tree_bytes(self._cache)
+            occ['tp'] = self._tp
+            occ['pool_bytes'] = total
+            occ['pool_bytes_per_device'] = per_dev
+        return occ
+
+    def memory_footprint(self) -> Dict[str, int]:
+        """Global and per-device bytes for the weights and the live KV
+        substrate (contiguous cache or paged pool). Per-device sums
+        each leaf's shard shape under its NamedSharding — the quantity
+        the MULTICHIP_serve dryrun pins at ≤ (1/tp + ε) of the
+        single-chip footprint. Initializes the cache if no tick ran
+        yet; call before serving traffic or while the engine is
+        quiescent (same contract as import_prefixes)."""
+        if self._cache is None:
+            self._cache = self._init_cache_for_mode()
+        weight, weight_dev = _tree_bytes(self.params)
+        kv, kv_dev = _tree_bytes(self._cache)
+        return {
+            'tp': self._tp,
+            'weight_bytes': weight,
+            'weight_bytes_per_device': weight_dev,
+            'kv_bytes': kv,
+            'kv_bytes_per_device': kv_dev,
+            'total_bytes': weight + kv,
+            'total_bytes_per_device': weight_dev + kv_dev,
+        }
+
+    def decode_hlo_stats(self) -> Dict[str, Any]:
+        """Compile the all-slots decode step and parse its optimized
+        HLO for collectives (parallel/hlo_probe): how many all-reduces
+        one tick pays and the bytes they move — the compile-time proxy
+        for ICI traffic while the chip is unreachable. Publishes
+        skytpu_engine_tp_collectives / skytpu_engine_tp_allreduce_bytes
+        and returns the stats dict.
+
+        COST: lower().compile() is the AOT path — it does NOT reuse
+        (or populate) the jit dispatch cache, so the first call pays
+        one full extra decode-step compile. The result is cached on
+        the engine, and callers keep it off the serving path (server
+        warmup before ready, bench rows, the dryrun)."""
+        from skypilot_tpu.parallel import hlo_probe
+        if self._hlo_probe_cache is not None:
+            return self._hlo_probe_cache
+        if self._cache is None:
+            self._cache = self._init_cache_for_mode()
+        tok = _upload([0] * self.num_slots, jnp.int32, self._repl)
+        pos = _upload([0] * self.num_slots, jnp.int32, self._repl)
+        temps = _upload([0.0] * self.num_slots, jnp.float32, self._repl)
+        tables = (self._table_array([None] * self.num_slots)
+                  if self.paged_block_size else None)
+        with (self.mesh if self.mesh is not None
+              else contextlib.nullcontext()):
+            compiled = self._decode.lower(
+                self.params, self._cache, tok, pos, temps,
+                jax.random.PRNGKey(0), tables).compile()
+        stats = hlo_probe.collective_stats(compiled.as_text())
+        stats['tp'] = self._tp
+        self._hlo_probe_cache = stats
+        _TP_COLLECTIVES.set(stats['total'])
+        _TP_ALLREDUCE_BYTES.set(stats['all_reduce_bytes'])
+        return stats
 
     # ---------------- prefix export / pre-warm (preemption path) -----
     #
@@ -1680,9 +1977,11 @@ class ContinuousBatchingEngine:
                     keep = np.sort(len(idx) - 1 - first_rev)
                     idx, arr = idx[keep], arr[keep]
                 arr = np.moveaxis(arr, 0, axis)
-                sel = (slice(None),) * axis + (_upload(idx),)
+                sel = (slice(None),) * axis + \
+                    (_upload(idx, sharding=self._repl),)
                 leaves[i] = leaves[i].at[sel].set(
-                    _upload(np.ascontiguousarray(arr)))
+                    _upload(np.ascontiguousarray(arr),
+                            sharding=self._repl))
 
         try:
             stats = kv_cache_lib.import_prefixes(
@@ -1729,11 +2028,11 @@ class ContinuousBatchingEngine:
             suffix = req.ids[plen:]
             bucket = self._bucket(len(suffix))
             tokens = _upload([suffix + [0] * (bucket - len(suffix))],
-                             jnp.int32)
+                             jnp.int32, self._repl)
             logits, cache1 = self._prefill_continue(
                 self.params, pcache, tokens,
-                _upload(plen, jnp.int32),
-                _upload(len(suffix), jnp.int32))
+                _upload(plen, jnp.int32, self._repl),
+                _upload(len(suffix), jnp.int32, self._repl))
             self.prefix_stats['hits'] += 1
             self.prefix_stats['tokens_reused'] += plen
             _PREFIX_HIT.inc()
@@ -1741,9 +2040,10 @@ class ContinuousBatchingEngine:
         else:
             bucket = self._bucket(true_len)
             padded = req.ids + [0] * (bucket - true_len)
-            tokens = _upload([padded], jnp.int32)
+            tokens = _upload([padded], jnp.int32, self._repl)
             logits, cache1 = self._prefill(
-                self.params, tokens, _upload(true_len, jnp.int32))
+                self.params, tokens,
+                _upload(true_len, jnp.int32, self._repl))
             if self.prefix_cache:
                 self.prefix_stats['misses'] += 1
                 _PREFIX_MISS.inc()
@@ -1762,7 +2062,7 @@ class ContinuousBatchingEngine:
         self._notify(req, first)
         req.next_pos = true_len
         cache = self._insert(self._cache, cache1,
-                             _upload(slot, jnp.int32))
+                             _upload(slot, jnp.int32, self._repl))
 
         def _commit():
             self._cache = cache
@@ -2035,6 +2335,15 @@ class ContinuousBatchingEngine:
         # behind the enabled-check).
         _ACTIVE_SLOTS.set(len(active))
         _QUEUE_DEPTH.set(queue.qsize())
+        # Re-set every tick, not only at construction/probe: the
+        # exporter typically enables AFTER warmup, and a gauge set
+        # while recording is disabled is a no-op. Unconditional so a
+        # single-chip engine reads the documented 1, not an unset 0.
+        _TP_SIZE.set(self._tp)
+        if self._tp > 1 and self._hlo_probe_cache is not None:
+            _TP_COLLECTIVES.set(self._hlo_probe_cache['total'])
+            _TP_ALLREDUCE_BYTES.set(
+                self._hlo_probe_cache['all_reduce_bytes'])
         if self._pool is not None:
             # Capacity re-set here (not only at __init__): the exporter
             # usually enables AFTER engine construction, and a gauge set
@@ -2043,6 +2352,18 @@ class ContinuousBatchingEngine:
             _PAGED_USED.set(self._pool.used)
             if self.paged_int8_bytes_saved:
                 _PAGED_INT8_SAVED.set(self.paged_int8_bytes_saved)
+            if self._per_dev_gauges:
+                # tp>1: per-device view of the pool. Bytes are static
+                # per engine (pool leaves / tp), computed once the
+                # cache exists; used-blocks match across devices while
+                # the block tables are replicated.
+                if self._pool_dev_bytes is None and \
+                        self._cache is not None:
+                    self._pool_dev_bytes = _tree_bytes(self._cache)[1]
+                for g_used, g_bytes in self._per_dev_gauges:
+                    g_used.set(self._pool.used)
+                    if self._pool_dev_bytes is not None:
+                        g_bytes.set(self._pool_dev_bytes)
         ring = self._ring
         if ring and ring[0].gen != gen:
             # A recovery swapped engine state since those dispatches
@@ -2204,7 +2525,8 @@ class ContinuousBatchingEngine:
         tsig = tuple(slots[i].temperature if i in active_set else 0.0
                      for i in range(self.num_slots))
         if tsig != self._temps_sig:
-            self._temps_cache = _upload(list(tsig), jnp.float32)
+            self._temps_cache = _upload(list(tsig), jnp.float32,
+                                        self._repl)
             self._temps_sig = tsig
         temps = self._temps_cache
         if chain is not None:
@@ -2226,11 +2548,11 @@ class ContinuousBatchingEngine:
                 tok_dev = _upload([(slots[i].tokens[-1]
                                     if i in active_set else 0)
                                    for i in range(self.num_slots)],
-                                  jnp.int32)
+                                  jnp.int32, self._repl)
                 pos_dev = _upload([(slots[i].next_pos
                                     if i in active_set else 0)
                                    for i in range(self.num_slots)],
-                                  jnp.int32)
+                                  jnp.int32, self._repl)
             gap = (time_lib.monotonic() - self._last_ready
                    if self._last_ready is not None else None)
         self._rng, rng = jax.random.split(self._rng)
@@ -2531,11 +2853,26 @@ def load_params_from_checkpoint(cfg: ModelConfig,
 @functools.lru_cache(maxsize=2)
 def get_engine(model_name: str, batch_size: int = 1,
                max_seq_len: Optional[int] = None,
-               checkpoint_dir: Optional[str] = None) -> InferenceEngine:
-    """Process-wide engine cache (the serve server's accessor)."""
+               checkpoint_dir: Optional[str] = None,
+               tp: Optional[int] = None) -> InferenceEngine:
+    """Process-wide engine cache (the serve server's accessor).
+
+    `tp=None` (the default) picks the tensor-parallel degree from the
+    LOCAL device count: the largest tp dividing both the device count
+    and every tp-sharded model dim (infer_serving_tp) — a model too
+    big for one chip serves over all local chips with no flag. tp=1
+    forces the single-chip engine; tp>1 shards over the first tp
+    devices (parallel.decode_mesh)."""
+    cfg = get_config(model_name)
     params = None
     if checkpoint_dir:
-        cfg = get_config(model_name)
         params = load_params_from_checkpoint(cfg, checkpoint_dir)
+    if tp is None:
+        tp = infer_serving_tp(cfg, len(jax.devices()))
+    mesh = None
+    if tp > 1:
+        from skypilot_tpu.parallel import decode_mesh
+        mesh = decode_mesh(tp)
     return InferenceEngine(model_name, params=params,
-                           batch_size=batch_size, max_seq_len=max_seq_len)
+                           batch_size=batch_size, max_seq_len=max_seq_len,
+                           mesh=mesh)

@@ -20,7 +20,15 @@ side the continuous-batching engine drives:
   (`last_compares` counts the work; pinned by tests/test_paged_cache.py).
 
 Everything here is plain host Python — no jax imports — so allocator
-invariants are testable without a device.
+invariants are testable without a device. That also makes the whole
+module tensor-parallel-agnostic: under a tp serving mesh the DEVICE
+pool leaves shard on the kv-head axis (models/inference.py places
+them; each device holds its slice of every block) while the block ids,
+refcounts and tables here stay replicated host state — allocation is
+identical at any tp. Artifacts are tp-portable for the same reason:
+the engine's gather/scatter callbacks hand this module GLOBAL
+(host-assembled) block bytes, so an export from a tp=N pool imports
+into a tp=M pool of the same model config unchanged.
 
 Preemption-native serving adds block-granular serialize/restore
 (docs/resilience.md "Preemption lifecycle"): `export_prefixes` walks the

@@ -362,7 +362,11 @@ class Attention(nn.Module):
         """KV-cached attention for prefill + autoregressive decode.
 
         The cache (`'cache'` variable collection) holds K/V over a static
-        max_seq_len window (kv heads sharded on tp, batch on dp/fsdp).
+        max_seq_len window (kv heads sharded on tp, batch on dp/fsdp —
+        under the serving mesh these logical annotations are load-
+        bearing: the continuous-batching engine places the cache with
+        parallel/sharding.tree_shardings and XLA partitions every
+        decode dispatch from the layouts alone).
         One call appends the current chunk — the whole prompt at prefill,
         one token per decode step — at the caller-provided `positions`
         and attends q to everything at-or-before each query's position.
@@ -552,7 +556,14 @@ class Attention(nn.Module):
         cache_dtype = jnp.int8 if kv_quant else k.dtype
         cache_shape = (nblocks, bs, kv_heads, cfg.head_dim)
         # No batch axis: the pool is shared across rows (that is the
-        # point), so it shards on kv_heads (tp) only.
+        # point), so it shards on kv_heads (tp) only. Under a tp
+        # serving mesh (models/inference.py places the pool via
+        # parallel/sharding.tree_shardings) every device holds its
+        # kv-head slice of EVERY block; the scatter/gather indices
+        # below are computed from replicated block tables, so they are
+        # identical on all devices and the paged path partitions
+        # without collectives — the per-layer all-reduce happens in
+        # o_proj/down_proj, exactly as on the contiguous path.
         cached_key = self.variable(
             'cache', 'cached_key',
             lambda: nn.with_logical_partitioning(

@@ -33,7 +33,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec
+from jax.sharding import Mesh
+
+from skypilot_tpu.parallel import sharding as sharding_lib
 
 _NEG_INF = -1e30
 
@@ -145,7 +147,9 @@ def ring_attention_ambient(q: jax.Array,
     """Ring attention over the ambient mesh (callers enter it with
     `jax.set_mesh(mesh)`): the form model code uses, so Flax modules don't
     thread Mesh objects. Specs follow the canonical activation layout."""
-    spec = PartitionSpec(('dp', 'fsdp'), 'sp', 'tp', None)
+    # The canonical (B, S, H, D) activation layout from the shared rule
+    # table (parallel/sharding.py) — no local copy of the mapping.
+    spec = sharding_lib.spec_for('batch', 'seq', 'act_heads', None)
     fn = functools.partial(ring_attention, axis_name='sp', causal=causal,
                            sm_scale=sm_scale)
     return jax.shard_map(fn, in_specs=(spec, spec, spec), out_specs=spec,
@@ -162,7 +166,7 @@ def ring_attention_sharded(mesh: Mesh,
     """Convenience wrapper: shard_map over the framework mesh with the
     canonical activation layout (batch on dp/fsdp, sequence on sp, heads
     on tp). Inputs are global arrays; XLA inserts the resharding."""
-    spec = PartitionSpec(('dp', 'fsdp'), 'sp', 'tp', None)
+    spec = sharding_lib.spec_for('batch', 'seq', 'act_heads', None)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,

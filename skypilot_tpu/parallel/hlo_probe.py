@@ -1,0 +1,98 @@
+"""Compiled-HLO collective probe.
+
+While the chip is unreachable, compile-time proxies stand in for
+hardware measurements (the BENCH_r03+ pattern: compile counts and
+transfer counts instead of tok/s). This module adds the sharded-serving
+proxy: parse a compiled executable's optimized HLO text and count the
+collectives GSPMD inserted — how many all-reduces a tp-sharded decode
+step pays per tick and how many bytes they move over ICI.
+
+Consumed by the inference engines (`decode_hlo_stats`, which feeds the
+`skytpu_engine_tp_allreduce_bytes` / `skytpu_engine_tp_collectives`
+gauges) and by `bench.py --dryrun-serve-sharded` (the MULTICHIP_serve
+row). Pure text parsing — no jax import, so it is testable without a
+device and adds nothing to engine import time.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict
+
+# Collective op mnemonics as they appear in optimized HLO. Order
+# matters for longest-match ('all-reduce-start' before 'all-reduce' is
+# handled by matching '-start'/'-done' suffixes explicitly).
+_COLLECTIVES = ('all-reduce', 'all-gather', 'reduce-scatter',
+                'collective-permute', 'all-to-all')
+
+_ITEMSIZE = {
+    'pred': 1, 's8': 1, 'u8': 1, 'f8e4m3fn': 1, 'f8e5m2': 1,
+    's16': 2, 'u16': 2, 'f16': 2, 'bf16': 2,
+    's32': 4, 'u32': 4, 'f32': 4,
+    's64': 8, 'u64': 8, 'f64': 8, 'c64': 8, 'c128': 16,
+}
+
+# `f32[4,1,64]` / `bf16[8]` / `s32[]` result-shape tokens.
+_SHAPE_RE = re.compile(r'\b([a-z]\w*)\[([0-9,]*)\]')
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    size = _ITEMSIZE.get(dtype)
+    if size is None:
+        return 0  # token/opaque types carry no payload we can count
+    n = 1
+    for d in dims.split(','):
+        if d:
+            n *= int(d)
+    return n * size
+
+
+def collective_stats(hlo_text: str) -> Dict[str, Any]:
+    """Count collective ops (and the bytes their results carry) in
+    optimized HLO text (`compiled.as_text()`).
+
+    Returns {'<op>': count, '<op>_bytes': bytes, ..., 'total',
+    'total_bytes'} with op keys underscored (all_reduce, ...). Async
+    pairs (all-reduce-start / all-reduce-done) count ONCE, via the
+    -start op. Byte counts sum each collective's RESULT shapes (tuple
+    results sum their elements) — for an all-reduce that is exactly the
+    payload every participating device contributes/receives per step.
+    """
+    stats: Dict[str, Any] = {}
+    for op in _COLLECTIVES:
+        key = op.replace('-', '_')
+        stats[key] = 0
+        stats[key + '_bytes'] = 0
+    for line in hlo_text.splitlines():
+        if '=' not in line:
+            continue
+        lhs, _, rhs = line.partition('=')
+        rhs = rhs.lstrip()
+        for op in _COLLECTIVES:
+            # Match the op at the head of the RHS (`f32[...] all-reduce(`
+            # puts the result shape first on the lhs side of ' = ' only
+            # for named instructions; optimized HLO prints
+            # `%name = f32[..] all-reduce(...)`, so after '=' the shape
+            # precedes the mnemonic).
+            m = re.search(r'\b' + re.escape(op) + r'(-start)?\(', rhs)
+            if m is None:
+                continue
+            if re.search(r'\b' + re.escape(op) + r'-done\(', rhs):
+                continue  # the -start already counted this pair
+            key = op.replace('-', '_')
+            stats[key] += 1
+            shape_src = rhs[:m.start()] or lhs
+            shapes = _SHAPE_RE.findall(shape_src)
+            size = sum(_shape_bytes(dt, dims) for dt, dims in shapes)
+            if m.group(1) and len(shapes) % 2 == 0 and \
+                    shapes[:len(shapes) // 2] == shapes[len(shapes) // 2:]:
+                # Async `-start` ops return an (operand-alias, result)
+                # tuple whose halves mirror each other — summing both
+                # would double-count the payload the collective moves.
+                size //= 2
+            stats[key + '_bytes'] += size
+            break
+    stats['total'] = sum(stats[op.replace('-', '_')]
+                         for op in _COLLECTIVES)
+    stats['total_bytes'] = sum(stats[op.replace('-', '_') + '_bytes']
+                               for op in _COLLECTIVES)
+    return stats

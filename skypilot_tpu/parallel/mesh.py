@@ -101,6 +101,23 @@ def infer_mesh_config(n_devices: int,
                       sp=sp or 1, tp=tp or 1)
 
 
+def decode_mesh(tp: int,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Serving mesh: pure tensor parallelism over the first `tp` local
+    devices. tp sits innermost in AXES, so on a real slice the per-layer
+    decode all-reduces ride the fastest ICI links — the same axis-order
+    argument training uses. tp=1 yields a valid single-device mesh
+    (trivial shardings, identical math), so callers can thread one mesh
+    type through sharded and unsharded serving alike."""
+    if devices is None:
+        devices = jax.devices()
+    if tp < 1 or tp > len(devices):
+        raise ValueError(
+            f'decode_mesh: tp={tp} needs 1..{len(devices)} local '
+            f'devices')
+    return build_mesh(MeshConfig(tp=tp), list(devices)[:tp])
+
+
 def mesh_for_slice(slice_topology: str, chips: int,
                    num_slices: int = 1,
                    **fixed_axes) -> MeshConfig:

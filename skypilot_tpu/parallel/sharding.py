@@ -78,8 +78,29 @@ def with_logical(x, *names: Optional[str]):
     return nn.with_logical_partitioning(x, names)
 
 
-def shard_params_sharding(mesh: Mesh, abstract_params):
-    """NamedShardings for a flax param pytree with logical metadata."""
-    logical_specs = nn.get_partition_spec(abstract_params)
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated placement on `mesh` (engine feeds, block tables,
+    scalar metrics — anything every device needs whole)."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def tree_shardings(mesh: Mesh, abstract_tree):
+    """NamedShardings for ANY flax tree whose leaves carry logical-axis
+    metadata (params, KV-cache variables, whole TrainStates).
+
+    This is THE logical→physical translation point shared by training
+    (train/trainer.py's sharded state init) and inference (the engines'
+    param placement and sharded KV pools in models/inference.py): both
+    sides consume these rules rather than keeping a copy, so changing a
+    parallelism strategy stays a one-file rule change. Returns a tree
+    shaped like `abstract_tree` (still boxed if the input was boxed —
+    callers nn.unbox before jax.device_put / out_shardings)."""
+    logical_specs = nn.get_partition_spec(abstract_tree)
     return nn.logical_to_mesh_sharding(logical_specs, mesh,
                                        logical_axis_rules())
+
+
+def shard_params_sharding(mesh: Mesh, abstract_params):
+    """NamedShardings for a flax param pytree with logical metadata.
+    (Historical name; alias of tree_shardings.)"""
+    return tree_shardings(mesh, abstract_params)

@@ -148,13 +148,23 @@ class InferenceServer:
                  prefill_chunk: int = 0,
                  async_depth: int = 0,
                  prefix_store: Optional[str] = None,
-                 preempt_drain_timeout: float = 10.0) -> None:
+                 preempt_drain_timeout: float = 10.0,
+                 tp: int = 1) -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
         if checkpoint_dir and hf_model_path:
             raise ValueError('--checkpoint-dir and --hf-model-path are '
                              'mutually exclusive')
+        # Tensor-parallel serving: ONE endpoint over an engine whose
+        # weights + KV pool shard across the first `tp` local devices
+        # (parallel.decode_mesh; the per-layer all-reduce rides ICI).
+        # Request/response surface is unchanged — sharding is invisible
+        # to clients.
+        mesh = None
+        if tp and tp > 1:
+            from skypilot_tpu.parallel import decode_mesh
+            mesh = decode_mesh(tp)
         params = None
         if checkpoint_dir:
             params = load_params_from_checkpoint(get_config(model),
@@ -188,7 +198,8 @@ class InferenceServer:
                                                paged_block_size=paged_block_size,
                                                paged_num_blocks=paged_num_blocks,
                                                prefill_chunk=prefill_chunk,
-                                               async_depth=async_depth)
+                                               async_depth=async_depth,
+                                               mesh=mesh)
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
         if tokenizer.startswith('hf:'):
@@ -526,6 +537,17 @@ class InferenceServer:
     def warmup(self) -> None:
         t0 = time.monotonic()
         self._generate_one([1, 2, 3], 4, 0.0)
+        if getattr(self.engine, '_tp', 1) > 1:
+            # Publish the tp collective gauges from the compiled-HLO
+            # probe. This pays one extra AOT compile of the decode
+            # step (the probe cannot reuse the warmup request's jit
+            # cache) — deliberately spent HERE, before ready=True,
+            # so it never lands on the serving path.
+            stats = self.engine.decode_hlo_stats()
+            logger.info('tp=%d decode step: %d collectives, '
+                        '%d all-reduce bytes/tick',
+                        stats['tp'], stats['total'],
+                        stats['all_reduce_bytes'])
         self.ready = True
         logger.info('engine warm in %.1fs', time.monotonic() - t0)
 
@@ -1067,6 +1089,18 @@ def main(argv=None) -> int:
     parser.add_argument('--num-slots', type=int, default=4,
                         help='concurrent decode slots (continuous '
                              'batching width)')
+    parser.add_argument('--tp', type=int, default=1,
+                        help='tensor-parallel serving: shard the '
+                             'weights, activations and KV cache/pool '
+                             'over the first N local devices (kv heads '
+                             '/ attention heads / MLP hidden / vocab '
+                             'split per parallel/sharding.py; XLA '
+                             'inserts the per-layer all-reduce over '
+                             'ICI). One endpoint, same API; greedy '
+                             'output is bit-identical to tp=1. N must '
+                             'divide the model\'s head/kv-head/mlp/'
+                             'vocab dims (see docs/performance.md '
+                             '"Sharded serving"). 1 = single-chip')
     def _top_k_arg(v):
         k = int(v)
         if k < 0:
@@ -1203,7 +1237,8 @@ def main(argv=None) -> int:
                              prefill_chunk=args.prefill_chunk,
                              async_depth=args.async_depth,
                              prefix_store=args.prefix_store,
-                             preempt_drain_timeout=args.preempt_drain_timeout)
+                             preempt_drain_timeout=args.preempt_drain_timeout,
+                             tp=args.tp)
     logger.info('sampling filters: top_k=%s top_p=%s (0 = off)',
                 args.top_k, args.top_p)
     # Preemption pre-warm BEFORE ready: a replacement replica restores

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import optax
 from flax import linen as nn
 from flax.training import train_state
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh, NamedSharding
 
 from skypilot_tpu.models.configs import ModelConfig
 from skypilot_tpu.models.transformer import Transformer
@@ -115,9 +115,10 @@ def create_sharded_state(
                                  params=variables['params'], tx=tx)
 
     abstract_state = jax.eval_shape(init_fn, rng)
-    logical_specs = nn.get_partition_spec(abstract_state)
-    state_shardings = nn.logical_to_mesh_sharding(
-        logical_specs, mesh, sharding_lib.logical_axis_rules())
+    # The logical→physical translation lives in parallel/sharding.py
+    # (tree_shardings) and is shared with the inference engines — no
+    # train-local copy of the rule application.
+    state_shardings = sharding_lib.tree_shardings(mesh, abstract_state)
     with mesh:
         state = jax.jit(init_fn, out_shardings=state_shardings)(rng)
     state = nn.unbox(state)
@@ -285,7 +286,7 @@ def make_train_step(
         return new_state, metrics
 
     unboxed_shardings = nn.unbox(state_shardings)
-    replicated = NamedSharding(mesh, PartitionSpec())
+    replicated = sharding_lib.replicated(mesh)
     return jax.jit(
         step,
         in_shardings=(unboxed_shardings, batch_sharding(mesh)),
@@ -332,11 +333,10 @@ def make_eval_step(
                                   batch.get('mask'))
 
     unboxed_shardings = nn.unbox(state_shardings)
-    replicated = NamedSharding(mesh, PartitionSpec())
     return jax.jit(
         step,
         in_shardings=(unboxed_shardings, batch_sharding(mesh)),
-        out_shardings=replicated,
+        out_shardings=sharding_lib.replicated(mesh),
     )
 
 

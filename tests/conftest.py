@@ -49,6 +49,12 @@ def pytest_configure(config):
         'enforced with SIGALRM — a wedged e2e test FAILS with a '
         'TimeoutError (and its children get reaped) instead of hanging '
         'the suite until the outer kill loses every result')
+    config.addinivalue_line(
+        'markers', 'sharded: tensor-parallel serving tests (tier-1). '
+        'Their jax work runs in a SUBPROCESS on 8 fake CPU devices '
+        '(the sharded_subprocess fixture) so the main pytest process '
+        'keeps its single-device jit caches; pair with '
+        '@pytest.mark.deadline(N) from the PR-6 SIGALRM fixture')
 
 
 @pytest.fixture(autouse=True)
@@ -129,6 +135,54 @@ def _reap_test_processes(marker: str) -> None:
                 os.kill(int(pid_dir), signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
+
+
+@pytest.fixture(scope='session')
+def sharded_subprocess():
+    """Runner for @pytest.mark.sharded tests: execute a python script
+    in a SUBPROCESS with the 8-fake-CPU-device XLA_FLAGS, so the
+    sharded SPMD compiles never touch this process's single-device jit
+    caches. Returns (CompletedProcess, last-JSON-line-or-None)."""
+    import json
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(script_path, *argv, timeout=600):
+        env = dict(os.environ)
+        env['JAX_PLATFORMS'] = 'cpu'
+        # APPEND (don't clobber) so ambient XLA settings — determinism
+        # or memory flags a CI sets suite-wide — hold in the child too,
+        # keeping its engines comparable to this process's baselines.
+        flags = env.get('XLA_FLAGS', '')
+        if '--xla_force_host_platform_device_count' not in flags:
+            env['XLA_FLAGS'] = (
+                flags + ' --xla_force_host_platform_device_count=8'
+            ).strip()
+        env['PYTHONPATH'] = repo + os.pathsep + env.get('PYTHONPATH', '')
+        # Tests are CPU-only; the axon sitecustomize would register the
+        # TPU plugin in the child (same rationale as the top of this
+        # file).
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, script_path),
+             *[str(a) for a in argv]],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            check=False)
+        parsed = None
+        for line in reversed(proc.stdout.splitlines()):
+            try:
+                candidate = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            # Only a dict counts as the driver's result row: a stray
+            # trailing scalar ('0', 'null') must not shadow it.
+            if isinstance(candidate, dict):
+                parsed = candidate
+                break
+        return proc, parsed
+
+    return run
 
 
 @pytest.fixture

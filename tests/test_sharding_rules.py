@@ -1,0 +1,214 @@
+"""The sharding substrate (tier-1, CPU, in-process — no engine
+compiles): parallel/sharding.py is the SINGLE source of logical-axis
+rules shared by train/ and inference.
+
+- grep-level lint: no second PartitionSpec rule table survives outside
+  parallel/ (the ISSUE-8 dedup satellite — train and ops now import
+  spec_for/tree_shardings instead of hardcoding physical specs);
+- the decode-specific rules map attention heads, KV heads, MLP hidden
+  and vocab/embedding onto the tp axis;
+- tree_shardings translates a boxed decode-model tree (params AND the
+  KV-cache variables) into per-leaf NamedShardings on a tp mesh;
+- decode_mesh / assert_tp_compatible / infer_serving_tp plumbing;
+- hlo_probe.collective_stats parses counts and bytes from HLO text.
+"""
+import os
+import re
+
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), 'skypilot_tpu')
+
+
+class TestNoDuplicateRuleTables:
+
+    def test_no_partition_spec_rules_outside_parallel(self):
+        """Any PartitionSpec(...) carrying axis-name STRINGS outside
+        parallel/ is a second rule table waiting to drift: model and
+        ops code must spell layouts with logical names through
+        spec_for/constrain/tree_shardings. Bare PartitionSpec() —
+        explicit replication — is fine."""
+        offenders = []
+        for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
+            rel = os.path.relpath(dirpath, PKG_ROOT)
+            if rel.split(os.sep)[0] == 'parallel':
+                continue
+            for fname in filenames:
+                if not fname.endswith('.py'):
+                    continue
+                path = os.path.join(dirpath, fname)
+                with open(path, encoding='utf-8') as f:
+                    text = f.read()
+                for m in re.finditer(r'PartitionSpec\(([^)]*)\)', text):
+                    if re.search(r'[\'\"]', m.group(1)):
+                        offenders.append(
+                            f'{os.path.relpath(path, PKG_ROOT)}: '
+                            f'PartitionSpec({m.group(1)})')
+        assert not offenders, (
+            'physical sharding rules outside parallel/ (use '
+            'sharding.spec_for / tree_shardings):\n' +
+            '\n'.join(offenders))
+
+    def test_no_logical_rule_table_outside_parallel(self):
+        """Exactly one logical-axis rule table exists, and it lives in
+        parallel/sharding.py."""
+        hits = []
+        for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
+            for fname in filenames:
+                if not fname.endswith('.py'):
+                    continue
+                path = os.path.join(dirpath, fname)
+                with open(path, encoding='utf-8') as f:
+                    if 'LOGICAL_AXIS_RULES: ' in f.read():
+                        hits.append(os.path.relpath(path, PKG_ROOT))
+        assert hits == [os.path.join('parallel', 'sharding.py')], hits
+
+
+class TestDecodeRules:
+
+    def test_tp_axis_covers_decode_dims(self):
+        """The dims tensor-parallel decode shards — attention heads,
+        KV heads (the cache axis), MLP hidden, vocab/embedding — all
+        map to `tp`."""
+        from skypilot_tpu.parallel import spec_for
+        assert spec_for('heads') == PartitionSpec('tp')
+        assert spec_for('kv_heads') == PartitionSpec('tp')
+        assert spec_for('mlp') == PartitionSpec('tp')
+        assert spec_for('vocab') == PartitionSpec('tp')
+        # The paged pool leaf layout: (blocks, block, kv_heads, dim).
+        assert spec_for(None, None, 'kv_heads', None) == \
+            PartitionSpec(None, None, 'tp', None)
+
+    def test_trainer_and_inference_share_the_helper(self):
+        """The moved helper is what both sides call — no local copy of
+        the rule application survives in train/ or models/."""
+        import inspect
+
+        from skypilot_tpu.models import inference
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        from skypilot_tpu.train import trainer
+        assert 'tree_shardings' in inspect.getsource(trainer)
+        assert 'tree_shardings' in inspect.getsource(inference)
+        # And neither re-applies the rules by hand.
+        for mod in (trainer, inference):
+            assert 'logical_to_mesh_sharding' not in \
+                inspect.getsource(mod), mod.__name__
+        assert sharding_lib.shard_params_sharding is not None  # alias
+
+
+class TestMeshPlumbing:
+
+    def test_decode_mesh_shape(self):
+        from skypilot_tpu.parallel import decode_mesh
+        mesh = decode_mesh(2)
+        assert dict(mesh.shape)['tp'] == 2
+        assert all(s == 1 for a, s in dict(mesh.shape).items()
+                   if a != 'tp')
+
+    def test_decode_mesh_rejects_bad_tp(self):
+        from skypilot_tpu.parallel import decode_mesh
+        with pytest.raises(ValueError):
+            decode_mesh(0)
+        with pytest.raises(ValueError):
+            decode_mesh(len(jax.devices()) + 1)
+
+    def test_assert_tp_compatible(self):
+        from skypilot_tpu.models import get_config
+        cfg = get_config('test-tiny')      # 4 heads, 2 kv heads
+        cfg.assert_tp_compatible(1)
+        cfg.assert_tp_compatible(2)
+        with pytest.raises(ValueError, match='num_kv_heads'):
+            cfg.assert_tp_compatible(4)    # heads divide, kv heads don't
+
+    def test_infer_serving_tp(self):
+        from skypilot_tpu.models import get_config
+        from skypilot_tpu.models.inference import infer_serving_tp
+        tiny = get_config('test-tiny')
+        assert infer_serving_tp(tiny, 1) == 1
+        assert infer_serving_tp(tiny, 8) == 2   # kv_heads=2 caps it
+        big = get_config('llama3-8b')           # kv_heads=8
+        assert infer_serving_tp(big, 8) == 8
+        assert infer_serving_tp(big, 6) == 2    # 6 % 4 != 0; 2 divides
+
+    def test_engine_rejects_non_tp_mesh(self):
+        """Serving meshes are tp-only for now: a dp/fsdp axis > 1 must
+        refuse up front (GSPMD would silently pad the 2-slot batch)."""
+        from skypilot_tpu.models import get_config
+        from skypilot_tpu.models.inference import (
+            _validate_serving_mesh)
+        from skypilot_tpu.parallel import MeshConfig, build_mesh
+        mesh = build_mesh(MeshConfig(fsdp=2), jax.devices()[:2])
+        with pytest.raises(ValueError, match='tensor parallelism only'):
+            _validate_serving_mesh(get_config('test-tiny'), mesh)
+
+    def test_tree_shardings_places_cache_on_tp(self):
+        """The KV-cache variables' logical metadata translates to
+        kv-head sharding on a decode mesh — params and cache flow
+        through ONE helper."""
+        import dataclasses
+
+        import jax.numpy as jnp
+        from flax import linen as nn
+
+        from skypilot_tpu.models import get_config
+        from skypilot_tpu.models.transformer import Transformer
+        from skypilot_tpu.parallel import decode_mesh, tree_shardings
+        cfg = dataclasses.replace(get_config('test-tiny'), decode=True,
+                                  remat=False)
+        model = Transformer(cfg)
+        mesh = decode_mesh(2)
+        abstract = jax.eval_shape(lambda: model.init(
+            jax.random.PRNGKey(0), jnp.ones((1, 1), jnp.int32),
+            jnp.zeros((1, 1), jnp.int32)))
+        shardings = nn.unbox(tree_shardings(mesh, abstract))
+        leaves = jax.tree.leaves(shardings)
+        assert leaves and all(isinstance(s, NamedSharding)
+                              for s in leaves)
+        # At least one cache leaf and one param leaf shard on tp.
+        cache_specs = [s.spec for s in
+                       jax.tree.leaves(shardings['cache'])]
+        assert any('tp' in jax.tree.leaves(list(sp))
+                   for sp in cache_specs), cache_specs
+        param_specs = [s.spec for s in
+                       jax.tree.leaves(shardings['params'])]
+        assert any('tp' in jax.tree.leaves(list(sp))
+                   for sp in param_specs), param_specs
+
+
+class TestHloProbe:
+
+    HLO = '''
+  %add.1 = f32[4,64]{1,0} add(%a, %b)
+  %all-reduce.3 = f32[4,1,64]{2,1,0} all-reduce(%x), replica_groups={}
+  %ar2 = (f32[8]{0}, bf16[2,2]{1,0}) all-reduce(%y, %z)
+  %ag = f32[4,512]{1,0} all-gather(%w), dimensions={1}
+  %start = f32[16]{0} collective-permute-start(%p)
+  %done = f32[16]{0} collective-permute-done(%start)
+  %ars = (f32[8]{0}, f32[8]{0}) all-reduce-start(%q)
+  %ard = f32[8]{0} all-reduce-done(%ars)
+'''
+
+    def test_counts_and_bytes(self):
+        from skypilot_tpu.parallel import hlo_probe
+        stats = hlo_probe.collective_stats(self.HLO)
+        assert stats['all_reduce'] == 3
+        # 4*1*64*4 + (8*4 + 2*2*2) + 8*4 = 1024 + 40 + 32 — the async
+        # -start tuple's mirrored (operand-alias, result) halves count
+        # ONCE, not summed.
+        assert stats['all_reduce_bytes'] == 1096
+        assert stats['all_gather'] == 1
+        assert stats['all_gather_bytes'] == 4 * 512 * 4
+        # start/done pairs count once.
+        assert stats['collective_permute'] == 1
+        assert stats['total'] == 5
+        assert stats['total_bytes'] == (
+            1096 + 4 * 512 * 4 + 16 * 4)
+
+    def test_empty(self):
+        from skypilot_tpu.parallel import hlo_probe
+        stats = hlo_probe.collective_stats('%r = f32[2] add(%a, %b)')
+        assert stats['total'] == 0 and stats['total_bytes'] == 0
