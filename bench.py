@@ -93,6 +93,16 @@ def _parse_args(argv=None):
                              'per-device weights+pool <= (1/N + eps), '
                              'collective count from the compiled-HLO '
                              'probe (parallel/hlo_probe)')
+    parser.add_argument('--dryrun-serve-fleet', action='store_true',
+                        help='emit the FLEET_serve proxy row on CPU (no '
+                             'chip needed): a 3-replica fleet of real '
+                             'engines routed by the prefix-aware policy '
+                             'vs round-robin on a shared-prefix '
+                             'workload — reports prefix-hit ratio, '
+                             'retry amplification past a dead replica, '
+                             'p50/p99 routed TTFT per policy, and pins '
+                             'that miss/stale/corrupt-digest routing '
+                             'falls back instead of erroring')
     parser.add_argument('--no-serve-row', action='store_true',
                         help='skip the serve row in the default sweep')
     parser.add_argument('--quantize', default=None, choices=['int8'],
@@ -532,11 +542,160 @@ def _dryrun_serve_sharded(args) -> int:
     return 0 if ok else 1
 
 
+def _dryrun_serve_fleet(args) -> int:
+    """FLEET_serve: the fleet-routing proxy row on CPU (runs with the
+    chip unreachable — the BENCH_r03+ proxy-pin pattern extended to
+    routing). A FakeReplicaFleet of 3 REAL engines (paged + prefix
+    cache) is routed at the policy level — digests and queue depths
+    fed back exactly as the LB learns them in-band — through the same
+    shared-prefix workload under round-robin and prefix-aware
+    policies, plus one dead replica advertising an attractive digest
+    (the retry-amplification path) and one corrupt digest on the wire
+    (the fallback path). Pins: prefix-aware hit ratio STRICTLY above
+    round-robin, greedy output bit-identical to a single healthy
+    replica under both policies, bounded retry amplification, and
+    zero routing errors. Emits ONE JSON row."""
+    del args
+    import dataclasses
+    import math as math_lib
+    import time as time_lib
+
+    from skypilot_tpu.models import get_config
+    from skypilot_tpu.models import inference as inference_lib
+    from skypilot_tpu.models.kv_cache import prefix_route_hash
+    from skypilot_tpu.serve.load_balancing_policies import (
+        PrefixAwarePolicy, RoundRobinPolicy)
+
+    cfg = dataclasses.replace(
+        get_config('test-tiny'), dtype='float32', param_dtype='float32',
+        max_seq_len=64, remat=False)
+    groups = [list(range(s, s + 24)) for s in (1, 60, 120, 180, 240)]
+    rounds = 3
+
+    def prompts():
+        for round_i in range(rounds):
+            for gi, group in enumerate(groups):
+                yield gi, round_i, group + [400 + round_i]
+
+    # Bit-identity oracle: one healthy single replica.
+    ref_engine = inference_lib.ContinuousBatchingEngine(
+        cfg, num_slots=2, paged_block_size=8, prefix_cache=6)
+    reference = {(gi, ri): ref_engine.generate(ids, max_new_tokens=4,
+                                               timeout=600)[0]
+                 for gi, ri, ids in prompts()}
+    ref_engine.stop()
+
+    def run_policy(policy) -> dict:
+        engines = [
+            inference_lib.ContinuousBatchingEngine(
+                cfg, num_slots=2, paged_block_size=8, prefix_cache=6)
+            for _ in range(3)
+        ]
+        urls = [f'replica://{i}' for i in range(3)]
+        dead_url = 'replica://zombie'
+        policy.set_ready_replicas(urls + [dead_url])
+        # The dead replica advertises the most attractive digest for
+        # group 4 — a replica that died mid-advertisement. Routing
+        # must absorb it as ONE wasted attempt per request at most.
+        policy.observe_response(dead_url, {
+            'X-SkyTPU-Queue-Depth': '0',
+            'X-SkyTPU-Prefix-Digest': 'v1:8:1:' + ','.join(
+                prefix_route_hash(groups[4][:k * 8])
+                for k in range(1, 4)),
+        })
+        attempts = served = rejected = mismatches = 0
+        ttfts = []
+        t0 = time_lib.time()
+        for gi, round_i, ids in prompts():
+            tried = set()
+            while True:
+                attempts += 1
+                url, _info = policy.select(
+                    exclude=tried,
+                    hint={'token_ids': ids, 'prompt_len': len(ids)})
+                assert url is not None, 'routing failed closed'
+                if url == dead_url:
+                    # Simulated transport error → client-level retry
+                    # on another replica (the LB breaker path).
+                    tried.add(url)
+                    continue
+                engine = engines[urls.index(url)]
+                policy.note_routed(url)
+                toks, stats = engine.generate(ids, max_new_tokens=4,
+                                              timeout=600)
+                policy.note_done(url)
+                ttfts.append(stats['ttft_s'])
+                headers = {
+                    'X-SkyTPU-Queue-Depth': str(engine.queue_load()),
+                }
+                digest = engine.prefix_digest()
+                if digest:
+                    headers['X-SkyTPU-Prefix-Digest'] = digest
+                if gi == 0 and round_i == 1:
+                    # Corrupt digest on the wire: must be dropped and
+                    # counted, never raised.
+                    headers['X-SkyTPU-Prefix-Digest'] = 'garbage!!'
+                if policy.observe_response(url, headers) == 'rejected':
+                    rejected += 1
+                if toks != reference[(gi, round_i)]:
+                    mismatches += 1
+                served += 1
+                break
+        wall = time_lib.time() - t0
+        hits = sum(e.prefix_stats['hits'] for e in engines)
+        misses = sum(e.prefix_stats['misses'] for e in engines)
+        for engine in engines:
+            engine.stop()
+        ttfts.sort()
+        n = len(ttfts)
+        p99_idx = min(n - 1, math_lib.ceil(n * 0.99) - 1)
+        return {
+            'prefix_hit_ratio': round(hits / max(1, hits + misses), 4),
+            'prefix_hits': hits,
+            'prefix_misses': misses,
+            'retry_amplification': round(attempts / max(1, served), 4),
+            'attempts': attempts,
+            'served': served,
+            'output_mismatches': mismatches,
+            'digests_rejected': rejected,
+            'p50_routed_ttft_ms': round(ttfts[n // 2] * 1e3, 2),
+            'p99_routed_ttft_ms': round(ttfts[p99_idx] * 1e3, 2),
+            'wall_s': round(wall, 1),
+        }
+
+    rr = run_policy(RoundRobinPolicy())
+    pa = run_policy(PrefixAwarePolicy())
+    ok = bool(
+        pa['prefix_hit_ratio'] > rr['prefix_hit_ratio'] and
+        pa['output_mismatches'] == 0 and rr['output_mismatches'] == 0
+        and pa['digests_rejected'] >= 1 and
+        pa['retry_amplification'] <= 2.0 and
+        rr['retry_amplification'] <= 2.0)
+    row = {
+        'metric': 'FLEET_serve dryrun prefix-hit ratio',
+        'value': pa['prefix_hit_ratio'],
+        'unit': 'hit_ratio',
+        'vs_baseline': round(
+            pa['prefix_hit_ratio'] / max(1e-9, rr['prefix_hit_ratio']),
+            2) if rr['prefix_hit_ratio'] else float(
+                pa['prefix_hits'] or 1),
+        'ok': ok,
+        'skipped': False,
+        'replicas': 3,
+        'groups': len(groups),
+        'rounds': rounds,
+        'round_robin': rr,
+        'prefix_aware': pa,
+    }
+    print(json.dumps(row))
+    return 0 if ok else 1
+
+
 def _supervise_dryrun(argv) -> int:
-    """Run the sharded-serving dryrun in a subprocess with the fake
-    8-CPU-device environment — NO TPU preflight (the dryrun exists
-    precisely for when the chip is unreachable) and no retry ladder
-    (it is deterministic)."""
+    """Run a CPU-only dryrun (sharded serving / fleet routing) in a
+    subprocess with the fake 8-CPU-device environment — NO TPU
+    preflight (dryruns exist precisely for when the chip is
+    unreachable) and no retry ladder (they are deterministic)."""
     env = dict(os.environ)
     env['JAX_PLATFORMS'] = 'cpu'
     flags = env.get('XLA_FLAGS', '')
@@ -680,6 +839,8 @@ def _worker(args) -> int:
         # CPU-only by design; forces its own fake-device backend
         # BEFORE any jax.devices() call.
         return _dryrun_serve_sharded(args)
+    if args.dryrun_serve_fleet:
+        return _dryrun_serve_fleet(args)
 
     import jax
 
@@ -847,7 +1008,7 @@ def main() -> int:
     if args.worker:
         return _worker(args)
     argv = [a for a in sys.argv[1:] if a != '--worker']
-    if args.dryrun_serve_sharded:
+    if args.dryrun_serve_sharded or args.dryrun_serve_fleet:
         return _supervise_dryrun(argv)
     return _supervise(argv)
 

@@ -904,6 +904,9 @@ class ContinuousBatchingEngine:
         self.tick_stats = {'dispatches': 0, 'chained': 0, 'flushes': 0,
                            'host_gap_s': 0.0, 'gap_samples': 0}
         self._prefix_entries = self._new_prefix_index()
+        # Cached routing-digest header value, keyed on (index identity,
+        # index epoch) — see prefix_digest().
+        self._digest_cache: Optional[tuple] = None
         self.model = Transformer(self.cfg)
         self._rng = jax.random.PRNGKey(rng_seed)
         # -------- tensor-parallel serving (docs/performance.md) -----
@@ -1760,6 +1763,45 @@ class ContinuousBatchingEngine:
                 _TOKENS_TOTAL.inc()
                 self._notify(req, first)
                 req.next_pos = total
+
+    def queue_load(self) -> int:
+        """Requests this engine is holding right now: queued awaiting
+        admission + occupying decode slots. The serve server advertises
+        it in-band (X-SkyTPU-Queue-Depth) so the load balancer's
+        least-loaded fallback routes on real backlog, not guesses."""
+        return (self._queue.qsize() +
+                sum(1 for r in self._slots if r is not None))
+
+    def prefix_digest(self) -> Optional[str]:
+        """Routing digest of the prefix cache, as the header value the
+        server piggybacks on every response (X-SkyTPU-Prefix-Digest):
+
+            v1:<chunk>:<epoch>:<h1>,<h2>,...
+
+        where each h is kv_cache.prefix_route_hash of a chunk-aligned
+        prefix of a cached entry (newest first, bounded). None when
+        prefix caching is off. Cached per index epoch, so the serving
+        hot path re-reads one string; called from HTTP handler threads
+        while the engine thread mutates the index, so a torn read is
+        possible — it degrades to the last cached (stale) digest, which
+        the routing layer is REQUIRED to tolerate anyway."""
+        if not self.prefix_cache:
+            return None
+        index = self._prefix_entries
+        epoch = index.epoch
+        cached = self._digest_cache
+        if cached is not None and cached[0] is index and \
+                cached[1] == epoch:
+            return cached[2]
+        try:
+            hashes = index.digest()
+        except RuntimeError:
+            # Index mutated mid-walk (engine thread admitting): serve
+            # the previous digest — staleness is the contract.
+            return cached[2] if cached is not None else None
+        value = f'v1:{index.chunk}:{epoch}:' + ','.join(hashes)
+        self._digest_cache = (index, epoch, value)
+        return value
 
     def paged_occupancy(self) -> Dict[str, Any]:
         """Pool accounting snapshot (bench.py --serve reports it; tests
@@ -2821,11 +2863,21 @@ class ContinuousBatchingEngine:
 
 
 def load_params_from_checkpoint(cfg: ModelConfig,
-                                checkpoint_dir: str) -> Any:
+                                checkpoint_dir: str,
+                                mesh: Optional[Any] = None) -> Any:
     """Restore trained params from an Orbax checkpoint written by
     train/run.py. Params-only partial restore: the fp32 AdamW moments
     (~5x the bf16 param bytes) never materialize — the difference
     between a serving replica that fits and one that OOMs for 8B+.
+
+    `mesh` (a serving mesh from parallel.decode_mesh) makes orbax
+    deserialize each leaf DIRECTLY into its tree_shardings placement —
+    a tp>1 engine's weights arrive on device already sharded on the tp
+    axis, and the later _place_params device_put is an identity. The
+    whole-tree-on-device-0 materialization this avoids was the gap
+    between serving a too-big-for-one-chip checkpoint and OOMing at
+    restore (the PR-7 named follow-up). Without a mesh the historical
+    behavior stands: restore over the local training-style mesh.
 
     LoRA checkpoints (train runs with --lora-rank write a lora.json
     sidecar) restore with the adapter structure recorded there and are
@@ -2845,9 +2897,10 @@ def load_params_from_checkpoint(cfg: ModelConfig,
         lora_cfg = _dc.replace(cfg, **meta)
         logger.info('LoRA checkpoint (%s): merging adapters into base '
                     'weights for serving', meta)
-        return merge_lora(restore_params_only(lora_cfg, checkpoint_dir),
+        return merge_lora(restore_params_only(lora_cfg, checkpoint_dir,
+                                              mesh=mesh),
                           lora_cfg)
-    return restore_params_only(cfg, checkpoint_dir)
+    return restore_params_only(cfg, checkpoint_dir, mesh=mesh)
 
 
 @functools.lru_cache(maxsize=2)
@@ -2864,15 +2917,18 @@ def get_engine(model_name: str, batch_size: int = 1,
     forces the single-chip engine; tp>1 shards over the first tp
     devices (parallel.decode_mesh)."""
     cfg = get_config(model_name)
-    params = None
-    if checkpoint_dir:
-        params = load_params_from_checkpoint(cfg, checkpoint_dir)
     if tp is None:
         tp = infer_serving_tp(cfg, len(jax.devices()))
     mesh = None
     if tp > 1:
         from skypilot_tpu.parallel import decode_mesh
         mesh = decode_mesh(tp)
+    params = None
+    if checkpoint_dir:
+        # Mesh-first: orbax deserializes straight into the serving
+        # shardings, never materializing the tree whole on device 0.
+        params = load_params_from_checkpoint(cfg, checkpoint_dir,
+                                             mesh=mesh)
     return InferenceEngine(model_name, params=params,
                            batch_size=batch_size, max_seq_len=max_seq_len,
                            mesh=mesh)

@@ -57,6 +57,19 @@ class PoolExhaustedError(Exception):
     drops free their blocks) or shed the request."""
 
 
+def prefix_route_hash(ids: Sequence[int]) -> str:
+    """Stable, process-independent hash of a token prefix — the unit of
+    the cache-aware routing digest (docs/serving.md "Fleet routing").
+
+    Both sides of the route MUST share one function: the replica hashes
+    its PrefixIndex entries into the advertised digest, the load
+    balancer hashes the incoming prompt's chunk-aligned prefixes and
+    intersects. Python's builtin hash() is salted per process, so this
+    is CRC-based on a canonical byte encoding instead."""
+    crc = zlib.crc32(repr(tuple(int(t) for t in ids)).encode())
+    return f'{crc & 0xffffffff:08x}'
+
+
 def int8_pool_bytes_saved(num_blocks: int, block_size: int,
                           kv_heads: int, head_dim: int,
                           num_layers: int, fp_bytes: int) -> int:
@@ -206,6 +219,11 @@ class PrefixIndex:
         # The engine uses it to attribute a hit to a pre-warmed
         # (imported) entry vs. a locally-prefilled one.
         self.last_key: Optional[tuple] = None
+        # Bumped on every CONTENT mutation (put/evict) — recency-only
+        # touches don't count. The engine keys its cached routing
+        # digest on this, so the serving hot path re-reads one string
+        # instead of re-walking the trie per response.
+        self.epoch = 0
 
     # -- container protocol (tests iterate/len the entry table) --
 
@@ -240,6 +258,7 @@ class PrefixIndex:
         evictions past capacity) so the caller can release their
         storage."""
         key = tuple(ids)
+        self.epoch += 1
         displaced: List[Tuple[tuple, Any]] = []
         if key in self._lru:
             displaced.append((key, self._lru[key]))
@@ -261,9 +280,31 @@ class PrefixIndex:
         """Evict the least-recently-stored entry (pool-pressure path)."""
         if not self._lru:
             return None
+        self.epoch += 1
         key, payload = self._lru.popitem(last=False)
         self._remove_from_trie(key)
         return key, payload
+
+    def digest(self, max_hashes: int = 64) -> List[str]:
+        """Routing digest: prefix_route_hash of every chunk-aligned
+        prefix of every cached entry, newest entry first (longest
+        prefix first within an entry), deduped and bounded to
+        `max_hashes`. A load balancer that hashes an incoming prompt's
+        chunk-aligned prefixes the same way can tell how deep this
+        index could serve it — approximately: the digest is advisory
+        routing intel, the engine's own lookup stays authoritative."""
+        out: List[str] = []
+        seen: set = set()
+        for key in reversed(list(self._lru)):
+            for k in range(len(key) // self.chunk, 0, -1):
+                h = prefix_route_hash(key[:k * self.chunk])
+                if h in seen:
+                    continue
+                seen.add(h)
+                out.append(h)
+                if len(out) >= max_hashes:
+                    return out
+        return out
 
     def _remove_from_trie(self, key: tuple) -> None:
         path = [self._root]

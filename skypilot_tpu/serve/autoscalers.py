@@ -23,6 +23,7 @@ import time
 import typing
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.observability import metrics as obs
 from skypilot_tpu.serve import constants
 from skypilot_tpu.serve import serve_state
 
@@ -31,6 +32,22 @@ if typing.TYPE_CHECKING:
     from skypilot_tpu.serve import service_spec as spec_lib
 
 logger = logging.getLogger(__name__)
+
+# Autoscaler metrics (docs/observability.md).
+_DECISIONS = obs.counter(
+    'skytpu_autoscaler_decisions_total',
+    'Autoscaler decision ticks by outcome: up / down (executed '
+    'scaling moves), hold (no change), damped (a direction flip '
+    'suppressed by flap damping)', ('direction',))
+_PRESSURE = obs.gauge(
+    'skytpu_autoscaler_pressure',
+    'Last fleet pressure the MetricsAutoscaler computed: the max of '
+    'queue-depth / TTFT / TPOT ratios vs their targets (1.0 = fleet '
+    'exactly at target; <0.5 invites downscale)')
+_TARGET_REPLICAS = obs.gauge(
+    'skytpu_autoscaler_target_replicas',
+    'Fleet size the autoscaler currently wants (after hysteresis and '
+    'flap damping)')
 
 
 class AutoscalerDecisionOperator(enum.Enum):
@@ -276,7 +293,225 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
         return decisions
 
 
+class MetricsAutoscaler(RequestRateAutoscaler):
+    """Scales from the fleet's OBSERVED serving signals — queue depth,
+    TTFT, TPOT — instead of the request rate (ROADMAP item 3: QPS says
+    how often clients knock; the registry's signals say whether the
+    fleet is actually keeping up).
+
+    Inputs arrive via `collect_replica_metrics({replica_id: {'queue_depth',
+    'ttft_s', 'tpot_s'}, ...})` — the controller scrapes each READY
+    replica's /metrics (replica_managers.scrape_replica_signals); tests
+    feed dicts directly. Each decision tick computes the fleet
+    **pressure**: the max over configured targets of mean-signal /
+    target. pressure > 1 wants ceil(ready × pressure) replicas;
+    pressure < 0.5 wants the fleet shrunk to match; in between the
+    fleet holds (a deadband, so a fleet at ~target never oscillates).
+
+    Stability is layered: (1) the inherited upscale/downscale
+    hysteresis (N consecutive ticks must agree before a move), then
+    (2) **flap damping** — after an executed move, a move in the
+    OPPOSITE direction is suppressed for `flap_damping` further ticks
+    (a storm that spikes TTFT during failover must not buy replicas
+    that an immediately-following quiet second tears back down).
+
+    DRAINING-aware by construction: DRAINING replicas count toward the
+    fleet (counts_toward_fleet — their replacement is already in
+    flight) but their signals are ignored (a draining queue runs dry
+    by design, which would otherwise read as idle capacity) and the
+    inherited victim selector never picks them.
+
+    Deterministic and REPLAYABLE: no wall clock anywhere — hysteresis
+    and damping count decision ticks — and every tick appends its
+    inputs + outcome to `decision_log`. `replay_decision_log(spec,
+    log)` re-derives the decisions from the log alone; the fleet-storm
+    chaos test pins that the replay matches what was recorded."""
+
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec',
+                 record_metrics: bool = True) -> None:
+        super().__init__(spec)
+        self._read_targets(spec)
+        self._signals: Dict[int, Dict[str, float]] = {}
+        self.decision_log: List[Dict[str, Any]] = []
+        # replay_decision_log runs a shadow instance: it must not
+        # double-count the live skytpu_autoscaler_* counters or clobber
+        # the gauges with historical values.
+        self._record_metrics = record_metrics
+        self._tick = 0
+        # +1 / -1 direction of the last EXECUTED move and how many
+        # ticks of opposite-direction damping remain.
+        self._last_direction = 0
+        self._damp_remaining = 0
+        self.flap_damping = constants.autoscaler_flap_damping_decisions()
+
+    def _read_targets(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+        self.target_queue_depth = (
+            spec.target_queue_depth_per_replica
+            if getattr(spec, 'target_queue_depth_per_replica', None)
+            is not None else constants.target_queue_depth_per_replica())
+        self.target_ttft_s = getattr(spec, 'target_ttft_seconds', None)
+        self.target_tpot_s = getattr(spec, 'target_tpot_seconds', None)
+
+    def update_spec(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+        super().update_spec(spec)
+        self._read_targets(spec)
+
+    # ---------------- inputs ----------------
+
+    def collect_replica_metrics(
+            self, snapshots: Dict[int, Dict[str, float]]) -> None:
+        """Latest per-replica signal snapshot; wholesale replacement
+        (a replica absent from the scrape contributes nothing)."""
+        self._signals = {int(k): dict(v) for k, v in snapshots.items()}
+
+    # ---------------- decisions ----------------
+
+    def _pressure(self, ready_ids: List[int]) -> Optional[float]:
+        """Max signal/target ratio over the READY fleet's signals, or
+        None when there is no intel to act on (hold — scaling blind
+        would flap on scrape outages)."""
+        sigs = [self._signals[i] for i in ready_ids
+                if i in self._signals]
+        if not sigs:
+            return None
+
+        def mean_of(key: str) -> Optional[float]:
+            vals = [s[key] for s in sigs if s.get(key) is not None]
+            return sum(vals) / len(vals) if vals else None
+
+        ratios: List[float] = []
+        queue = mean_of('queue_depth')
+        if queue is not None and self.target_queue_depth:
+            ratios.append(queue / self.target_queue_depth)
+        ttft = mean_of('ttft_s')
+        if ttft is not None and self.target_ttft_s:
+            ratios.append(ttft / self.target_ttft_s)
+        tpot = mean_of('tpot_s')
+        if tpot is not None and self.target_tpot_s:
+            ratios.append(tpot / self.target_tpot_s)
+        return max(ratios) if ratios else None
+
+    def evaluate_scaling(
+        self,
+        replica_infos: List['replica_managers.ReplicaInfo'],
+    ) -> List[AutoscalerDecision]:
+        self._tick += 1
+        alive = [i for i in replica_infos
+                 if i.status.counts_toward_fleet()]
+        ready = [i for i in alive
+                 if i.status == serve_state.ReplicaStatus.READY]
+        current = len(alive)
+        pressure = self._pressure([i.replica_id for i in ready])
+        if current == 0:
+            desired_raw = self.min_replicas
+        elif pressure is None:
+            desired_raw = current
+        elif pressure > 1.0:
+            # Never below `current`: replicas already PROVISIONING are
+            # the response to this very pressure — ceil(ready ×
+            # pressure) alone would read them as excess and cut the
+            # launch short while the fleet is still overloaded.
+            desired_raw = max(current, math.ceil(len(ready) * pressure))
+        elif pressure < 0.5:
+            desired_raw = max(1, math.ceil(len(ready) * pressure))
+        else:
+            desired_raw = current  # deadband: at target, hold
+        desired_raw = max(self.min_replicas,
+                          min(self.max_replicas, desired_raw))
+        desired = self._stable_target(current, desired_raw)
+
+        # Flap damping on top of hysteresis: an opposite-direction
+        # move within the damping window is suppressed (and the
+        # suppression recorded — replayable like everything else).
+        direction = (1 if desired > current else
+                     -1 if desired < current else 0)
+        damped = False
+        if direction != 0 and self._damp_remaining > 0 and \
+                direction == -self._last_direction:
+            damped = True
+            desired = current
+            direction = 0
+        if self._damp_remaining > 0:
+            self._damp_remaining -= 1
+
+        decisions: List[AutoscalerDecision] = []
+        if desired > current:
+            for _ in range(desired - current):
+                decisions.append(AutoscalerDecision(
+                    AutoscalerDecisionOperator.SCALE_UP,
+                    dict(self._replica_overrides())))
+        elif desired < current:
+            for replica_id in self._select_scale_down(
+                    alive, current - desired):
+                decisions.append(AutoscalerDecision(
+                    AutoscalerDecisionOperator.SCALE_DOWN, replica_id))
+        if direction != 0:
+            self._last_direction = direction
+            self._damp_remaining = self.flap_damping
+
+        outcome = ('damped' if damped else
+                   'up' if direction > 0 else
+                   'down' if direction < 0 else 'hold')
+        if self._record_metrics:
+            _DECISIONS.labels(direction=outcome).inc()
+            if pressure is not None:
+                _PRESSURE.set(pressure)
+            _TARGET_REPLICAS.set(desired)
+        self.decision_log.append({
+            'tick': self._tick,
+            'signals': {k: dict(v) for k, v in self._signals.items()},
+            'replicas': [
+                (i.replica_id, i.status.value, i.version,
+                 bool(getattr(i, 'is_spot', False)))
+                for i in replica_infos
+            ],
+            'current': current,
+            'pressure': pressure,
+            'desired_raw': desired_raw,
+            'desired': desired,
+            'outcome': outcome,
+            'decisions': [(d.operator.value, d.target)
+                          for d in decisions],
+        })
+        return decisions
+
+
+class _ReplayReplica:
+    """Replica stand-in rebuilt from a decision-log row (the replay
+    needs only what the autoscaler reads: id, status, version, spot)."""
+
+    def __init__(self, replica_id: int, status: str, version: int,
+                 is_spot: bool) -> None:
+        self.replica_id = replica_id
+        self.status = serve_state.ReplicaStatus(status)
+        self.version = version
+        self.is_spot = is_spot
+
+
+def replay_decision_log(spec: 'spec_lib.SkyServiceSpec',
+                        log: List[Dict[str, Any]]
+                        ) -> List[List[tuple]]:
+    """Re-derive a MetricsAutoscaler's decisions from its decision log
+    alone: feed each recorded tick's signals + replica snapshot through
+    a FRESH autoscaler and return the decision tuples per tick. Equal
+    to the recorded `decisions` streams iff the autoscaler is the
+    deterministic function of its logged inputs it claims to be (the
+    chaos harness pins this)."""
+    fresh = MetricsAutoscaler(spec, record_metrics=False)
+    out: List[List[tuple]] = []
+    for entry in log:
+        fresh.collect_replica_metrics(entry['signals'])
+        infos = [_ReplayReplica(*row) for row in entry['replicas']]
+        decisions = fresh.evaluate_scaling(infos)
+        out.append([(d.operator.value, d.target) for d in decisions])
+    return out
+
+
 def make_autoscaler(spec: 'spec_lib.SkyServiceSpec') -> Autoscaler:
+    # metrics targets + spot fallback is rejected at spec validation
+    # (SkyServiceSpec.__init__), so the arms are mutually exclusive.
+    if getattr(spec, 'metrics_autoscaling_enabled', False):
+        return MetricsAutoscaler(spec)
     if spec.use_ondemand_fallback:
         return FallbackRequestRateAutoscaler(spec)
     return RequestRateAutoscaler(spec)

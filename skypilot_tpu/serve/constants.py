@@ -81,6 +81,68 @@ def probe_timeout_seconds() -> float:
     return _env_float('SKYTPU_SERVE_PROBE_TIMEOUT', 15.0)
 
 
+# ---- fleet routing (serve/load_balancing_policies.py) ----
+
+
+def lb_policy_name() -> str:
+    """Which load-balancing policy `serve up` fleets run. Default is
+    prefix_aware (cache-aware + phase-aware with least-loaded
+    fallback); round_robin restores the historical behavior."""
+    return os.environ.get('SKYTPU_SERVE_LB_POLICY', 'prefix_aware')
+
+
+def lb_digest_staleness_seconds() -> float:
+    """How long a learned prefix digest stays routable. A digest older
+    than this is treated as ABSENT (the replica's cache may have
+    churned since): routing falls back to least-loaded, never errors."""
+    return _env_float('SKYTPU_SERVE_LB_DIGEST_STALENESS', 30.0)
+
+
+def lb_phase_prompt_threshold() -> int:
+    """Prompt length (tokens; bytes under the byte tokenizer) at and
+    above which a request counts as prefill-heavy for phase-aware
+    routing."""
+    return int(_env_float('SKYTPU_SERVE_LB_PHASE_THRESHOLD', 192))
+
+
+def lb_phase_min_fleet() -> int:
+    """Smallest ready fleet that specializes into prefill-leaning /
+    decode-leaning replicas; below it routing collapses to uniform
+    (a 2-replica fleet must not strand half its capacity per phase)."""
+    return max(2, int(_env_float('SKYTPU_SERVE_LB_PHASE_MIN_FLEET', 4)))
+
+
+def lb_phase_prefill_fraction() -> float:
+    """Fraction of the ready fleet designated prefill-leaning once the
+    fleet is large enough to specialize (at least one replica)."""
+    return _env_float('SKYTPU_SERVE_LB_PHASE_PREFILL_FRACTION', 0.25)
+
+
+# ---- metrics-driven autoscaling (serve/autoscalers.py) ----
+
+
+def target_queue_depth_per_replica() -> float:
+    """Default queue-depth target for the MetricsAutoscaler when the
+    service spec does not name one."""
+    return _env_float('SKYTPU_SERVE_TARGET_QUEUE_DEPTH', 4.0)
+
+
+def autoscaler_scrape_timeout_seconds() -> float:
+    """Per-replica /metrics scrape timeout for the MetricsAutoscaler's
+    input sweep. Deliberately much shorter than the readiness-probe
+    timeout: scrapes run every decision tick and a missing signal just
+    contributes nothing, so a wedged endpoint must not stall the
+    controller loop."""
+    return _env_float('SKYTPU_SERVE_SCRAPE_TIMEOUT', 3.0)
+
+
+def autoscaler_flap_damping_decisions() -> int:
+    """After an executed scale decision, how many decision ticks must
+    pass before a move in the OPPOSITE direction may execute — the
+    flap damper layered on top of the upscale/downscale hysteresis."""
+    return max(0, int(_env_float('SKYTPU_SERVE_FLAP_DAMPING', 3)))
+
+
 # ---- preemption lifecycle (serve/replica_managers.py + server.py) ----
 
 

@@ -62,6 +62,15 @@ class SkyServeController:
                     # fleet; ordinary autoscaling resumes after.
                     self._rollout_step()
                 else:
+                    if isinstance(self.autoscaler,
+                                  autoscalers.MetricsAutoscaler):
+                        # Metrics-driven scaling: feed the tick with
+                        # each READY replica's scraped TTFT/TPOT/
+                        # queue-depth signals (QPS timestamps still
+                        # arrive via the LB sync but are not the
+                        # decision input).
+                        self.autoscaler.collect_replica_metrics(
+                            self.replica_manager.scrape_replica_signals())
                     infos = self.replica_manager.get_replica_infos()
                     decisions = self.autoscaler.evaluate_scaling(infos)
                     for decision in decisions:

@@ -70,7 +70,9 @@ def up(task: 'task_lib.Task', service_name: Optional[str] = None,
     from skypilot_tpu.utils import common_utils
     common_utils.dump_yaml(task_yaml, task.to_yaml_config())
 
-    if not serve_state.add_service(service_name, 'round_robin', task_yaml):
+    if not serve_state.add_service(service_name,
+                                  constants.lb_policy_name(),
+                                  task_yaml):
         raise exceptions.ServeUserTerminatedError(
             f'Service {service_name!r} already exists. Use '
             'serve.update() or pick another name.')

@@ -9,10 +9,11 @@ natively streams chunked responses — the hot path for LLM token streaming.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Set
+from typing import Any, Callable, Dict, List, Optional, Set
 
 import aiohttp
 from aiohttp import web
@@ -42,6 +43,20 @@ _BREAKER_STATE = obs.gauge(
 _BREAKER_TRANSITIONS = obs.counter(
     'skytpu_lb_breaker_transitions_total',
     'Circuit-breaker state transitions', ('replica', 'transition'))
+_ROUTE_TOTAL = obs.counter(
+    'skytpu_lb_prefix_route_total',
+    'Cache-aware routing outcomes: hit (digest matched, routed to the '
+    'warm replica), miss (prompt hashed, no replica matched), stale '
+    '(only expired digests available), fallback (no prompt to hash), '
+    'rejected (corrupt digest dropped)', ('result',))
+_PHASE_TOTAL = obs.counter(
+    'skytpu_lb_phase_route_total',
+    'Phase-aware routing preferences applied (uniform routing when '
+    'the fleet is too small to specialize records nothing)', ('phase',))
+_REPLICA_PHASE = obs.gauge(
+    'skytpu_lb_replica_phase',
+    '1 while the replica is designated prefill-leaning by the '
+    'phase-aware partition, else 0', ('replica',))
 
 _HOP_HEADERS = {
     'connection', 'keep-alive', 'proxy-authenticate',
@@ -53,6 +68,13 @@ _HOP_HEADERS = {
 # request can have had no effect worth double-applying. POST /generate
 # is NOT here — a generation may already be burning decode slots.
 _IDEMPOTENT_METHODS = frozenset({'GET', 'HEAD', 'OPTIONS'})
+
+# Routes whose bodies carry a prompt worth hashing for cache-aware
+# routing (docs/serving.md "Fleet routing").
+_PROMPT_ROUTES = frozenset({'/generate', '/v1/completions',
+                            '/v1/chat/completions'})
+# A body bigger than this is not worth parsing on the proxy hot path.
+_HINT_BODY_CAP = 1 << 20
 
 
 class _CommittedStreamError(Exception):
@@ -255,9 +277,16 @@ class SkyServeLoadBalancer:
                 # controller no longer knows about.
                 known = set(urls)
                 for metric in (_LB_REQUESTS, _BREAKER_STATE,
-                               _BREAKER_TRANSITIONS):
+                               _BREAKER_TRANSITIONS, _REPLICA_PHASE):
                     metric.prune(
                         lambda labels: labels.get('replica') in known)
+                # Phase-aware partition visibility: 1 per prefill-
+                # leaning replica, 0 for decode-leaning (empty set =
+                # uniform routing, every replica reads 0).
+                prefill = self.policy.prefill_urls()
+                for url in urls:
+                    _REPLICA_PHASE.labels(replica=url).set(
+                        1 if url in prefill else 0)
         except Exception as e:  # pylint: disable=broad-except
             # Keep serving with the last-known replica list; re-queue the
             # timestamps so the QPS signal is not lost.
@@ -279,6 +308,52 @@ class SkyServeLoadBalancer:
 
     # ---------------- proxy ----------------
 
+    @staticmethod
+    def _routing_hint(request: web.Request,
+                      body: bytes) -> Optional[Dict[str, Any]]:
+        """Best-effort {'token_ids', 'prompt_len'} extracted from a
+        prompt-carrying request body, for cache/phase-aware routing.
+        Token ids come from prompt_ids verbatim, or from byte-encoding
+        a text prompt (the byte-tokenizer contract — an HF-tokenized
+        fleet simply never digest-matches text prompts and falls back,
+        which is the required fail-open behavior). Any parse problem
+        returns None: routing intel must never 4xx/5xx a request."""
+        if request.method.upper() != 'POST' or \
+                request.path not in _PROMPT_ROUTES or \
+                not body or len(body) > _HINT_BODY_CAP:
+            return None
+        try:
+            data = json.loads(body)
+            if not isinstance(data, dict):
+                return None
+            ids: Optional[List[int]] = None
+            prompt_ids = data.get('prompt_ids')
+            prompt = data.get('prompt')
+            if isinstance(prompt_ids, (list, tuple)) and prompt_ids and \
+                    isinstance(prompt_ids[0], (list, tuple)):
+                ids = [int(t) for t in prompt_ids[0]]
+            elif isinstance(prompt, str):
+                ids = list(prompt.encode('utf-8'))
+            elif isinstance(prompt, (list, tuple)) and prompt:
+                if isinstance(prompt[0], str):
+                    ids = list(prompt[0].encode('utf-8'))
+                elif isinstance(prompt[0], int):
+                    ids = [int(t) for t in prompt]
+            prompt_len: Optional[int] = len(ids) if ids else None
+            if prompt_len is None and \
+                    isinstance(data.get('messages'), list):
+                # Chat: the template is server-side, so there is
+                # nothing to hash — but the content length still
+                # phase-routes the request.
+                prompt_len = sum(
+                    len(str(m.get('content', '')))
+                    for m in data['messages'] if isinstance(m, dict))
+            if ids is None and prompt_len is None:
+                return None
+            return {'token_ids': ids, 'prompt_len': prompt_len}
+        except Exception:  # pylint: disable=broad-except
+            return None
+
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
         with self._ts_lock:
             self.request_timestamps.append(time.time())
@@ -289,6 +364,7 @@ class SkyServeLoadBalancer:
         # The body is fully buffered before the first attempt, so a
         # retry on a different replica replays the identical request.
         body = await request.read()
+        hint = self._routing_hint(request, body)
         idempotent = request.method.upper() in _IDEMPOTENT_METHODS
         attempts = constants.lb_retry_attempts() if idempotent else 1
         tried: Set[str] = set()
@@ -297,9 +373,15 @@ class SkyServeLoadBalancer:
             blocked = self.breaker.blocked(
                 self.policy.ready_replica_urls) | tried | \
                 self._draining_urls
-            replica_url = self.policy.select_replica(exclude=blocked)
+            replica_url, route_info = self.policy.select(exclude=blocked,
+                                                         hint=hint)
             if replica_url is None:
                 break
+            result = route_info.get('result')
+            if result in ('hit', 'miss', 'stale', 'fallback'):
+                _ROUTE_TOTAL.labels(result=result).inc()
+            if route_info.get('phase'):
+                _PHASE_TOTAL.labels(phase=route_info['phase']).inc()
             _LB_REQUESTS.labels(replica=replica_url).inc()
             if tried:
                 # Second (or later) attempt: this IS the
@@ -308,6 +390,7 @@ class SkyServeLoadBalancer:
             # If this replica is half-open, this request is the probe:
             # concurrent traffic keeps avoiding it until we report.
             self.breaker.claim_probe(replica_url)
+            self.policy.note_routed(replica_url)
             try:
                 return await self._proxy_once(request, replica_url,
                                               headers, body,
@@ -347,6 +430,10 @@ class SkyServeLoadBalancer:
                 # for an extra cooldown.
                 self.breaker.clear_probe(replica_url)
                 raise
+            finally:
+                # In-flight accounting for the least-loaded fallback:
+                # every routed request is released on every exit path.
+                self.policy.note_done(replica_url)
         if last_err is not None:
             # A replica existed and answered the wire with a transport
             # error — NOT a no-replica condition; counting it here
@@ -377,6 +464,13 @@ class SkyServeLoadBalancer:
                 data=body if body else None,
                 timeout=aiohttp.ClientTimeout(
                     total=None, sock_connect=10)) as upstream:
+            # Learn routing intel in-band from EVERY upstream answer
+            # (queue depth + prefix digest — the X-SkyTPU-Draining
+            # pattern): a corrupt digest is dropped and counted, never
+            # surfaced to the client.
+            if self.policy.observe_response(
+                    replica_url, upstream.headers) == 'rejected':
+                _ROUTE_TOTAL.labels(result='rejected').inc()
             if upstream.headers.get('X-SkyTPU-Draining') == '1':
                 # Learn the drain in-band on EVERY response carrying
                 # the header — serving traffic is POST, so without

@@ -1,12 +1,38 @@
 """Load-balancing policies.
 
 Reference parity: sky/serve/load_balancing_policies.py (70 LoC) —
-`RoundRobinPolicy` (:47).
+`RoundRobinPolicy` (:47). On top of it, `PrefixAwarePolicy` is the
+fleet-routing brain (docs/serving.md "Fleet routing"):
+
+- **cache-aware**: replicas piggyback a digest of their PrefixIndex
+  contents on every response (X-SkyTPU-Prefix-Digest, hashed chunk-trie
+  keys — kv_cache.prefix_route_hash on both sides); an incoming
+  prompt's chunk-aligned prefix hashes are intersected with each
+  replica's digest and the deepest match wins (warm KV beats an idle
+  queue: the hit skips a whole prefill).
+- **phase-aware**: once the ready fleet is large enough to specialize,
+  a deterministic slice of it is designated prefill-leaning; long
+  prompts prefer it, steady decode traffic prefers the rest. Below the
+  threshold the partition collapses to uniform routing.
+- **fallback**: on digest miss, stale digest, corrupt digest, breaker
+  exclusion, or DRAINING, selection degrades to least-loaded (the
+  in-band X-SkyTPU-Queue-Depth gauge plus locally-tracked in-flight
+  requests) with a deterministic URL tie-break. Routing NEVER blocks
+  or fails closed on missing cache intel — a replica is always
+  returned while any candidate exists.
+
+All intel is advisory and staleness-bounded; the clock is injectable so
+chaos tests drive digest expiry without sleeping.
 """
 from __future__ import annotations
 
+import math
 import threading
-from typing import List, Optional, Set
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu.serve import constants
+from skypilot_tpu.utils import fault_injection
 
 
 class LoadBalancingPolicy:
@@ -24,6 +50,34 @@ class LoadBalancingPolicy:
         """Pick a replica, skipping `exclude` (circuit-broken or
         already-tried replicas). None when nothing is selectable."""
         raise NotImplementedError
+
+    # -- fleet-routing hooks (no-ops for policies that ignore intel) --
+
+    def select(self, exclude: Optional[Set[str]] = None,
+               hint: Optional[Dict[str, Any]] = None
+               ) -> Tuple[Optional[str], Dict[str, Any]]:
+        """(replica_url, routing_info). `hint` optionally carries
+        {'token_ids': [...], 'prompt_len': N} extracted from the
+        request body; policies that cannot use it ignore it."""
+        del hint
+        return self.select_replica(exclude), {}
+
+    def observe_response(self, url: str, headers) -> Optional[str]:
+        """Learn in-band routing intel from an upstream response's
+        headers (queue depth, prefix digest). Returns 'learned' /
+        'rejected' when a digest was processed, None otherwise."""
+        return None
+
+    def note_routed(self, url: str) -> None:
+        """A request was just routed to `url` (in-flight accounting)."""
+
+    def note_done(self, url: str) -> None:
+        """A previously-routed request finished (either way)."""
+
+    def prefill_urls(self) -> Set[str]:
+        """The prefill-leaning slice of the fleet (empty when the
+        policy does not specialize)."""
+        return set()
 
 
 class RoundRobinPolicy(LoadBalancingPolicy):
@@ -54,6 +108,218 @@ class RoundRobinPolicy(LoadBalancingPolicy):
             return None
 
 
+class PrefixAwarePolicy(LoadBalancingPolicy):
+    """Cache-aware + phase-aware routing with least-loaded fallback
+    (module docstring has the design; docs/serving.md the semantics).
+
+    `stats` counts routing outcomes in plain ints so the policy is
+    testable and benchable without the metrics registry; the load
+    balancer mirrors them into skytpu_lb_prefix_route_total /
+    skytpu_lb_phase_route_total."""
+
+    def __init__(self, clock=time.monotonic) -> None:
+        super().__init__()
+        self._clock = clock
+        # url -> {'chunk': int, 'epoch': int, 'hashes': set, 'at': t}
+        self._digests: Dict[str, dict] = {}
+        # url -> (advertised queue depth, learned-at t)
+        self._depths: Dict[str, Tuple[int, float]] = {}
+        # url -> requests routed here since the last depth observation.
+        self._outstanding: Dict[str, int] = {}
+        self._prefill: Set[str] = set()
+        self.stats = {'hit': 0, 'miss': 0, 'stale': 0, 'fallback': 0,
+                      'digest_rejected': 0, 'phase_prefill': 0,
+                      'phase_decode': 0}
+
+    # ---------------- membership / phase partition ----------------
+
+    def set_ready_replicas(self, urls: List[str]) -> None:
+        with self._lock:
+            self.ready_replica_urls = list(urls)
+            known = set(urls)
+            for table in (self._digests, self._depths,
+                          self._outstanding):
+                for url in list(table):
+                    if url not in known:
+                        del table[url]
+            # Deterministic phase partition: the first
+            # ceil(n*fraction) of the SORTED urls lean prefill once
+            # the fleet is big enough to specialize. Sorting (not
+            # arrival order) keeps the partition stable across
+            # controller syncs that reorder the list.
+            n = len(known)
+            if n >= constants.lb_phase_min_fleet():
+                frac = constants.lb_phase_prefill_fraction()
+                count = min(n - 1, max(1, math.ceil(n * frac)))
+                self._prefill = set(sorted(known)[:count])
+            else:
+                self._prefill = set()
+
+    def prefill_urls(self) -> Set[str]:
+        with self._lock:
+            return set(self._prefill)
+
+    # ---------------- in-band intel ----------------
+
+    def observe_response(self, url: str, headers) -> Optional[str]:
+        now = self._clock()
+        depth = headers.get('X-SkyTPU-Queue-Depth')
+        digest = headers.get('X-SkyTPU-Prefix-Digest')
+        with self._lock:
+            if depth is not None:
+                try:
+                    self._depths[url] = (max(0, int(depth)), now)
+                    self._outstanding[url] = 0
+                except ValueError:
+                    pass
+            if digest is None:
+                return None
+            try:
+                # Chaos seam: an armed 'lb.digest' fault is a corrupt
+                # digest on the wire — it must degrade to no-intel
+                # fallback, never to an error on the serving path.
+                fault_injection.point('lb.digest')
+                self._digests[url] = self._parse_digest(digest, now)
+                return 'learned'
+            except (fault_injection.InjectedFault, ValueError):
+                self._digests.pop(url, None)
+                self.stats['digest_rejected'] += 1
+                return 'rejected'
+
+    @staticmethod
+    def _parse_digest(value: str, now: float) -> dict:
+        version, chunk, epoch, hashes = value.split(':', 3)
+        if version != 'v1':
+            raise ValueError(f'unknown digest version {version!r}')
+        return {
+            'chunk': int(chunk),
+            'epoch': int(epoch),
+            'hashes': set(h for h in hashes.split(',') if h),
+            'at': now,
+        }
+
+    def note_routed(self, url: str) -> None:
+        with self._lock:
+            self._outstanding[url] = self._outstanding.get(url, 0) + 1
+
+    def note_done(self, url: str) -> None:
+        with self._lock:
+            pending = self._outstanding.get(url, 0)
+            if pending > 0:
+                self._outstanding[url] = pending - 1
+
+    def _load(self, url: str, now: float) -> int:
+        """Advertised queue depth (staleness-bounded — a depth the
+        replica reported during a burst must not exile it from
+        least-loaded routing after its queue drained; past the bound
+        it reads as unknown/0) plus locally-tracked in-flight."""
+        depth, learned_at = self._depths.get(url, (0, 0.0))
+        if now - learned_at > constants.lb_digest_staleness_seconds():
+            depth = 0
+        return depth + self._outstanding.get(url, 0)
+
+    # ---------------- selection ----------------
+
+    def _prompt_hashes(self, token_ids, chunk: int) -> List[str]:
+        """Chunk-aligned prefix hashes of the prompt, shortest first.
+        Capped at len-1 tokens, mirroring the engine's own lookup limit
+        (the suffix must stay non-empty to produce logits)."""
+        from skypilot_tpu.models import kv_cache as kv_cache_lib
+        limit = max(0, len(token_ids) - 1)
+        return [
+            kv_cache_lib.prefix_route_hash(token_ids[:k * chunk])
+            for k in range(1, limit // chunk + 1)
+        ]
+
+    def select(self, exclude: Optional[Set[str]] = None,
+               hint: Optional[Dict[str, Any]] = None
+               ) -> Tuple[Optional[str], Dict[str, Any]]:
+        exclude = exclude or set()
+        hint = hint or {}
+        now = self._clock()
+        with self._lock:
+            candidates = [u for u in self.ready_replica_urls
+                          if u not in exclude]
+            if not candidates:
+                return None, {'result': 'no_replica'}
+
+            # 1. Cache-aware: deepest digest match wins; ties break by
+            # (load, url) so the choice is deterministic.
+            token_ids = hint.get('token_ids')
+            saw_stale = saw_fresh = False
+            if token_ids and len(token_ids) > 1:
+                staleness = constants.lb_digest_staleness_seconds()
+                hash_cache: Dict[int, List[str]] = {}
+                best: Optional[Tuple[int, int, str]] = None
+                for url in candidates:
+                    digest = self._digests.get(url)
+                    if digest is None:
+                        continue
+                    if now - digest['at'] > staleness:
+                        saw_stale = True
+                        continue
+                    saw_fresh = True
+                    chunk = digest['chunk']
+                    if chunk < 1:
+                        continue
+                    hashes = hash_cache.get(chunk)
+                    if hashes is None:
+                        hashes = self._prompt_hashes(token_ids, chunk)
+                        hash_cache[chunk] = hashes
+                    depth = 0
+                    for k, h in enumerate(hashes, start=1):
+                        if h in digest['hashes']:
+                            depth = k * chunk
+                    if depth <= 0:
+                        continue
+                    key = (-depth, self._load(url, now), url)
+                    if best is None or key < best:
+                        best = key
+                if best is not None:
+                    url = best[2]
+                    self.stats['hit'] += 1
+                    return url, {'result': 'hit',
+                                 'matched_tokens': -best[0]}
+
+            # 2. Phase-aware preference (uniform when the fleet is too
+            # small to specialize, or the preferred phase is fully
+            # excluded — never fail closed).
+            pool = candidates
+            phase = None
+            if self._prefill:
+                prompt_len = hint.get('prompt_len') or (
+                    len(token_ids) if token_ids else 0)
+                want_prefill = (prompt_len >=
+                                constants.lb_phase_prompt_threshold())
+                preferred = [u for u in candidates
+                             if (u in self._prefill) == want_prefill]
+                if preferred:
+                    pool = preferred
+                    phase = 'prefill' if want_prefill else 'decode'
+                    self.stats['phase_prefill' if want_prefill
+                               else 'phase_decode'] += 1
+
+            # 3. Least-loaded with deterministic tie-break.
+            url = min(pool, key=lambda u: (self._load(u, now), u))
+            if saw_stale and not saw_fresh:
+                # ONLY expired digests were available (documented
+                # semantics): a fresh digest that simply missed is a
+                # miss, not a staleness signal.
+                result = 'stale'
+            elif token_ids:
+                result = 'miss'
+            else:
+                result = 'fallback'
+            self.stats[result] += 1
+            return url, {'result': result, 'phase': phase}
+
+    def select_replica(self,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
+        return self.select(exclude)[0]
+
+
 POLICIES = {
     'round_robin': RoundRobinPolicy,
+    'prefix_aware': PrefixAwarePolicy,
 }

@@ -41,6 +41,7 @@ def main() -> int:
     from skypilot_tpu.utils import remote_rpc
     remote_rpc.merge_enabled_clouds(args.enabled_clouds)
 
+    from skypilot_tpu.serve import constants
     from skypilot_tpu.serve import serve_state
     from skypilot_tpu.serve import service as service_lib
 
@@ -62,7 +63,8 @@ def main() -> int:
     controller_port = _usable(args.controller_port)
     lb_port = _usable(args.lb_port)
     task_yaml = os.path.expanduser(args.task_yaml)
-    serve_state.add_service(args.service_name, 'round_robin', task_yaml)
+    serve_state.add_service(args.service_name,
+                            constants.lb_policy_name(), task_yaml)
     serve_state.set_service_controller(args.service_name, os.getpid(),
                                        controller_port, lb_port)
     return service_lib.run_service(args.service_name, task_yaml,

@@ -106,6 +106,39 @@ class ReplicaInfo:
                 f'v{self.version}, {self.status.value})')
 
 
+def _signals_from_exposition(text: str) -> Dict[str, float]:
+    """Reduce a replica's Prometheus exposition to the
+    MetricsAutoscaler's inputs: the queue-depth gauge plus the TTFT /
+    TPOT histogram MEANS (sum/count — the lifetime average; good
+    enough for a scale signal and free of bucket interpolation).
+    Missing families are simply absent keys."""
+    from skypilot_tpu.observability import exposition
+    families = exposition.parse_prometheus_text(text)
+
+    def scalar(family: str, sample: str) -> Optional[float]:
+        fam = families.get(family)
+        if fam is None:
+            return None
+        total = None
+        for (name, _labels), value in fam['samples'].items():
+            if name == sample:
+                total = (total or 0.0) + value
+        return total
+
+    signals: Dict[str, float] = {}
+    queue = scalar('skytpu_engine_queue_depth',
+                   'skytpu_engine_queue_depth')
+    if queue is not None:
+        signals['queue_depth'] = queue
+    for key, family in (('ttft_s', 'skytpu_engine_ttft_seconds'),
+                        ('tpot_s', 'skytpu_engine_tpot_seconds')):
+        total = scalar(family, family + '_sum')
+        count = scalar(family, family + '_count')
+        if total is not None and count:
+            signals[key] = total / count
+    return signals
+
+
 def _port_for_replica(base_port: int, replica_id: int) -> int:
     if os.environ.get('SKYTPU_SERVE_PORT_OFFSET_BY_REPLICA') == '1':
         return base_port + replica_id
@@ -461,6 +494,50 @@ class SkyPilotReplicaManager:
                 else:
                     info.status = ReplicaStatus.NOT_READY
                     self._persist(info)
+
+    # ---------------- metric scraping (MetricsAutoscaler input) ----
+
+    def scrape_replica_signals(self) -> Dict[int, Dict[str, float]]:
+        """Best-effort per-replica serving signals for the
+        MetricsAutoscaler: GET each READY replica's /metrics, parse
+        with the strict exposition parser, and reduce to
+        {'queue_depth', 'ttft_s', 'tpot_s'} (histogram means). A
+        replica that fails to scrape simply contributes nothing —
+        scaling on partial intel beats flapping on scrape outages.
+        DRAINING replicas are skipped by construction: their queues
+        run dry by design, which would read as idle capacity."""
+        import concurrent.futures
+        with self.lock:
+            ready = [i for i in self.replicas.values()
+                     if i.status == ReplicaStatus.READY and
+                     i.url is not None]
+        if not ready:
+            return {}
+
+        def scrape(info: ReplicaInfo):
+            try:
+                resp = requests.get(
+                    info.url + '/metrics',
+                    timeout=constants.autoscaler_scrape_timeout_seconds())
+                if resp.status_code != 200:
+                    return info.replica_id, None
+                return (info.replica_id,
+                        _signals_from_exposition(resp.text))
+            except (requests.RequestException, ValueError) as e:
+                logger.debug('metrics scrape of replica %d failed: %s',
+                             info.replica_id, e)
+                return info.replica_id, None
+
+        # Concurrent + short timeout: the sweep runs inside the
+        # controller's decision loop, so a few wedged endpoints must
+        # cost ONE scrape timeout, not one per replica.
+        out: Dict[int, Dict[str, float]] = {}
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(ready))) as pool:
+            for replica_id, signals in pool.map(scrape, ready):
+                if signals is not None:
+                    out[replica_id] = signals
+        return out
 
     # ---------------- preemption lifecycle ----------------
     # (docs/resilience.md "Preemption lifecycle": notice → drain →

@@ -167,8 +167,13 @@ class InferenceServer:
             mesh = decode_mesh(tp)
         params = None
         if checkpoint_dir:
+            # Mesh-first restore: with tp>1 orbax deserializes each
+            # leaf straight into its serving-mesh sharding
+            # (tree_shardings out-shardings), so the weights never
+            # materialize whole on device 0 before _place_params.
             params = load_params_from_checkpoint(get_config(model),
-                                                 checkpoint_dir)
+                                                 checkpoint_dir,
+                                                 mesh=mesh)
         elif hf_model_path:
             # A local HF checkpoint dir (safetensors): convert into the
             # mesh-first tree. The cfg carries the max_seq_len override
@@ -503,9 +508,27 @@ class InferenceServer:
             full = self.decode(toks)
             if full.startswith(sent['text']):
                 return full[len(sent['text']):]
-            # The canonical decode retroactively changed text that was
-            # already on the wire; emitted bytes cannot be retracted —
-            # log loudly rather than silently diverge.
+            # The canonical decode no longer extends what was sent.
+            # When everything already on the wire past the common
+            # prefix is U+FFFD placeholders (a stale '�' that got
+            # emitted before its replacement bytes arrived), the
+            # corrected text was WITHHELD by push — emit it now, as
+            # the diff against what was actually sent, instead of
+            # dropping it: the stale marker cannot be retracted, but
+            # the replacement must not be lost with it (round-5
+            # ADVICE item; regression-pinned).
+            already = sent['text']
+            common = 0
+            for a, b in zip(already, full):
+                if a != b:
+                    break
+                common += 1
+            stale_tail = already[common:]
+            if stale_tail and set(stale_tail) <= {'�'}:
+                return full[common:]
+            # Genuinely divergent non-placeholder text is on the wire;
+            # emitted bytes cannot be retracted — log loudly rather
+            # than silently diverge.
             logger.warning(
                 'streamed text diverged from canonical decode '
                 '(sent %r... vs canonical %r...)', sent['text'][:40],
@@ -1059,12 +1082,44 @@ class InferenceServer:
                       'owned_by': 'skypilot_tpu'}],
         })
 
+    def _fleet_intel_headers(self) -> dict:
+        """Routing intel piggybacked on every response (the
+        X-SkyTPU-Draining pattern): current queue load and the prefix-
+        cache digest, read by the load balancer's cache-aware /
+        least-loaded policy (docs/serving.md "Fleet routing").
+        Best-effort by contract — a failure here must never fail a
+        response the engine already produced."""
+        headers = {}
+        engine = getattr(self, 'engine', None)
+        if engine is None:
+            return headers
+        try:
+            headers['X-SkyTPU-Queue-Depth'] = str(engine.queue_load())
+            digest = engine.prefix_digest()
+            if digest:
+                headers['X-SkyTPU-Prefix-Digest'] = digest
+        except Exception:  # pylint: disable=broad-except
+            logger.debug('fleet-intel headers unavailable', exc_info=True)
+        return headers
+
     def make_app(self) -> web.Application:
         # Serving a /metrics route IS attaching an exporter: recording
         # flips on here, never at import (tests pin the import path
         # side-effect-free).
         obs.enable()
-        app = web.Application(middlewares=[_metrics_middleware])
+
+        @web.middleware
+        async def fleet_headers_middleware(request, handler):
+            response = await handler(request)
+            # Streaming responses (SSE) are already on the wire by the
+            # time the middleware sees them — headers are immutable.
+            if not response.prepared:
+                for key, value in self._fleet_intel_headers().items():
+                    response.headers[key] = value
+            return response
+
+        app = web.Application(middlewares=[_metrics_middleware,
+                                           fleet_headers_middleware])
         app.router.add_get('/health', self.handle_health)
         app.router.add_get('/metrics', self.handle_metrics)
         app.router.add_post('/preempt', self.handle_preempt)

@@ -64,7 +64,12 @@ def run_service(service_name: str, task_yaml: str, controller_port: int,
     balancer = lb_lib.SkyServeLoadBalancer(
         controller_url=(
             f'http://{constants.CONTROLLER_HOST}:{controller_port}'),
-        port=lb_port)
+        port=lb_port,
+        # prefix_aware by default (cache-aware + phase-aware with
+        # least-loaded fallback; $SKYTPU_SERVE_LB_POLICY overrides) —
+        # it degrades to uniform least-loaded routing when replicas
+        # advertise no digests, so non-engine replicas lose nothing.
+        policy_name=constants.lb_policy_name())
     balancer.start_in_thread()
 
     stopping = {'flag': False}

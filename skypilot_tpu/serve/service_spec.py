@@ -32,6 +32,9 @@ class SkyServiceSpec:
         base_ondemand_fallback_replicas: Optional[int] = None,
         dynamic_ondemand_fallback: Optional[bool] = None,
         use_ondemand_fallback: bool = False,
+        target_queue_depth_per_replica: Optional[float] = None,
+        target_ttft_seconds: Optional[float] = None,
+        target_tpot_seconds: Optional[float] = None,
     ) -> None:
         if not readiness_path.startswith('/'):
             raise ValueError(
@@ -47,6 +50,37 @@ class SkyServiceSpec:
                 raise ValueError(
                     'max_replicas is required when autoscaling with '
                     'target_qps_per_replica')
+        metric_targets = [
+            name for name, value in (
+                ('target_queue_depth_per_replica',
+                 target_queue_depth_per_replica),
+                ('target_ttft_seconds', target_ttft_seconds),
+                ('target_tpot_seconds', target_tpot_seconds))
+            if value is not None
+        ]
+        for name, value in (
+                ('target_queue_depth_per_replica',
+                 target_queue_depth_per_replica),
+                ('target_ttft_seconds', target_ttft_seconds),
+                ('target_tpot_seconds', target_tpot_seconds)):
+            if value is not None:
+                if value <= 0:
+                    raise ValueError(f'{name} must be > 0')
+                if max_replicas is None:
+                    raise ValueError(
+                        f'max_replicas is required when autoscaling '
+                        f'with {name}')
+        if metric_targets and (use_ondemand_fallback or
+                               base_ondemand_fallback_replicas or
+                               dynamic_ondemand_fallback):
+            # Refuse at validation time: silently degrading to the
+            # QPS autoscaler would pin a fleet with no QPS target at
+            # min_replicas forever, with only a log line to show why.
+            raise ValueError(
+                f'metrics-driven autoscaling ({", ".join(metric_targets)}) '
+                f'does not compose with spot on-demand fallback yet; '
+                f'drop the fallback knobs or use '
+                f'target_qps_per_replica')
         self.readiness_path = readiness_path
         self.initial_delay_seconds = initial_delay_seconds
         self.readiness_timeout_seconds = readiness_timeout_seconds
@@ -63,10 +97,22 @@ class SkyServiceSpec:
             use_ondemand_fallback or
             bool(base_ondemand_fallback_replicas) or
             bool(dynamic_ondemand_fallback))
+        # Metrics-driven autoscaling (serve/autoscalers.MetricsAutoscaler):
+        # scale from observed queue depth / TTFT / TPOT instead of QPS.
+        self.target_queue_depth_per_replica = target_queue_depth_per_replica
+        self.target_ttft_seconds = target_ttft_seconds
+        self.target_tpot_seconds = target_tpot_seconds
 
     @property
     def autoscaling_enabled(self) -> bool:
-        return self.target_qps_per_replica is not None
+        return (self.target_qps_per_replica is not None or
+                self.metrics_autoscaling_enabled)
+
+    @property
+    def metrics_autoscaling_enabled(self) -> bool:
+        return any(v is not None for v in (
+            self.target_queue_depth_per_replica,
+            self.target_ttft_seconds, self.target_tpot_seconds))
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyServiceSpec':
@@ -111,7 +157,9 @@ class SkyServiceSpec:
                         'downscale_delay_seconds',
                         'base_ondemand_fallback_replicas',
                         'dynamic_ondemand_fallback',
-                        'use_ondemand_fallback'):
+                        'use_ondemand_fallback',
+                        'target_queue_depth_per_replica',
+                        'target_ttft_seconds', 'target_tpot_seconds'):
                 if key in policy:
                     kwargs[key] = policy[key]
         return cls(**kwargs)
@@ -135,7 +183,9 @@ class SkyServiceSpec:
             for key in ('max_replicas', 'target_qps_per_replica',
                         'upscale_delay_seconds', 'downscale_delay_seconds',
                         'base_ondemand_fallback_replicas',
-                        'dynamic_ondemand_fallback'):
+                        'dynamic_ondemand_fallback',
+                        'target_queue_depth_per_replica',
+                        'target_ttft_seconds', 'target_tpot_seconds'):
                 value = getattr(self, key)
                 if value is not None:
                     policy[key] = value

@@ -73,7 +73,7 @@ class CheckpointManager:
         self._manager.close()
 
 
-def restore_params_only(cfg, checkpoint_dir: str):
+def restore_params_only(cfg, checkpoint_dir: str, mesh=None):
     """Restore ONLY the params subtree of a train checkpoint (orbax
     partial restore) — skips the fp32 AdamW moments, cutting peak memory
     ~5x vs materializing the whole TrainState. The right loader for
@@ -84,6 +84,14 @@ def restore_params_only(cfg, checkpoint_dir: str):
     all local devices), not the sharding saved at train time — a
     checkpoint trained on a 32-chip mesh must load on an 8-chip serving
     replica.
+
+    `mesh` overrides the default training-style mesh with an explicit
+    target (the serving decode_mesh): every leaf deserializes with the
+    SAME tree_shardings out-shardings the engine will place it under,
+    so a tensor-parallel replica's weights are born sharded on the tp
+    axis — they never materialize whole on device 0 on their way to
+    the engine (pinned by the restore-placement test in
+    tests/test_sharding_rules.py).
     """
     import os as os_lib
 
@@ -96,14 +104,17 @@ def restore_params_only(cfg, checkpoint_dir: str):
     from skypilot_tpu.parallel import build_mesh, infer_mesh_config
     from skypilot_tpu.parallel import sharding as sharding_lib
 
-    mesh = build_mesh(infer_mesh_config(jax.device_count()))
+    if mesh is None:
+        mesh = build_mesh(infer_mesh_config(jax.device_count()))
     abstract = jax.eval_shape(
         lambda: Transformer(cfg).init(jax.random.PRNGKey(0),
                                       jnp.ones((1, 8), jnp.int32))
     )['params']
-    specs = nn.get_partition_spec(abstract)
-    shardings = nn.logical_to_mesh_sharding(
-        specs, mesh, sharding_lib.logical_axis_rules())
+    # tree_shardings is the ONE logical→physical translation (the PR-7
+    # dedup contract): an explicit serving mesh takes the same path
+    # _place_params uses, so restore placement and engine placement
+    # can never disagree.
+    shardings = nn.unbox(sharding_lib.tree_shardings(mesh, abstract))
     abstract = jax.tree.map(
         lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
         nn.unbox(abstract), shardings,

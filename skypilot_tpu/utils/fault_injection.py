@@ -25,6 +25,11 @@ on the framework's failure-critical paths:
     storage.export  prefix-artifact export — per exported prefix
     storage.import  prefix-artifact import / pre-warm — per imported
                     prefix
+    lb.digest       serve/load_balancing_policies — as the load
+                    balancer learns a replica's prefix digest from a
+                    response header; a failure simulates a corrupt
+                    digest on the wire (routing must fall back to
+                    least-loaded, never error)
 
 Disarmed (the default, always in production) a point is a single
 module-level boolean check: no allocation, no locks, no behavior change
@@ -67,6 +72,7 @@ KNOWN_POINTS = (
     'replica.preempt_kill',
     'storage.export',
     'storage.import',
+    'lb.digest',
 )
 
 

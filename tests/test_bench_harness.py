@@ -152,3 +152,27 @@ class TestWorkerPartialFile:
         assert any(r.get('primary') for r in rows)
         final = json.loads(proc.stdout.strip().splitlines()[-1])
         assert 'partial' not in final  # clean run is not marked partial
+
+
+class TestFleetDryrunDispatch:
+
+    def test_dryrun_serve_fleet_skips_tpu_preflight(self, monkeypatch):
+        """--dryrun-serve-fleet exists for when the chip is
+        unreachable: it must route through the no-preflight dryrun
+        supervisor (like --dryrun-serve-sharded), never the TPU probe
+        ladder that would burn minutes on a dead tunnel."""
+        bench = _load_bench()
+        calls = {}
+        def fake_dryrun(argv):
+            calls['dry'] = argv
+            return 0
+
+        monkeypatch.setattr(bench, '_supervise_dryrun', fake_dryrun)
+        monkeypatch.setattr(
+            bench, '_supervise',
+            lambda argv: (_ for _ in ()).throw(
+                AssertionError('TPU preflight path taken')))
+        monkeypatch.setattr(sys, 'argv',
+                            ['bench.py', '--dryrun-serve-fleet'])
+        assert bench.main() == 0
+        assert calls['dry'] == ['--dryrun-serve-fleet']

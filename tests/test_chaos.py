@@ -619,6 +619,272 @@ class TestCircuitBreaker:
 
 
 # ---------------------------------------------------------------------
+# fleet storm: cache-aware routing + breakers + drain + digest chaos
+# ---------------------------------------------------------------------
+
+
+_GROUP_A = list(range(1, 21))        # 20 tokens → chunk hashes at 8, 16
+_GROUP_B = list(range(40, 60))
+_GROUP_C = list(range(70, 90))
+
+
+def _chunk_hashes(ids, chunk=8):
+    from skypilot_tpu.models.kv_cache import prefix_route_hash
+    return [prefix_route_hash(ids[:k * chunk])
+            for k in range(1, (len(ids) - 1) // chunk + 1)]
+
+
+class TestFleetStorm:
+    """THE fleet-robustness acceptance scenario (ISSUE 9): a 3-replica
+    fleet behind the prefix-aware LB survives a storm of preemption
+    drains, transport deaths (breaker trips), stale digests, and
+    corrupt digests — with a fake clock driving breaker cooldowns and
+    digest staleness, zero requests lost non-retryably, bounded retry
+    amplification, greedy output bit-identical to a single healthy
+    replica regardless of which replica served, and the metrics
+    autoscaler's storm decisions replayable from its log."""
+
+    @pytest.fixture(scope='class')
+    def fleet(self):
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        from skypilot_tpu.serve.load_balancer import (
+            ReplicaCircuitBreaker, SkyServeLoadBalancer)
+        from skypilot_tpu.serve.load_balancing_policies import \
+            PrefixAwarePolicy
+        engines, servers, urls = [], [], []
+        for _ in range(3):
+            engine = ContinuousBatchingEngine(_cfg(), num_slots=2,
+                                              paged_block_size=8,
+                                              prefix_cache=4)
+            engine.generate([1, 2, 3], max_new_tokens=2,
+                            timeout=300)  # compile
+            server = _wrap_server(engine)
+            port = _serve_in_thread(server.make_app())
+            engines.append(engine)
+            servers.append(server)
+            urls.append(f'http://127.0.0.1:{port}')
+        # The bit-identity oracle: one never-stormed engine with the
+        # same seed/config (engines are weight-identical by seed).
+        ref = ContinuousBatchingEngine(_cfg(), num_slots=2,
+                                       paged_block_size=8,
+                                       prefix_cache=4)
+
+        clock = {'t': 0.0}
+        policy = PrefixAwarePolicy(clock=lambda: clock['t'])
+        lb_port = _free_port()
+        lb = SkyServeLoadBalancer('http://127.0.0.1:1', lb_port,
+                                  policy_name='prefix_aware')
+        lb.policy = policy
+        # threshold=1 + huge cooldown on the fake clock: one transport
+        # error ejects a replica for the rest of the storm.
+        lb.breaker = ReplicaCircuitBreaker(threshold=1, cooldown=1e9,
+                                           clock=lambda: clock['t'])
+        policy.set_ready_replicas(list(urls))
+        lb.start_in_thread()
+        lb_url = f'http://127.0.0.1:{lb_port}'
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                requests.get(lb_url + '/metrics', timeout=2)
+                break
+            except requests.RequestException:
+                time.sleep(0.1)
+        yield {'engines': engines, 'servers': servers, 'urls': urls,
+               'ref': ref, 'lb': lb, 'policy': policy, 'clock': clock,
+               'lb_url': lb_url}
+        fault_injection.disarm_all()
+        for engine in engines:
+            engine.stop()
+        ref.stop()
+
+    def _post(self, lb_url, ids, attempts, max_attempts=4):
+        """Client-side retry loop: every non-200 must be RETRYABLE
+        (502 upstream error or 503 with Retry-After) — a request is
+        'lost non-retryably' iff this helper raises."""
+        for _ in range(max_attempts):
+            attempts['n'] += 1
+            resp = requests.post(
+                lb_url + '/generate',
+                json={'prompt_ids': [ids], 'max_new_tokens': 4},
+                timeout=300)
+            if resp.status_code == 200:
+                return resp.json()['token_ids'][0]
+            assert resp.status_code in (502, 503), resp.text
+            if resp.status_code == 503:
+                assert 'Retry-After' in resp.headers, resp.text
+        raise AssertionError(f'request lost non-retryably: {ids[:4]}...')
+
+    def test_storm_invariants(self, fleet):
+        from skypilot_tpu.serve import autoscalers
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        engines = fleet['engines']
+        servers = fleet['servers']
+        urls = fleet['urls']
+        ref, lb, policy = fleet['ref'], fleet['lb'], fleet['policy']
+        clock, lb_url = fleet['clock'], fleet['lb_url']
+
+        workload = [
+            _GROUP_A, _GROUP_B,
+            _GROUP_A + [30, 31], _GROUP_B + [61, 62],
+            _GROUP_A + [30, 31, 32], _GROUP_B + [61, 62, 63],
+        ]
+        reference = {tuple(ids): ref.generate(ids, max_new_tokens=4,
+                                              timeout=300)[0]
+                     for ids in workload + [_GROUP_C, _GROUP_C + [91]]}
+        attempts = {'n': 0}
+        served = 0
+
+        # Storm-long autoscaler, fed each phase; replayed at the end.
+        spec = SkyServiceSpec(min_replicas=1, max_replicas=6,
+                              target_queue_depth_per_replica=2.0,
+                              upscale_delay_seconds=0,
+                              downscale_delay_seconds=0)
+        scaler = autoscalers.MetricsAutoscaler(spec)
+
+        class _Info:
+
+            def __init__(self, rid, status=ReplicaStatus.READY):
+                self.replica_id = rid
+                self.status = status
+                self.version = 1
+                self.is_spot = False
+
+        def autoscale_tick(signals, statuses):
+            scaler.collect_replica_metrics(signals)
+            return scaler.evaluate_scaling(
+                [_Info(i, st) for i, st in enumerate(statuses)])
+
+        def engine_signals(extra=0.0):
+            return {i: {'queue_depth': e.queue_load() + extra}
+                    for i, e in enumerate(engines)}
+
+        # ---- wave 1: warm traffic, cache-aware convergence ----
+        for ids in workload:
+            out = self._post(lb_url, ids, attempts)
+            assert out == reference[tuple(ids)]
+            served += 1
+        # Repeats of a group converged onto the replica holding it.
+        assert policy.stats['hit'] >= 3, policy.stats
+        autoscale_tick(engine_signals(), [ReplicaStatus.READY] * 3)
+
+        # ---- phase 2: a dead replica with the most attractive digest
+        # (transport death mid-advertisement) → breaker trip + retry ----
+        dead_url = f'http://127.0.0.1:{_free_port()}'
+        policy.set_ready_replicas(list(urls) + [dead_url])
+        policy.observe_response(dead_url, {
+            'X-SkyTPU-Queue-Depth': '0',
+            'X-SkyTPU-Prefix-Digest':
+                'v1:8:1:' + ','.join(_chunk_hashes(_GROUP_C + [91])),
+        })
+        before = attempts['n']
+        out = self._post(lb_url, _GROUP_C, attempts)
+        assert out == reference[tuple(_GROUP_C)]
+        served += 1
+        # Exactly one wasted attempt: the digest pointed at the corpse,
+        # the 502 charged its breaker, the retry landed elsewhere.
+        assert attempts['n'] - before == 2
+        assert lb.breaker.is_ejected(dead_url)
+        # Follow-up traffic never touches the ejected replica again:
+        # bounded amplification, not one 502 per request.
+        before = attempts['n']
+        out = self._post(lb_url, _GROUP_C + [91], attempts)
+        assert out == reference[tuple(_GROUP_C + [91])]
+        served += 1
+        assert attempts['n'] - before == 1
+        autoscale_tick({**engine_signals(), 3: {'queue_depth': 10.0}},
+                       [ReplicaStatus.READY] * 3)
+
+        # ---- phase 3: every digest goes stale (fake clock) — routing
+        # falls back least-loaded, never blocks or errors ----
+        clock['t'] += 1e5
+        before_stale = policy.stats['stale']
+        out = self._post(lb_url, _GROUP_A + [30, 31], attempts)
+        assert out == reference[tuple(_GROUP_A + [30, 31])]
+        served += 1
+        assert policy.stats['stale'] > before_stale
+        # That response re-advertised a fresh digest: hits resume.
+        out = self._post(lb_url, _GROUP_A + [30, 31, 32], attempts)
+        assert out == reference[tuple(_GROUP_A + [30, 31, 32])]
+        served += 1
+
+        # ---- phase 4: corrupt digest on the wire (lb.digest) ----
+        rejected_before = policy.stats['digest_rejected']
+        fault_injection.arm('lb.digest', 'fail:1')
+        try:
+            out = self._post(lb_url, _GROUP_B + [61, 62], attempts)
+        finally:
+            fault_injection.disarm_all()
+        assert out == reference[tuple(_GROUP_B + [61, 62])]
+        served += 1
+        assert policy.stats['digest_rejected'] == rejected_before + 1
+
+        # ---- phase 5: preemption drain of the replica holding GROUP_B
+        # (notice semantics: 503 + X-SkyTPU-Draining, learned in-band,
+        # excluded, traffic re-prefills elsewhere bit-identically) ----
+        # One clean request first: phase 3 staled and phase 4 rejected
+        # B's digest, so re-learn which replica holds it now.
+        out = self._post(lb_url, _GROUP_B + [61, 62], attempts)
+        assert out == reference[tuple(_GROUP_B + [61, 62])]
+        served += 1
+        hash_b = _chunk_hashes(_GROUP_B)[-1]
+        # The replica whose FRESH digest advertises B (stale wave-1
+        # digests may also mention it but cannot win a route).
+        holder = next(
+            u for u, d in policy._digests.items()  # pylint: disable=protected-access
+            if u in urls and hash_b in d['hashes'] and
+            clock['t'] - d['at'] < 30.0)
+        servers[urls.index(holder)].draining = True
+        before = attempts['n']
+        out = self._post(lb_url, _GROUP_B + [61, 62, 63], attempts)
+        assert out == reference[tuple(_GROUP_B + [61, 62, 63])]
+        served += 1
+        # The digest hit routed to the now-draining holder, whose 503
+        # was learned in-band; exactly one replay landed elsewhere.
+        assert holder in lb._draining_urls  # pylint: disable=protected-access
+        assert attempts['n'] - before == 2
+        # Storm-wide amplification bound: one extra attempt per
+        # distinct failure EVENT (dead digest, drain flip), not per
+        # request.
+        assert attempts['n'] <= served + 3, (attempts['n'], served)
+        autoscale_tick(
+            {i: {'queue_depth': 0.0} for i in range(3)},
+            [ReplicaStatus.READY, ReplicaStatus.DRAINING,
+             ReplicaStatus.READY])
+
+        # ---- the autoscaler's storm decisions replay exactly, and a
+        # DRAINING replica was never picked as a downscale victim ----
+        replayed = autoscalers.replay_decision_log(
+            spec, scaler.decision_log)
+        assert replayed == [entry['decisions']
+                            for entry in scaler.decision_log]
+        for entry in scaler.decision_log:
+            draining_ids = {rid for rid, status, _v, _s
+                            in entry['replicas']
+                            if status == 'DRAINING'}
+            for _op, target in entry['decisions']:
+                assert target not in draining_ids
+
+    def test_draining_replica_sheds_with_digest_headers_intact(
+            self, fleet):
+        """A draining replica's shed responses still carry fleet-intel
+        headers (the middleware is unconditional) — and the LB keeps
+        excluding it without charging its breaker."""
+        servers, urls, lb = fleet['servers'], fleet['urls'], fleet['lb']
+        draining_idx = next(
+            (i for i, s in enumerate(servers) if s.draining), None)
+        if draining_idx is None:
+            servers[1].draining = True
+            draining_idx = 1
+        resp = requests.post(urls[draining_idx] + '/generate',
+                             json={'prompt': 'x'}, timeout=30)
+        assert resp.status_code == 503
+        assert resp.headers.get('X-SkyTPU-Draining') == '1'
+        assert 'X-SkyTPU-Queue-Depth' in resp.headers
+        assert not lb.breaker.is_ejected(urls[draining_idx])
+
+
+# ---------------------------------------------------------------------
 # controller-RPC escalation: serve mirror + cross-process jobs CLI
 # ---------------------------------------------------------------------
 
