@@ -93,6 +93,17 @@ def _parse_args(argv=None):
                              'per-device weights+pool <= (1/N + eps), '
                              'collective count from the compiled-HLO '
                              'probe (parallel/hlo_probe)')
+    parser.add_argument('--dryrun-train-zero1', action='store_true',
+                        help='emit the MULTICHIP_train_zero1 proxy row '
+                             'on 8 fake CPU devices (no chip needed): '
+                             'ZeRO-1 weight-update sharding on a dp=8 '
+                             'mesh vs the unsharded trainer — '
+                             'bit-identical loss+grad_norm over 3 '
+                             'steps (with and without grad_accum), '
+                             'per-device optimizer-state bytes <= '
+                             '(1/dp + eps), and reduce-scatter + '
+                             'all-gather counts from the compiled-HLO '
+                             'probe (parallel/hlo_probe)')
     parser.add_argument('--dryrun-serve-fleet', action='store_true',
                         help='emit the FLEET_serve proxy row on CPU (no '
                              'chip needed): a 3-replica fleet of real '
@@ -691,6 +702,123 @@ def _dryrun_serve_fleet(args) -> int:
     return 0 if ok else 1
 
 
+def _dryrun_train_zero1(args) -> int:
+    """MULTICHIP_train_zero1: the ZeRO-1 weight-update-sharding proxy
+    row on 8 fake CPU devices (runs with the chip unreachable — the
+    BENCH_r03+ compile/transfer-count-pin pattern, extended to
+    optimizer-state sharding; arxiv 2004.13336).
+
+    Trains the tiny model 3 steps on a pure dp=8 mesh twice — once
+    plain, once with zero_sharding — for grad_accum 1 AND 2, with
+    clipping ACTIVE (the hard case: the clip scale is where sharded
+    reduction order would leak into the update), and pins:
+
+    - loss AND grad_norm bit-identical between the two trainers;
+    - per-device optimizer-state bytes <= (1/dp + eps) x unsharded;
+    - the compiled zero1 step scatters gradients (reduce-scatter, or
+      the CPU pipeline's unfused all-reduce + partition-slice form)
+      and all-gathers the updated params, while the plain step has
+      NO scatter and NO gather.
+
+    Emits ONE JSON row mirroring the MULTICHIP_r0x dryrun contract."""
+    del args
+    from __graft_entry__ import _force_cpu_devices
+    _force_cpu_devices(8)
+    import jax
+
+    dp = 8
+    n = len(jax.devices())
+    if n < dp:
+        # Deterministic verdict, not a flaky device: the structured
+        # skip (never the retry ladder), emitted BEFORE the training
+        # stack even imports.
+        _emit_skip(f'train-zero1 dryrun needs {dp} devices, have {n}',
+                   combo={'dp': dp, 'n_devices': n})
+        return 3
+    import dataclasses
+
+    from skypilot_tpu.models import get_config
+    from skypilot_tpu.parallel import train_mesh
+    from skypilot_tpu.train import (TrainConfig, create_sharded_state,
+                                    make_train_step, synthetic_batch)
+    from skypilot_tpu.train import metrics as metrics_lib
+    from skypilot_tpu.train.trainer import compiled_step_collectives
+
+    cfg = dataclasses.replace(
+        get_config('test-tiny'), dtype='float32', param_dtype='float32')
+    tc = TrainConfig(warmup_steps=1, total_steps=10,
+                     learning_rate=3e-2, grad_clip_norm=0.5)
+    mesh = train_mesh(dp)
+    rng = jax.random.PRNGKey(0)
+    batches = [synthetic_batch(jax.random.PRNGKey(i), 16, 64,
+                               cfg.vocab_size) for i in range(3)]
+
+    def run(zero, accum, probe=True):
+        state, sh = create_sharded_state(cfg, mesh, rng, tc,
+                                         zero_sharding=zero)
+        step = make_train_step(cfg, mesh, sh, grad_accum=accum)
+        # The probe is an honest second AOT compile — skip it for the
+        # runs whose stats nothing reads.
+        hlo = compiled_step_collectives(step, state, batches[0],
+                                        dp=dp) if probe else None
+        series = []
+        with mesh:
+            for b in batches:
+                state, m = step(state, b)
+                series.append((float(m['loss']),
+                               float(m['grad_norm'])))
+        return series, hlo, metrics_lib.opt_state_bytes(state)
+
+    base1, base_hlo, (base_bytes, base_per_dev) = run(False, 1)
+    zero1, zero_hlo, (_, zero_per_dev) = run(True, 1)
+    base2, _, _ = run(False, 2, probe=False)
+    zero2, zero_hlo2, _ = run(True, 2)
+
+    eps = 0.05
+    frac = zero_per_dev / max(1, base_bytes)
+    rs = zero_hlo['reduce_scatter_effective']
+    ok = bool(
+        base1 == zero1 and base2 == zero2
+        and frac <= 1.0 / dp + eps
+        and rs > 0 and zero_hlo['all_gather'] > 0
+        and zero_hlo2['reduce_scatter_effective'] > 0
+        and base_hlo['reduce_scatter_effective'] == 0
+        and base_hlo['all_gather'] == 0)
+    row = {
+        'metric': 'MULTICHIP_train_zero1 dryrun',
+        'value': float(dp),
+        'unit': 'dp',
+        'vs_baseline': 1.0,
+        'n_devices': n,
+        'dp': dp,
+        'ok': ok,
+        'skipped': False,
+        'steps': len(batches),
+        'loss_grad_norm_bit_identical': base1 == zero1,
+        'accum2_bit_identical': base2 == zero2,
+        'losses': [loss for loss, _ in zero1],
+        'opt_state_bytes': base_bytes,
+        'opt_state_bytes_per_device': zero_per_dev,
+        'unsharded_bytes_per_device': base_per_dev,
+        'per_device_frac': round(frac, 4),
+        'max_frac': round(1.0 / dp + eps, 4),
+        'reduce_scatter_effective': rs,
+        'reduce_scatter_native': zero_hlo['reduce_scatter'],
+        'partition_scatter': zero_hlo['partition_scatter'],
+        'all_gather': zero_hlo['all_gather'],
+        'all_reduce': zero_hlo['all_reduce'],
+        'accum2_reduce_scatter_effective':
+            zero_hlo2['reduce_scatter_effective'],
+        'accum2_all_gather': zero_hlo2['all_gather'],
+        'baseline_reduce_scatter_effective':
+            base_hlo['reduce_scatter_effective'],
+        'baseline_all_gather': base_hlo['all_gather'],
+        'baseline_all_reduce': base_hlo['all_reduce'],
+    }
+    print(json.dumps(row))
+    return 0 if ok else 1
+
+
 def _supervise_dryrun(argv) -> int:
     """Run a CPU-only dryrun (sharded serving / fleet routing) in a
     subprocess with the fake 8-CPU-device environment — NO TPU
@@ -841,6 +969,10 @@ def _worker(args) -> int:
         return _dryrun_serve_sharded(args)
     if args.dryrun_serve_fleet:
         return _dryrun_serve_fleet(args)
+    if args.dryrun_train_zero1:
+        # CPU-only by design; forces its own fake-device backend
+        # BEFORE any jax.devices() call.
+        return _dryrun_train_zero1(args)
 
     import jax
 
@@ -1008,7 +1140,8 @@ def main() -> int:
     if args.worker:
         return _worker(args)
     argv = [a for a in sys.argv[1:] if a != '--worker']
-    if args.dryrun_serve_sharded or args.dryrun_serve_fleet:
+    if (args.dryrun_serve_sharded or args.dryrun_serve_fleet or
+            args.dryrun_train_zero1):
         return _supervise_dryrun(argv)
     return _supervise(argv)
 

@@ -3,18 +3,20 @@ from skypilot_tpu.parallel.distributed import initialize
 from skypilot_tpu.parallel.distributed import topology_from_env
 from skypilot_tpu.parallel.mesh import (AXES, MeshConfig, build_mesh,
                                         decode_mesh, infer_mesh_config,
-                                        mesh_for_slice)
+                                        mesh_for_slice, train_mesh)
 from skypilot_tpu.parallel.pipeline import (bubble_fraction,
                                             pipeline_apply,
                                             pipeline_num_ticks)
 from skypilot_tpu.parallel.sharding import (constrain, logical_axis_rules,
                                             replicated, sharding_for,
-                                            spec_for, tree_shardings)
+                                            spec_for, tree_shardings,
+                                            zero_update_shardings)
 
 __all__ = [
     'AXES', 'MeshConfig', 'ProcessTopology', 'build_mesh',
     'bubble_fraction', 'constrain', 'decode_mesh', 'infer_mesh_config',
     'initialize', 'logical_axis_rules', 'mesh_for_slice',
     'pipeline_apply', 'pipeline_num_ticks', 'replicated', 'sharding_for',
-    'spec_for', 'topology_from_env', 'tree_shardings',
+    'spec_for', 'topology_from_env', 'train_mesh', 'tree_shardings',
+    'zero_update_shardings',
 ]

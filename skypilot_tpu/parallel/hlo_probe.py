@@ -16,7 +16,7 @@ device and adds nothing to engine import time.
 from __future__ import annotations
 
 import re
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 # Collective op mnemonics as they appear in optimized HLO. Order
 # matters for longest-match ('all-reduce-start' before 'all-reduce' is
@@ -35,15 +35,18 @@ _ITEMSIZE = {
 _SHAPE_RE = re.compile(r'\b([a-z]\w*)\[([0-9,]*)\]')
 
 
-def _shape_bytes(dtype: str, dims: str) -> int:
-    size = _ITEMSIZE.get(dtype)
-    if size is None:
+def _shape_elems(dtype: str, dims: str) -> int:
+    if dtype not in _ITEMSIZE:
         return 0  # token/opaque types carry no payload we can count
     n = 1
     for d in dims.split(','):
         if d:
             n *= int(d)
-    return n * size
+    return n
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    return _shape_elems(dtype, dims) * _ITEMSIZE.get(dtype, 0)
 
 
 def collective_stats(hlo_text: str) -> Dict[str, Any]:
@@ -96,3 +99,52 @@ def collective_stats(hlo_text: str) -> Dict[str, Any]:
     stats['total_bytes'] = sum(stats[op.replace('-', '_') + '_bytes']
                                for op in _COLLECTIVES)
     return stats
+
+
+def partition_scatter_count(hlo_text: str,
+                            shards: Optional[int] = None) -> int:
+    """Count partition-addressed scatter slices: ops whose result is an
+    exact 1/k fraction (k = `shards` when given, else any k >= 2) of one
+    of their operands AND whose offset comes from `partition-id` — each
+    device keeps only ITS shard of a cross-replica-reduced tensor.
+
+    This is the reduce-scatter as the CPU backend spells it. The SPMD
+    partitioner lowers "reduced tensor consumed at a sharded layout" to
+    all-reduce + dynamic-slice(partition-id); TPU/GPU pipelines then run
+    the ReduceScatterCreator rewrite that fuses the pair into a native
+    `reduce-scatter` op, but the CPU pipeline (the 8-fake-device proxy
+    environment) does not, so the dryrun pins count BOTH forms:
+    `collective_stats()['reduce_scatter']` for the fused op and this
+    pattern for the unfused one. The ZeRO-1 weight-update-sharding row
+    (`bench.py --dryrun-train-zero1`) is the consumer.
+
+    Text heuristic, deliberately narrow: a line counts when it has a
+    `%partition-id` operand and the largest same-line operand carries
+    exactly `k x` the result's elements — gather-style index plumbing
+    (embedding scatter-adds also consult partition-id under a dp-sharded
+    batch) never slices a tensor down by the shard count, so it does not
+    match."""
+    count = 0
+    for line in hlo_text.splitlines():
+        if '%partition-id' not in line or '=' not in line:
+            continue
+        _lhs, _, rhs = line.partition('=')
+        # `%name = f32[8,512]{1,0} fusion(f32[512,64] %op, u32[] %pid)`:
+        # the first shape after '=' is the RESULT, the rest operands.
+        shapes = _SHAPE_RE.findall(rhs)
+        if not shapes:
+            continue
+        result = _shape_elems(*shapes[0])
+        if result <= 0:
+            continue
+        operands = [_shape_elems(dt, dims) for dt, dims in shapes[1:]]
+        biggest = max(operands, default=0)
+        if biggest <= result or biggest % result:
+            continue
+        k = biggest // result
+        if shards is None:
+            if k >= 2:
+                count += 1
+        elif k == shards:
+            count += 1
+    return count

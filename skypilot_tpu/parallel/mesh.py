@@ -118,6 +118,24 @@ def decode_mesh(tp: int,
     return build_mesh(MeshConfig(tp=tp), list(devices)[:tp])
 
 
+def train_mesh(dp: int,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Pure data-parallel training mesh over the first `dp` local
+    devices — the decode_mesh counterpart for the dp axis. This is the
+    mesh the ZeRO-1 weight-update-sharding dryrun and tests pin against:
+    batch shards on dp, weights replicate, and the optimizer-state
+    shardings (parallel/sharding.zero_update_shardings) put the Adam
+    moments at 1/dp per device. dp=1 yields a valid single-device mesh
+    so callers can thread one mesh type through sharded and unsharded
+    training alike."""
+    if devices is None:
+        devices = jax.devices()
+    if dp < 1 or dp > len(devices):
+        raise ValueError(
+            f'train_mesh: dp={dp} needs 1..{len(devices)} local devices')
+    return build_mesh(MeshConfig(dp=dp), list(devices)[:dp])
+
+
 def mesh_for_slice(slice_topology: str, chips: int,
                    num_slices: int = 1,
                    **fixed_axes) -> MeshConfig:

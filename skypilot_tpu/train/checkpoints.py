@@ -7,6 +7,20 @@ restore-on-start, so a preempted managed job resumes from the last step.
 
 Works sharded: save/restore preserve each array's NamedSharding, so a
 resumed job on the same mesh shape restores without resharding traffic.
+
+ZeRO-1 weight-update sharding (train/trainer.py zero_sharding) rides
+this unchanged: the async save writes the dp-sharded fp32 Adam moments
+PER SHARD (Orbax serializes from each device's shard buffers — the
+global moment tree never gathers onto one host), and a restore
+deserializes straight into the template state's shardings. The template
+decides the layout, not the checkpoint: a run saved at dp=8 restores
+onto a dp=4 or dp=2 mesh (or back onto an unsharded one) by reading
+each device's byte ranges from disk — no reshard through host memory.
+Torn state never loads silently: Orbax/TensorStore validates byte
+ranges and manifest entries (a truncated or missing shard file raises),
+uncommitted async saves are invisible to latest_step(), and restore()
+below cross-checks the restored placement against the template
+(pinned by tests/zero1_driver.py).
 """
 from __future__ import annotations
 
@@ -47,13 +61,39 @@ class CheckpointManager:
 
     def restore(self, state: Any, step: Optional[int] = None) -> Any:
         """Restore into the sharding/structure of `state` (an abstract or
-        concrete template). Returns the restored pytree."""
+        concrete template). Returns the restored pytree.
+
+        The template's shardings are authoritative — this is what makes
+        checkpoints portable across dp extents under ZeRO-1 (save at
+        dp=8, restore onto a dp=4 template). The placement cross-check
+        below is a tripwire, not a reshard: if Orbax ever hands back a
+        leaf placed differently from the template (an API regression
+        would silently materialize the fp32 moments whole), restoring
+        fails loudly instead of OOMing later. Abstract templates whose
+        leaves carry no sharding (plain eval_shape structs) skip the
+        check — there is no requested placement to defend."""
+        import jax
         import orbax.checkpoint as ocp
         if step is None:
             step = self.latest_step()
         assert step is not None, 'no checkpoint to restore'
-        return self._manager.restore(step,
-                                     args=ocp.args.StandardRestore(state))
+        restored = self._manager.restore(
+            step, args=ocp.args.StandardRestore(state))
+        mismatched = [
+            f'got {got.sharding}, template wanted {want.sharding}'
+            for got, want in zip(jax.tree.leaves(restored),
+                                 jax.tree.leaves(state))
+            if getattr(want, 'sharding', None) is not None
+            and hasattr(got, 'sharding')
+            and got.sharding != want.sharding
+        ]
+        if mismatched:
+            raise ValueError(
+                f'checkpoint step {step}: {len(mismatched)} restored '
+                f'leaves are not placed per the template shardings '
+                f'(first: {mismatched[0]}) — refusing a layout the '
+                f'trainer did not ask for')
+        return restored
 
     def maybe_restore(self, state: Any) -> tuple:
         """(state, start_step): restores when a checkpoint exists, else

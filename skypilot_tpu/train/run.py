@@ -50,6 +50,25 @@ def main(argv=None) -> int:
                              'effective batch is --batch, activation '
                              'memory is --batch/N — global batches '
                              'beyond slice HBM')
+    parser.add_argument('--zero1', action='store_true',
+                        help='ZeRO-1 cross-replica weight-update '
+                             'sharding (arxiv 2004.13336): the fp32 '
+                             'Adam moments shard over the dp axis '
+                             '(born sharded, ~1/dp per device), '
+                             'gradients scatter into the shards and '
+                             'updated params all-gather back — same '
+                             'math, bit-identical losses, the '
+                             'optimizer-state HBM of a dp-replicated '
+                             'run divided by dp. Checkpoints stay '
+                             'restorable across dp extents')
+    parser.add_argument('--probe-hlo', action='store_true',
+                        help='AOT-compile the train step once more and '
+                             'publish its collective-op counts '
+                             '(skytpu_train_step_collectives) — the '
+                             'compile-time proxy for how gradients '
+                             'land (reduce-scatter vs all-reduce) and '
+                             'params return (all-gather). Costs one '
+                             'extra compile before the loop')
     parser.add_argument('--lora-rank', type=int, default=0,
                         help='LoRA fine-tune: adapter rank (0 = full '
                              'fine-tune). Only lora_a/lora_b train; '
@@ -115,6 +134,17 @@ def main(argv=None) -> int:
                                  pp=args.pp)
     mesh = build_mesh(mesh_cfg)
     logger.info('mesh: %s', mesh_cfg)
+    if args.zero1 and mesh_cfg.dp <= 1:
+        # Silent-no-op guard: the default mesh sends every spare device
+        # to fsdp, so without an explicit dp axis there is nothing to
+        # shard the optimizer state over — the moments would stay fully
+        # replicated while the flag suggests otherwise.
+        raise SystemExit(
+            f'--zero1 shards the optimizer state over the dp axis, but '
+            f'the mesh is {mesh_cfg} (dp=1): pass --dp N (e.g. --dp '
+            f'{jax.device_count()} for pure data parallelism) or drop '
+            f'--zero1. Note fsdp already shards weights AND moments '
+            f'ZeRO-3 style; --zero1 is the dp-axis lever.')
 
     # 3. Sharded state, restored if a checkpoint exists.
     cfg_overrides = {}
@@ -127,7 +157,15 @@ def main(argv=None) -> int:
                                total_steps=args.steps)
     state, shardings = create_sharded_state(cfg, mesh,
                                             jax.random.PRNGKey(0),
-                                            train_config)
+                                            train_config,
+                                            zero_sharding=args.zero1)
+    from skypilot_tpu.train import metrics as metrics_lib
+    opt_total, opt_per_dev = metrics_lib.publish_opt_state_bytes(state)
+    if args.zero1:
+        logger.info(
+            'zero1: optimizer state %.1f MB global, %.1f MB/device '
+            '(%.3fx)', opt_total / 2**20, opt_per_dev / 2**20,
+            opt_per_dev / max(1, opt_total))
     manager = None
     start_step = 0
     if args.checkpoint_dir:
@@ -303,6 +341,27 @@ def main(argv=None) -> int:
         logger.info('step %d val_loss=%.4f val_ppl=%.2f', step, val_loss,
                     math.exp(min(val_loss, 30.0)))
         return val_loss
+
+    if args.probe_hlo:
+        from skypilot_tpu.train.trainer import compiled_step_collectives
+        # Datasets advance on every next_batch: probe with the first
+        # batch, then hand that same batch back to the loop so no
+        # training data is skipped.
+        probed_batch = batch_for(start_step)
+        probe = compiled_step_collectives(
+            step_fn, state, probed_batch, dp=mesh_cfg.dp)
+        inner_batch_for = batch_for
+        replay = {'batch': probed_batch}
+
+        def batch_for(step):  # noqa: F811
+            held = replay.pop('batch', None)
+            return held if held is not None else inner_batch_for(step)
+        metrics_lib.publish_step_collectives(probe)
+        logger.info(
+            'compiled step collectives: all_reduce=%d all_gather=%d '
+            'reduce_scatter=%d (+%d unfused partition-scatter)',
+            probe['all_reduce'], probe['all_gather'],
+            probe['reduce_scatter'], probe['partition_scatter'])
 
     loss = float('nan')
     # Profile a small steady-state slice: step 2 (past compile+warmup)

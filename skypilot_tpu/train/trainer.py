@@ -96,6 +96,7 @@ def create_sharded_state(
     mesh: Mesh,
     rng: jax.Array,
     train_config: Optional[TrainConfig] = None,
+    zero_sharding: bool = False,
 ) -> Tuple[TrainState, Any]:
     """Initialize a TrainState with every array born sharded on `mesh`.
 
@@ -103,6 +104,20 @@ def create_sharded_state(
     inherit its logical metadata) are placed per the logical axis rules —
     nothing ever materializes replicated on one host.
     Returns (state, state_shardings).
+
+    `zero_sharding` turns on ZeRO-1-style cross-replica weight-update
+    sharding (arxiv 2004.13336): the optimizer-state shardings are
+    additionally split over the `dp` mesh axis
+    (parallel/sharding.zero_update_shardings), so the fp32 Adam moments
+    are BORN at 1/dp per device — the jit init below materializes them
+    straight into their shards, never whole on one device. The returned
+    state_shardings carry the augmentation; pass them to
+    make_train_step/make_eval_step and to checkpoint restores unchanged
+    and the whole pipeline (step in/out shardings, Orbax per-shard
+    save/restore) follows. The step MATH is untouched — the sharding of
+    the update is carried entirely by these annotations (the paper's
+    "automatic" thesis), which is what keeps sharded and unsharded
+    training bit-identical (pinned by tests/zero1_driver.py).
     """
     tc = train_config or TrainConfig()
     model = Transformer(cfg)
@@ -119,6 +134,11 @@ def create_sharded_state(
     # (tree_shardings) and is shared with the inference engines — no
     # train-local copy of the rule application.
     state_shardings = sharding_lib.tree_shardings(mesh, abstract_state)
+    if zero_sharding:
+        state_shardings = state_shardings.replace(
+            opt_state=sharding_lib.zero_update_shardings(
+                mesh, nn.unbox(abstract_state).opt_state,
+                nn.unbox(state_shardings).opt_state))
     with mesh:
         state = jax.jit(init_fn, out_shardings=state_shardings)(rng)
     state = nn.unbox(state)
@@ -158,6 +178,23 @@ def make_train_step(
     in `pipeline.circular_execution_order` — fine from scratch; to
     continue a sequentially-trained checkpoint, reorder its stack with
     `pipeline.reorder_stack_for_circular` first.
+
+    ZeRO-1 weight-update sharding needs NO flag here: it is carried
+    entirely by `state_shardings` (create_sharded_state(zero_sharding=
+    True) augments the optimizer-state entries with the dp axis). The
+    step body is IDENTICAL either way — the gradients are pinned to the
+    PARAMS' shardings (a no-op placement-wise: that is where a gradient
+    already lands), which fixes the clip/global-norm reduction order to
+    whole-leaf reductions in both modes, and the dp-sharded moments then
+    make XLA scatter the update (reduce-scatter on backends whose
+    pipeline fuses it; all-reduce + partition-slice on the CPU proxy)
+    and all-gather the updated params back per the out-shardings. One
+    code path, bit-identical losses, sharded memory — the accumulate-
+    then-update math cannot fork because there is nothing to fork.
+    With grad_accum the fp32 gradient carry stays at the params'
+    placement through the scan, so the update scatter and the param
+    all-gather are issued ONCE per accumulation step, not per
+    microbatch.
     """
     model = Transformer(cfg)
     num_stages = mesh.shape.get('pp', 1) if hasattr(mesh, 'shape') else 1
@@ -193,6 +230,8 @@ def make_train_step(
             logits = model.apply({'params': params}, batch['inputs'])
         return cross_entropy_loss(logits, batch['targets'],
                                   batch.get('mask'))
+
+    unboxed_shardings = nn.unbox(state_shardings)
 
     def step(state: TrainState, batch):
         if grad_accum <= 1:
@@ -277,6 +316,18 @@ def make_train_step(
                                     if _is_trained(path)
                                     else jnp.zeros(p.shape, p.dtype)),
                 grads, state.params)
+        # Pin the gradients to the PARAMS' placement (dp-replicated
+        # under pure data parallelism, fsdp/tp-sharded where the params
+        # are). Placement-wise a no-op — this is where a gradient lands
+        # anyway — but it anchors the clip/global-norm reductions to
+        # whole-leaf order in BOTH the plain and the ZeRO-1 trainer:
+        # without it, dp-sharded moments pull the gradients (and the
+        # norm's sum-of-squares) into per-shard order and the clip
+        # scale drifts in the low bits vs the unsharded run. The
+        # update's dp scatter then happens AFTER the norm, where it is
+        # order-free (elementwise).
+        grads = jax.lax.with_sharding_constraint(
+            grads, unboxed_shardings.params)
         new_state = state.apply_gradients(grads=grads)
         metrics = {
             'loss': loss,
@@ -285,7 +336,6 @@ def make_train_step(
         }
         return new_state, metrics
 
-    unboxed_shardings = nn.unbox(state_shardings)
     replicated = sharding_lib.replicated(mesh)
     return jax.jit(
         step,
@@ -295,6 +345,32 @@ def make_train_step(
                         'step': replicated}),
         donate_argnums=(0,),
     )
+
+
+def compiled_step_collectives(step_fn, state, batch,
+                              dp: Optional[int] = None
+                              ) -> Dict[str, Any]:
+    """Collective-op stats of the COMPILED train step — the training
+    counterpart of the engines' decode_hlo_stats (the BENCH_r03+
+    compile-time proxy while the chip is unreachable).
+
+    Lowers and compiles `step_fn` AOT (an honest second compile:
+    `.lower().compile()` does NOT reuse the jit dispatch cache — spend
+    it in bench/dryrun rows or behind train.run's --probe-hlo, off the
+    step loop) and parses the optimized HLO with parallel/hlo_probe.
+    Adds `partition_scatter` — the CPU backend's unfused spelling of
+    reduce-scatter (all-reduce + partition-id slice; see
+    hlo_probe.partition_scatter_count) — and `reduce_scatter_effective`
+    = native + unfused, the number the ZeRO-1 pins read on any backend.
+    """
+    from skypilot_tpu.parallel import hlo_probe
+    text = step_fn.lower(state, batch).compile().as_text()
+    stats = hlo_probe.collective_stats(text)
+    stats['partition_scatter'] = hlo_probe.partition_scatter_count(
+        text, shards=dp)
+    stats['reduce_scatter_effective'] = (stats['reduce_scatter'] +
+                                         stats['partition_scatter'])
+    return stats
 
 
 def make_eval_step(

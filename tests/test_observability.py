@@ -566,7 +566,8 @@ class TestPrefixCacheChurn:
             engine.generate(p_a, max_new_tokens=2)   # miss; cache [A]
             engine.generate(p_b, max_new_tokens=2)   # miss; cache [A, B]
             assert engine.prefix_stats == {
-                'hits': 0, 'misses': 2, 'tokens_reused': 0}
+                'hits': 0, 'misses': 2, 'tokens_reused': 0,
+                'prewarm_hits': 0}
             # Exact repeat of A: hit (reuses all but the last token)
             # AND refreshes A's recency → order [B, A].
             engine.generate(p_a, max_new_tokens=2)
@@ -607,7 +608,8 @@ class TestPrefixCacheChurn:
             keys = list(engine._prefix_entries)  # pylint: disable=protected-access
             assert keys == [tuple(prompts[2]), tuple(prompts[3])]
             assert engine.prefix_stats == {
-                'hits': 0, 'misses': 4, 'tokens_reused': 0}
+                'hits': 0, 'misses': 4, 'tokens_reused': 0,
+                'prewarm_hits': 0}
         finally:
             engine.stop()
 

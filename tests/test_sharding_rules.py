@@ -53,6 +53,47 @@ class TestNoDuplicateRuleTables:
             'sharding.spec_for / tree_shardings):\n' +
             '\n'.join(offenders))
 
+    def test_no_hardcoded_collective_axis_outside_parallel(self):
+        """The PartitionSpec lint's collective-call sibling (the ISSUE-10
+        CI satellite): any `jax.lax.psum` / `psum_scatter` (the jax
+        spelling of reduce-scatter) / `all_gather` / `ppermute` call
+        whose ARGUMENTS carry a quoted axis-name string outside
+        parallel/ is a hardcoded physical-axis dependency waiting to
+        drift from the rule table — collective axis names must arrive
+        through a parameter or a parallel/ helper (the ring-attention
+        pattern: `axis_name` threaded in, spec_for for layouts)."""
+        call_re = re.compile(
+            r'\blax\.(?:psum|psum_scatter|all_gather|reduce_scatter|'
+            r'ppermute)\s*\(')
+        offenders = []
+        for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
+            rel = os.path.relpath(dirpath, PKG_ROOT)
+            if rel.split(os.sep)[0] == 'parallel':
+                continue
+            for fname in filenames:
+                if not fname.endswith('.py'):
+                    continue
+                path = os.path.join(dirpath, fname)
+                with open(path, encoding='utf-8') as f:
+                    text = f.read()
+                for m in call_re.finditer(text):
+                    depth, i = 1, m.end()
+                    while i < len(text) and depth:
+                        depth += {'(': 1, ')': -1}.get(text[i], 0)
+                        i += 1
+                    args = text[m.end():i - 1]
+                    # Strip comments: an apostrophe in a trailing
+                    # remark must not read as a hardcoded axis string.
+                    args = re.sub(r'#[^\n]*', '', args)
+                    if re.search(r'[\'\"]', args):
+                        offenders.append(
+                            f'{os.path.relpath(path, PKG_ROOT)}: '
+                            f'{text[m.start():i][:80]}')
+        assert not offenders, (
+            'collective calls with hardcoded axis-name strings outside '
+            'parallel/ (thread the axis in, or add a parallel/ '
+            'helper):\n' + '\n'.join(offenders))
+
     def test_no_logical_rule_table_outside_parallel(self):
         """Exactly one logical-axis rule table exists, and it lives in
         parallel/sharding.py."""

@@ -1,0 +1,234 @@
+"""ZeRO-1 cross-replica weight-update sharding — driver.
+
+Run by tests/test_zero1.py through the sharded_subprocess fixture
+(8 fake CPU devices), so the SPMD compiles never touch the main pytest
+process's jit caches.
+
+Scenario (ISSUE-10 tentpole, arxiv 2004.13336):
+
+1. PARITY — toggling `zero_sharding` on a dp=8 mesh yields bit-identical
+   loss AND grad_norm for 3 steps, with grad_accum 1 and 2, with
+   clipping ACTIVE (grad_clip_norm below the observed norms — the hard
+   case: the clip scale is where sharded reduction order would leak
+   into the update). The accumulate-then-update path must not fork.
+2. BORN SHARDED — every optimizer-state leaf of the zero1 state carries
+   exactly the sharding `zero_update_shardings` assigns (jit init with
+   out-shardings: the fp32 moments never materialize whole on one
+   device — the sharded_restore_driver assertion style), and per-device
+   optimizer-state bytes <= (1/dp + eps) x the unsharded trainer's.
+3. HLO — the compiled zero1 step scatters gradients
+   (reduce_scatter_effective > 0: native reduce-scatter, or the CPU
+   pipeline's unfused all-reduce + partition-slice) and all-gathers the
+   updated params; the plain step has neither.
+4. CHECKPOINT — a zero1 state saved at dp=4 restores (a) onto a dp=4
+   template with zero respecialization (values AND placements equal)
+   and (b) onto a dp=2 template (resharded restore through per-shard
+   reads, values equal, per-device frac ~1/2); a TRUNCATED shard file
+   and a DELETED shard file both raise instead of silently loading a
+   torn state.
+5. GAUGES — publish_opt_state_bytes / publish_step_collectives land in
+   the registry with recording enabled late (the PR-5 late-exporter
+   lesson).
+
+Emits ONE JSON row; the pytest side asserts on it.
+"""
+import dataclasses
+import glob
+import json
+import os
+import sys
+import tempfile
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from skypilot_tpu.models import get_config
+    from skypilot_tpu.observability import metrics as obs
+    from skypilot_tpu.parallel import train_mesh, zero_update_shardings
+    from skypilot_tpu.train import (TrainConfig, create_sharded_state,
+                                    make_train_step, synthetic_batch)
+    from skypilot_tpu.train import metrics as metrics_lib
+    from skypilot_tpu.train.checkpoints import CheckpointManager
+    from skypilot_tpu.train.trainer import compiled_step_collectives
+
+    cfg = dataclasses.replace(get_config('test-tiny'), dtype='float32',
+                              param_dtype='float32')
+    tc = TrainConfig(warmup_steps=1, total_steps=10, learning_rate=3e-2,
+                     grad_clip_norm=0.5)
+    rng = jax.random.PRNGKey(0)
+    dp = 8
+    mesh = train_mesh(dp)
+    batches = [synthetic_batch(jax.random.PRNGKey(i), 16, 64,
+                               cfg.vocab_size) for i in range(3)]
+
+    def run(zero, accum, probe):
+        state, sh = create_sharded_state(cfg, mesh, rng, tc,
+                                         zero_sharding=zero)
+        step = make_train_step(cfg, mesh, sh, grad_accum=accum)
+        hlo = compiled_step_collectives(step, state, batches[0],
+                                        dp=dp) if probe else None
+        series = []
+        with mesh:
+            for b in batches:
+                state, m = step(state, b)
+                series.append((float(m['loss']),
+                               float(m['grad_norm'])))
+        return state, sh, series, hlo
+
+    # --- 1+2+3: parity, born-sharded, HLO -----------------------------
+    base_state, _, base1, base_hlo = run(False, 1, True)
+    zero_state, zero_sh, zero1, zero_hlo = run(True, 1, True)
+    _, _, base2, _ = run(False, 2, False)
+    _, _, zero2, zero_hlo2 = run(True, 2, True)
+
+    clip_active = all(norm > tc.grad_clip_norm for _, norm in base1)
+
+    abstract = jax.eval_shape(lambda: zero_state)
+    want_opt = zero_update_shardings(
+        mesh, abstract.opt_state,
+        jax.tree.map(lambda l: l.sharding, base_state.opt_state))
+    spec_mismatches = 0
+    sharded_leaves = 0
+    for got, want in zip(jax.tree.leaves(zero_state.opt_state),
+                         jax.tree.leaves(want_opt)):
+        if got.sharding.spec != want.spec:
+            spec_mismatches += 1
+        if any('dp' in ((e,) if isinstance(e, str) else tuple(e or ()))
+               for e in got.sharding.spec):
+            sharded_leaves += 1
+
+    base_bytes, base_per_dev = metrics_lib.opt_state_bytes(base_state)
+    _, zero_per_dev = metrics_lib.opt_state_bytes(zero_state)
+    frac = zero_per_dev / max(1, base_bytes)
+
+    # --- 5: late-exporter gauges --------------------------------------
+    obs.enable()
+    metrics_lib.publish_opt_state_bytes(zero_state)
+    metrics_lib.publish_step_collectives(zero_hlo)
+    from skypilot_tpu.observability.exposition import (
+        generate_latest, parse_prometheus_text)
+    families = parse_prometheus_text(generate_latest())
+    per_dev_gauge = families[
+        'skytpu_train_opt_state_bytes_per_device']['samples'][
+            ('skytpu_train_opt_state_bytes_per_device', ())]
+    coll = families['skytpu_train_step_collectives']['samples']
+    rs_gauge = coll.get(('skytpu_train_step_collectives',
+                         (('op', 'reduce_scatter_effective'),)))
+    gauges_ok = (per_dev_gauge == float(zero_per_dev) and
+                 rs_gauge == float(
+                     zero_hlo['reduce_scatter_effective']))
+
+    # --- 4: checkpoint round-trip across dp extents -------------------
+    ck = tempfile.mkdtemp(prefix='skytpu-zero1-')
+
+    def make(dp_n):
+        m4 = train_mesh(dp_n)
+        st, sh4 = create_sharded_state(cfg, m4, rng, tc,
+                                       zero_sharding=True)
+        return m4, st, sh4
+
+    mesh4, state4, sh4 = make(4)
+    step4 = make_train_step(cfg, mesh4, sh4)
+    with mesh4:
+        state4, _m = step4(state4, batches[0])
+    manager = CheckpointManager(ck, save_interval_steps=1)
+    manager.save(1, state4, force=True)
+    manager.save(2, state4, force=True)
+    manager.wait()
+
+    def tree_equal(a, b):
+        return bool(jax.tree.all(jax.tree.map(
+            lambda x, y: bool(np.array_equal(np.asarray(x),
+                                             np.asarray(y))), a, b)))
+
+    # (a) dp=4 -> dp=4: zero respecialization.
+    _, tmpl4, _ = make(4)
+    restored4 = manager.restore(tmpl4, step=2)
+    same_vals4 = tree_equal(restored4.opt_state, state4.opt_state) and \
+        tree_equal(restored4.params, state4.params)
+    same_specs4 = all(
+        got.sharding == want.sharding
+        for got, want in zip(jax.tree.leaves(restored4),
+                             jax.tree.leaves(tmpl4)))
+
+    # (b) dp=4 -> dp=2: resharded restore, values intact, frac ~1/2.
+    _, tmpl2, _ = make(2)
+    restored2 = manager.restore(tmpl2, step=2)
+    same_vals2 = tree_equal(restored2.opt_state, state4.opt_state) and \
+        tree_equal(restored2.params, state4.params)
+    _, per2 = metrics_lib.opt_state_bytes(restored2)
+    frac2 = per2 / max(1, base_bytes)
+
+    # (c) torn state never loads: truncate step 2, delete from step 1.
+    def blobs(step):
+        return sorted(
+            (p for p in glob.glob(os.path.join(ck, str(step), '**'),
+                                  recursive=True)
+             if os.path.isfile(p) and os.sep + 'd' + os.sep in p),
+            key=os.path.getsize)
+
+    victim = blobs(2)[-1]
+    with open(victim, 'r+b') as f:
+        f.truncate(os.path.getsize(victim) // 2)
+    corrupt_raises = False
+    corrupt_error = ''
+    try:
+        CheckpointManager(ck).restore(make(4)[1], step=2)
+    except Exception as e:  # pylint: disable=broad-except
+        corrupt_raises = True
+        corrupt_error = type(e).__name__
+    os.remove(blobs(1)[-1])
+    partial_raises = False
+    try:
+        CheckpointManager(ck).restore(make(4)[1], step=1)
+    except Exception:  # pylint: disable=broad-except
+        partial_raises = True
+
+    row = {
+        'dp': dp,
+        'clip_active': clip_active,
+        'parity_accum1': base1 == zero1,
+        'parity_accum2': base2 == zero2,
+        'series': zero1,
+        'spec_mismatches': spec_mismatches,
+        'sharded_opt_leaves': sharded_leaves,
+        'opt_state_bytes': base_bytes,
+        'opt_state_bytes_per_device': zero_per_dev,
+        'unsharded_bytes_per_device': base_per_dev,
+        'per_device_frac': round(frac, 4),
+        'max_frac': round(1.0 / dp + 0.05, 4),
+        'zero_hlo': {k: v for k, v in zero_hlo.items()
+                     if not k.endswith('bytes')},
+        'zero_hlo_accum2': {k: v for k, v in zero_hlo2.items()
+                            if not k.endswith('bytes')},
+        'base_hlo': {k: v for k, v in base_hlo.items()
+                     if not k.endswith('bytes')},
+        'gauges_ok': gauges_ok,
+        'ckpt_same_dp_values': same_vals4,
+        'ckpt_same_dp_specs': same_specs4,
+        'ckpt_cross_dp_values': same_vals2,
+        'ckpt_cross_dp_frac': round(frac2, 4),
+        'corrupt_raises': corrupt_raises,
+        'corrupt_error': corrupt_error,
+        'partial_raises': partial_raises,
+    }
+    row['ok'] = bool(
+        clip_active and row['parity_accum1'] and row['parity_accum2']
+        and spec_mismatches == 0 and sharded_leaves > 0
+        and frac <= 1.0 / dp + 0.05
+        and zero_hlo['reduce_scatter_effective'] > 0
+        and zero_hlo['all_gather'] > 0
+        and zero_hlo2['reduce_scatter_effective'] > 0
+        and base_hlo['reduce_scatter_effective'] == 0
+        and base_hlo['all_gather'] == 0
+        and gauges_ok and same_vals4 and same_specs4 and same_vals2
+        and frac2 <= 1.0 / 2 + 0.05
+        and corrupt_raises and partial_raises)
+    print(json.dumps(row))
+    return 0 if row['ok'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())
