@@ -104,6 +104,17 @@ def _parse_args(argv=None):
                              '(1/dp + eps), and reduce-scatter + '
                              'all-gather counts from the compiled-HLO '
                              'probe (parallel/hlo_probe)')
+    parser.add_argument('--dryrun-train-elastic', action='store_true',
+                        help='emit the MULTICHIP_train_elastic proxy '
+                             'row on 8 fake CPU devices (no chip '
+                             'needed): a 2-notice preemption storm '
+                             'over the elastic train loop — dp=4 → '
+                             'surviving dp=2 → grown-back dp=4 — '
+                             'reporting steps-lost-per-preemption '
+                             '(pinned 0 beyond the in-flight step), '
+                             'per-incarnation resume latency, and '
+                             'loss bit-parity vs an unpreempted run '
+                             'over the same data order')
     parser.add_argument('--dryrun-serve-fleet', action='store_true',
                         help='emit the FLEET_serve proxy row on CPU (no '
                              'chip needed): a 3-replica fleet of real '
@@ -819,6 +830,131 @@ def _dryrun_train_zero1(args) -> int:
     return 0 if ok else 1
 
 
+def _dryrun_train_elastic(args) -> int:
+    """MULTICHIP_train_elastic: the preemption-native elastic-training
+    proxy row on 8 fake CPU devices (runs with the chip unreachable —
+    the BENCH_r03+ pin pattern applied to live dp resharding; ROADMAP
+    open item 4, arxiv 2004.13336 + 2011.03641).
+
+    Trains the tiny model 6 steps at a canonical extent of dp=4 twice —
+    once unpreempted, once through a 2-notice storm (notice at dp=4 →
+    relaunch at the surviving dp=2 → notice → grow back to dp=4) using
+    the PR-9 reshard restore between incarnations — and pins:
+
+    - ZERO completed steps re-trained per preemption (only the
+      in-flight step is at risk, by construction);
+    - the merged storm loss series bit-identical to the unpreempted
+      run over the same data order (the extent-invariant elastic step);
+    - resume latency per incarnation (mesh + init + reshard restore),
+      the number a real spot fleet pays per relaunch.
+
+    Emits ONE JSON row mirroring the MULTICHIP_r0x dryrun contract."""
+    del args
+    from __graft_entry__ import _force_cpu_devices
+    _force_cpu_devices(8)
+    import jax
+
+    need = 8
+    n = len(jax.devices())
+    if n < need:
+        # Deterministic verdict, not a flaky device: the structured
+        # skip (never the retry ladder), emitted BEFORE the training
+        # stack even imports.
+        _emit_skip(f'train-elastic dryrun needs {need} devices, '
+                   f'have {n}', combo={'canonical_dp': 4,
+                                       'n_devices': n})
+        return 3
+    import dataclasses
+    import tempfile
+
+    from skypilot_tpu.models import get_config
+    from skypilot_tpu.train import TrainConfig, synthetic_batch
+    from skypilot_tpu.train.elastic import (ElasticTrainLoop,
+                                            PreemptionNotice,
+                                            surviving_extent)
+
+    cfg = dataclasses.replace(
+        get_config('test-tiny'), dtype='float32', param_dtype='float32')
+    tc = TrainConfig(warmup_steps=1, total_steps=6,
+                     learning_rate=3e-2, grad_clip_norm=0.5)
+    total_steps = 6
+    batches = [synthetic_batch(jax.random.PRNGKey(i), 16, 64,
+                               cfg.vocab_size)
+               for i in range(total_steps)]
+
+    base_loop = ElasticTrainLoop(cfg, tc,
+                                 tempfile.mkdtemp(prefix='skytpu-ela-b-'),
+                                 canonical_dp=4)
+    base = base_loop.run(4, lambda s: batches[s], total_steps)
+
+    storm_loop = ElasticTrainLoop(cfg, tc,
+                                  tempfile.mkdtemp(prefix='skytpu-ela-s-'),
+                                  canonical_dp=4)
+    notice = PreemptionNotice()
+    dp2 = surviving_extent(4, 2)
+
+    def trigger(step):
+        def f(s):
+            if s == step:
+                notice.deliver()
+            return batches[s]
+        return f
+
+    series = {}
+    incs = []
+    prev_next = 0
+    steps_lost = []
+    plan = [(4, trigger(1)), (dp2, trigger(3)), (4, lambda s: batches[s])]
+    for dp, bf in plan:
+        notice.clear()
+        r = storm_loop.run(dp, bf, total_steps, notice=notice)
+        start = r.next_step - len(r.series)
+        steps_lost.append(max(0, prev_next - start))
+        for i, v in enumerate(r.series):
+            series[start + i] = v
+        prev_next = r.next_step
+        incs.append({'dp': r.dp, 'start': start, 'next': r.next_step,
+                     'preempted': r.preempted,
+                     'committed': r.checkpoint_committed,
+                     'resume_latency_s': round(r.resume_latency_s, 3)})
+
+    parity = [series.get(s) == base.series[s]
+              for s in range(total_steps)]
+    lost_per_preemption = (sum(steps_lost[1:]) /
+                           max(1, len(steps_lost) - 1))
+    resume_latencies = [inc['resume_latency_s'] for inc in incs]
+    ok = bool(
+        all(parity)
+        and all(l == 0 for l in steps_lost)
+        and [inc['dp'] for inc in incs] == [4, dp2, 4]
+        and all(inc['committed'] for inc in incs)
+        and incs[0]['preempted'] and incs[1]['preempted']
+        and not incs[2]['preempted'])
+    row = {
+        'metric': 'MULTICHIP_train_elastic dryrun',
+        'value': lost_per_preemption,
+        'unit': 'steps_lost/preemption',
+        'vs_baseline': 1.0,
+        'n_devices': n,
+        'canonical_dp': 4,
+        'surviving_dp': dp2,
+        'ok': ok,
+        'skipped': False,
+        'steps': total_steps,
+        'preemptions': 2,
+        'steps_lost': steps_lost,
+        'loss_bit_identical': all(parity),
+        'losses': [loss for loss, _ in
+                   (series[s] for s in sorted(series))],
+        'incarnations': incs,
+        'resume_latency_s': resume_latencies,
+        'resume_latency_mean_s': round(
+            sum(resume_latencies) / len(resume_latencies), 3),
+    }
+    print(json.dumps(row))
+    return 0 if ok else 1
+
+
 def _supervise_dryrun(argv) -> int:
     """Run a CPU-only dryrun (sharded serving / fleet routing) in a
     subprocess with the fake 8-CPU-device environment — NO TPU
@@ -973,6 +1109,8 @@ def _worker(args) -> int:
         # CPU-only by design; forces its own fake-device backend
         # BEFORE any jax.devices() call.
         return _dryrun_train_zero1(args)
+    if args.dryrun_train_elastic:
+        return _dryrun_train_elastic(args)
 
     import jax
 
@@ -1141,7 +1279,7 @@ def main() -> int:
         return _worker(args)
     argv = [a for a in sys.argv[1:] if a != '--worker']
     if (args.dryrun_serve_sharded or args.dryrun_serve_fleet or
-            args.dryrun_train_zero1):
+            args.dryrun_train_zero1 or args.dryrun_train_elastic):
         return _supervise_dryrun(argv)
     return _supervise(argv)
 

@@ -450,6 +450,12 @@ def main() -> int:
         if all(rc == 0 for rc in rcs):
             job_lib.set_status(job_id, job_lib.JobStatus.SUCCEEDED)
             return 0
+        if any(rc == 75 for rc in rcs):
+            # EX_TEMPFAIL: the task checkpointed on a preemption notice
+            # and asks to be relaunched (train.run --elastic) — recovery
+            # semantics, not a user-code failure.
+            job_lib.set_status(job_id, job_lib.JobStatus.PREEMPTED)
+            return 1
         job_lib.set_status(job_id, job_lib.JobStatus.FAILED)
         return 1
     except Exception:  # pylint: disable=broad-except

@@ -41,6 +41,12 @@ class JobStatus(enum.Enum):
     FAILED = 'FAILED'
     FAILED_SETUP = 'FAILED_SETUP'
     CANCELLED = 'CANCELLED'
+    # The task exited 75 (EX_TEMPFAIL): it checkpointed on a preemption
+    # notice and ASKS to be relaunched (train.run --elastic). Distinct
+    # from FAILED so the managed-jobs controller recovers it instead of
+    # burning the user-failure restart budget — even when the slice
+    # outlives the notice window (aborted preemption, manual SIGTERM).
+    PREEMPTED = 'PREEMPTED'
 
     def is_terminal(self) -> bool:
         return self in _TERMINAL
@@ -51,7 +57,7 @@ class JobStatus(enum.Enum):
 
 
 _TERMINAL = {JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.FAILED_SETUP,
-             JobStatus.CANCELLED}
+             JobStatus.CANCELLED, JobStatus.PREEMPTED}
 
 
 def _create_table(cursor: sqlite3.Cursor, conn: sqlite3.Connection) -> None:

@@ -57,6 +57,13 @@ MAX_LAUNCH_RETRIES = int(os.environ.get('SKYTPU_JOBS_MAX_LAUNCH_RETRIES',
                                         '3'))
 
 
+# How often a DEGRADED elastic job (running below its target extent
+# after a spot storm) attempts to grow back to the target
+# (recovery_strategy.ElasticStrategyExecutor.try_grow).
+def elastic_grow_gap_seconds() -> float:
+    return _env_float('SKYTPU_JOBS_ELASTIC_GROW_GAP_SECONDS', 300.0)
+
+
 # Cap on concurrently-running LOCAL controller processes; jobs beyond it
 # queue and start as slots free up (reference sizing: ~4 controller
 # processes per vCPU on the controller VM, sky/jobs/constants.py:16).

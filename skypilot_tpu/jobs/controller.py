@@ -43,7 +43,8 @@ logger = logging.getLogger(__name__)
 
 # On-cluster job statuses that are terminal (agent/job_lib FSM values come
 # back over the codegen RPC as plain strings).
-_JOB_TERMINAL = {'SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'CANCELLED'}
+_JOB_TERMINAL = {'SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'CANCELLED',
+                 'PREEMPTED'}
 
 
 class JobsController:
@@ -112,7 +113,8 @@ class JobsController:
             max_restarts = max(max_restarts,
                                int(args.get('max_restarts_on_errors', 0)))
         self.strategy = recovery_strategy.StrategyExecutor.make(
-            cluster_name, task, max_restarts_on_errors=max_restarts)
+            cluster_name, task, max_restarts_on_errors=max_restarts,
+            job_id=job_id, task_id=task_id)
 
         import datetime
         jobs_state.set_submitted(
@@ -134,6 +136,9 @@ class JobsController:
         jobs_state.set_started(job_id, task_id, cluster_name)
 
         gap = constants.job_status_check_gap_seconds()
+        grow_gap = constants.elastic_grow_gap_seconds()
+        grow_backoff = 1          # doubles per failed grow, capped 8x
+        last_grow_check = time.time()
         while True:
             if self._cancelled():
                 jobs_state.set_cancelling(job_id)
@@ -154,6 +159,25 @@ class JobsController:
             # reason, controller.py:188-325).
             if not self._cluster_is_up(cluster_name):
                 self._recover(task_id)
+                # A recovery may have landed DEGRADED this very second:
+                # restart the grow clock so the first grow-back attempt
+                # waits a full gap instead of immediately tearing down
+                # the seconds-old cluster to re-probe capacity that
+                # just proved unavailable. grow_backoff is NOT reset —
+                # only a successful grow earns back the base gap (a
+                # grow attempt that died mid-flight routes through here
+                # and must not erase its own backoff).
+                last_grow_check = time.time()
+                continue
+
+            if status == 'PREEMPTED':
+                # The task exited 75: it checkpointed on a preemption
+                # notice and ASKS to be relaunched (train.run
+                # --elastic). Recovery semantics even though the slice
+                # is still up (aborted preemption, manual SIGTERM) —
+                # never the user-failure restart budget.
+                self._recover(task_id)
+                last_grow_check = time.time()
                 continue
 
             if status in ('FAILED', 'FAILED_SETUP'):
@@ -170,6 +194,7 @@ class JobsController:
                     self._best_effort_teardown()
                     return False
                 self._recover(task_id)
+                last_grow_check = time.time()
                 continue
 
             if status == 'CANCELLED':
@@ -180,6 +205,55 @@ class JobsController:
                 return False
             # None (transient RPC failure on a healthy cluster) or
             # PENDING/SETTING_UP/RUNNING: keep polling.
+
+            # Elastic grow-back: a job running DEGRADED after a spot
+            # storm (relaunched at the surviving extent) periodically
+            # attempts the target extent again. Growing is a
+            # checkpointed restart — the run resumes from its latest
+            # checkpoint at the bigger extent — so it reuses the
+            # recovery bookkeeping minus the recovery_count bump. A
+            # failed grow restarts the job at the extent it already had
+            # (paying resume latency for nothing), so each failure
+            # doubles the gap before the next attempt (capped 8x,
+            # reset on success) — a multi-hour capacity crunch must
+            # not turn into a restart-every-gap churn loop.
+            if (isinstance(self.strategy,
+                           recovery_strategy.ElasticStrategyExecutor)
+                    and self.strategy.degraded()
+                    # Only a RUNNING job grows: a still-provisioning /
+                    # setting-up relaunch must not be torn down to
+                    # re-probe capacity before it trains a single step.
+                    and status == 'RUNNING'
+                    and time.time() - last_grow_check >=
+                    grow_gap * grow_backoff):
+                last_grow_check = time.time()
+                jobs_state.set_recovering(job_id, task_id)
+                try:
+                    grew = self.strategy.try_grow()
+                except exceptions.ManagedJobReachedMaxRetriesError as e:
+                    # Even the degraded-extent fallback found no
+                    # capacity: the cluster is down and nothing will
+                    # bring it back soon.
+                    jobs_state.set_failed(
+                        job_id, task_id,
+                        jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                        str(e))
+                    return False
+                except Exception as e:  # pylint: disable=broad-except
+                    # Cluster state unknown (teardown or relaunch died
+                    # mid-flight) — stay RECOVERING; the next poll's
+                    # cloud check routes into _recover rather than
+                    # claiming RUNNING against a possibly-dead slice.
+                    logger.warning('elastic grow attempt failed: %s', e)
+                    grow_backoff = min(grow_backoff * 2, 8)
+                    continue
+                jobs_state.set_started(job_id, task_id, cluster_name)
+                if grew:
+                    grow_backoff = 1
+                    logger.info('elastic job %d grew back to its target '
+                                'extent', job_id)
+                else:
+                    grow_backoff = min(grow_backoff * 2, 8)
 
     def _recover(self, task_id: int) -> None:
         """Preemption path: delete the (partial) slice, relaunch via the

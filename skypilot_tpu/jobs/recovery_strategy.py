@@ -17,17 +17,24 @@ from __future__ import annotations
 import logging
 import time
 import typing
-from typing import Dict, Optional, Type
+from typing import Dict, List, Optional, Type
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import status_lib
 from skypilot_tpu.jobs import constants
+from skypilot_tpu.utils import retry as retry_lib
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu import task as task_lib
 
 logger = logging.getLogger(__name__)
+
+# Exported into the task env by the ELASTIC strategy so the training
+# command can size its dp axis to what capacity actually delivered
+# (e.g. `--dp $((SKYTPU_ELASTIC_NUM_CHIPS))`; docs/resilience.md
+# "Elastic training lifecycle").
+ELASTIC_NUM_CHIPS_ENV_VAR = 'SKYTPU_ELASTIC_NUM_CHIPS'
 
 DEFAULT_RECOVERY_STRATEGY = 'EAGER_NEXT_REGION'
 RECOVERY_STRATEGIES: Dict[str, Type['StrategyExecutor']] = {}
@@ -40,11 +47,18 @@ class StrategyExecutor:
     NAME = 'STRATEGY_BASE'
 
     def __init__(self, cluster_name: str, task: 'task_lib.Task',
-                 max_restarts_on_errors: int = 0) -> None:
+                 max_restarts_on_errors: int = 0,
+                 job_id: Optional[int] = None,
+                 task_id: Optional[int] = None) -> None:
         self.cluster_name = cluster_name
         self.task = task
         self.max_restarts_on_errors = max_restarts_on_errors
         self.restart_cnt_on_failure = 0
+        # For strategies that record per-task state (the ELASTIC
+        # strategy's preemption lineage); None when driven outside a
+        # managed-job controller (unit tests, ad-hoc use).
+        self.job_id = job_id
+        self.task_id = task_id
 
     def __init_subclass__(cls) -> None:
         if cls.NAME in RECOVERY_STRATEGIES:
@@ -53,7 +67,9 @@ class StrategyExecutor:
 
     @classmethod
     def make(cls, cluster_name: str, task: 'task_lib.Task',
-             max_restarts_on_errors: int = 0) -> 'StrategyExecutor':
+             max_restarts_on_errors: int = 0,
+             job_id: Optional[int] = None,
+             task_id: Optional[int] = None) -> 'StrategyExecutor':
         """Picks the strategy from the task's resources.job_recovery
         (reference: StrategyExecutor.make, recovery_strategy.py:80-113)."""
         names = set()
@@ -69,7 +85,8 @@ class StrategyExecutor:
                 f'Unknown job_recovery strategy {name!r}; available: '
                 f'{sorted(RECOVERY_STRATEGIES)}')
         return RECOVERY_STRATEGIES[name](cluster_name, task,
-                                         max_restarts_on_errors)
+                                         max_restarts_on_errors,
+                                         job_id=job_id, task_id=task_id)
 
     # ---------------- operations ----------------
 
@@ -96,37 +113,47 @@ class StrategyExecutor:
         Raises ClusterTeardownError when every retry fails: relaunching
         while the old slice may still exist risks a double provision (two
         live clusters billing under one managed job), so the caller must
-        see the failure rather than proceed."""
+        see the failure rather than proceed.
+
+        Retries ride the shared utils/retry.py jittered-backoff ladder
+        (one policy for every transient-failure path — the PR-1
+        conversion finally applied to the strategy executors)."""
         from skypilot_tpu import core
-        last_error: Optional[Exception] = None
-        for attempt in range(max_retry):
-            try:
-                record = global_user_state.get_cluster_from_name(
-                    self.cluster_name)
-                if record is None:
-                    return
-                core.down(self.cluster_name, purge=(attempt ==
-                                                    max_retry - 1))
+        attempt_no = {'n': 0}
+
+        def _down() -> None:
+            attempt_no['n'] += 1
+            record = global_user_state.get_cluster_from_name(
+                self.cluster_name)
+            if record is None:
                 return
+            try:
+                core.down(self.cluster_name,
+                          purge=(attempt_no['n'] == max_retry))
             except exceptions.ClusterNotUpError:
                 return
-            except Exception as e:  # pylint: disable=broad-except
-                last_error = e
-                logger.warning('Failed to terminate %s (attempt %d): %s',
-                               self.cluster_name, attempt, e)
-                time.sleep(min(2 ** attempt, 10))
-        raise exceptions.ClusterTeardownError(
-            f'Failed to terminate cluster {self.cluster_name!r} after '
-            f'{max_retry} attempts; refusing to relaunch over a possibly '
-            f'live slice.') from last_error
+
+        try:
+            retry_lib.call_with_retry(_down, attempts=max_retry,
+                                      base=1.0, cap=10.0)
+        except Exception as e:  # pylint: disable=broad-except
+            raise exceptions.ClusterTeardownError(
+                f'Failed to terminate cluster {self.cluster_name!r} '
+                f'after {max_retry} attempts; refusing to relaunch over '
+                f'a possibly live slice.') from e
 
     def _launch(self, raise_on_failure: bool = True,
                 resources_override: Optional[dict] = None,
-                blocked_resources: Optional[list] = None
+                blocked_resources: Optional[list] = None,
+                max_attempts: Optional[int] = None
                 ) -> Optional[float]:
         """One launch attempt cycle: walk the optimizer's candidates via
         execution.launch (which itself fails over across zones/regions),
-        retrying up to MAX_LAUNCH_RETRIES with a gap (reference: _launch,
+        retrying up to `max_attempts` (default MAX_LAUNCH_RETRIES) on
+        the shared utils/retry.py jittered-backoff ladder — base gap
+        recovery_wait_seconds(), exponential, capped at 8x, so a spot
+        storm's relaunches spread instead of thundering-herding the
+        provisioner in lock-step (reference: _launch,
         recovery_strategy.py:246-370)."""
         from skypilot_tpu import execution
 
@@ -137,9 +164,9 @@ class StrategyExecutor:
             }
             task = task.copy()
             task.set_resources(new_resources)
+        attempts = max_attempts or constants.MAX_LAUNCH_RETRIES
 
-        backoff = constants.recovery_wait_seconds()
-        for retry_cnt in range(1, constants.MAX_LAUNCH_RETRIES + 1):
+        def _attempt() -> float:
             try:
                 job_id, handle = execution.launch(
                     task,
@@ -148,28 +175,35 @@ class StrategyExecutor:
                     stream_logs=False,
                     quiet_optimizer=True,
                     blocked_resources=blocked_resources)
-                assert job_id is not None and handle is not None
-                return time.time()
             except exceptions.ProvisionPrechecksError:
                 raise
             except exceptions.ResourcesUnavailableError as e:
-                # Every candidate was capacity-blocked. If the failover
-                # history contains only capacity errors this is retryable;
-                # anything else is a precheck-style failure
+                # Every candidate was capacity-blocked: retryable
                 # (reference: recovery_strategy.py:300-340 distinguishes
                 # via failover_history).
-                logger.info('Launch attempt %d/%d found no capacity: %s',
-                            retry_cnt, constants.MAX_LAUNCH_RETRIES, e)
+                logger.info('Launch attempt found no capacity: %s', e)
+                raise
             except Exception as e:  # pylint: disable=broad-except
-                logger.warning('Launch attempt %d/%d failed: %s',
-                               retry_cnt, constants.MAX_LAUNCH_RETRIES, e)
-            if retry_cnt < constants.MAX_LAUNCH_RETRIES:
-                time.sleep(backoff)
-        if raise_on_failure:
-            raise exceptions.ManagedJobReachedMaxRetriesError(
-                f'Failed to launch {self.cluster_name!r} after '
-                f'{constants.MAX_LAUNCH_RETRIES} attempts.')
-        return None
+                logger.warning('Launch attempt failed: %s', e)
+                raise
+            assert job_id is not None and handle is not None
+            return time.time()
+
+        base = constants.recovery_wait_seconds()
+        try:
+            return retry_lib.call_with_retry(
+                _attempt, attempts=attempts,
+                retry_if=lambda e: not isinstance(
+                    e, exceptions.ProvisionPrechecksError),
+                base=base, cap=base * 8)
+        except exceptions.ProvisionPrechecksError:
+            raise
+        except Exception:  # pylint: disable=broad-except
+            if raise_on_failure:
+                raise exceptions.ManagedJobReachedMaxRetriesError(
+                    f'Failed to launch {self.cluster_name!r} after '
+                    f'{attempts} attempts.')
+            return None
 
     def should_restart_on_failure(self) -> bool:
         """User-code failure budget (reference: recovery_strategy.py
@@ -221,6 +255,163 @@ class FailoverStrategyExecutor(StrategyExecutor):
         launched = self._launch(raise_on_failure=True)
         self._record_location()
         return launched
+
+
+class ElasticStrategyExecutor(FailoverStrategyExecutor):
+    """Elastic training recovery: relaunch at the SURVIVING extent
+    instead of waiting for full capacity (ROADMAP open item 4; arxiv
+    2011.03641 — keeping the surviving replicas productive beats
+    restarting the world).
+
+    On preemption the strategy tries the full target extent once, then
+    walks the divisor ladder (8 → 4 → 2 → ... chips; every rung divides
+    the target so the relaunched run's dp always divides the canonical
+    extent; floor `accelerator_args.elastic_min_chips`, default 1) with
+    ONE attempt per rung — capacity decides the extent, not a retry
+    budget. The training run sizes its dp axis from
+    $SKYTPU_ELASTIC_NUM_CHIPS and resumes through the ZeRO-1 reshard
+    path (`train.run --elastic`). Every resize is
+    recorded as preemption lineage in jobs/state. When the job runs
+    degraded, the controller periodically calls `try_grow()` to move
+    back to the target extent (a checkpointed restart, not a recovery).
+    """
+
+    NAME = 'ELASTIC'
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        from skypilot_tpu import topology
+        base = next(iter(self.task.resources))
+        if base.accelerators is None:
+            raise ValueError(
+                'ELASTIC job_recovery needs a TPU accelerator resource '
+                '(the extent ladder resizes the slice)')
+        self._slice = topology.parse_accelerator(base.accelerators)
+        self._target_chips = self._slice.chips
+        args_ = base.accelerator_args or {}
+        self._min_chips = max(1, int(args_.get('elastic_min_chips', 1)))
+        self.current_chips = self._target_chips
+
+    # -------- extent bookkeeping --------
+
+    def _accelerator_for(self, chips: int) -> str:
+        factor = 2 if self._slice.gen.counts_cores else 1
+        return f'tpu-{self._slice.generation}-{chips * factor}'
+
+    def _extent_ladder(self) -> List[int]:
+        # Only DIVISORS of the target extent that form a REAL slice:
+        # the relaunched task's live dp must divide the run's canonical
+        # extent or `train.run --elastic` refuses to start (a 12-chip
+        # target steps 6 → 4 → 3 → …, never a blind halving's 5), and a
+        # rung whose chip count has no valid physical topology for the
+        # generation (v5p has no 6-chip slice) would make the Resources
+        # copy raise before any launch attempt.
+        from skypilot_tpu import topology
+        ladder = []
+        for c in range(self._target_chips - 1, 0, -1):
+            if self._target_chips % c or c < self._min_chips:
+                continue
+            try:
+                topology.parse_accelerator(self._accelerator_for(c))
+            except Exception:  # pylint: disable=broad-except
+                continue
+            ladder.append(c)
+        return ladder
+
+    def _set_extent_env(self, chips: int) -> None:
+        self.task.update_envs({ELASTIC_NUM_CHIPS_ENV_VAR: str(chips)})
+
+    def _record_extent(self, chips: int, reason: str) -> None:
+        prev, self.current_chips = self.current_chips, chips
+        if self.job_id is None or self.task_id is None:
+            return
+        from skypilot_tpu.jobs import state as jobs_state
+        jobs_state.record_preemption_event(
+            self.job_id, self.task_id, {
+                'at': time.time(), 'reason': reason,
+                'from_chips': prev, 'to_chips': chips,
+            })
+
+    def _launch_at(self, chips: int, *, max_attempts: Optional[int],
+                   raise_on_failure: bool) -> Optional[float]:
+        self._set_extent_env(chips)
+        override: Dict[str, object] = {}
+        if chips != self._target_chips:
+            base = next(iter(self.task.resources))
+            args_ = dict(base.accelerator_args or {})
+            # A fixed physical topology cannot survive a resize.
+            args_.pop('topology', None)
+            override = {'accelerators': self._accelerator_for(chips),
+                        'accelerator_args': args_ or None}
+        return self._launch(raise_on_failure=raise_on_failure,
+                            resources_override=override or None,
+                            max_attempts=max_attempts)
+
+    # -------- lifecycle --------
+
+    def launch(self) -> float:
+        self._set_extent_env(self._target_chips)
+        launched = super().launch()
+        self._record_extent(self._target_chips, 'launch')
+        return launched
+
+    def recover(self) -> float:
+        # The preempted slice must be deleted before ANY relaunch (TPU
+        # slices cannot restart in place).
+        self.terminate_cluster()
+        # 1. Full extent, one quick shot: not every preemption is a
+        #    capacity crunch.
+        launched = self._launch_at(self._target_chips, max_attempts=1,
+                                   raise_on_failure=False)
+        if launched is not None:
+            self._record_extent(self._target_chips, 'preemption')
+            self._record_location()
+            return launched
+        # 2. Walk the ladder down: ONE attempt per rung — relaunching
+        #    the surviving extent NOW beats waiting out a full retry
+        #    budget for capacity that is not coming back.
+        for chips in self._extent_ladder()[:-1]:
+            launched = self._launch_at(chips, max_attempts=1,
+                                       raise_on_failure=False)
+            if launched is not None:
+                self._record_extent(chips, 'preemption')
+                self._record_location()
+                return launched
+        # 3. Last rung gets the full retry ladder before giving up.
+        floor = (self._extent_ladder() or [self._target_chips])[-1]
+        launched = self._launch_at(floor, max_attempts=None,
+                                   raise_on_failure=True)
+        self._record_extent(floor, 'preemption')
+        self._record_location()
+        return launched
+
+    def degraded(self) -> bool:
+        return self.current_chips < self._target_chips
+
+    def try_grow(self) -> bool:
+        """Attempt ONE relaunch at the full target extent while running
+        degraded (called by the controller every elastic-grow gap).
+        Growing is a checkpointed restart, not a recovery: the run
+        resumes from its latest checkpoint at the bigger extent. A
+        failed grow falls straight back to the current degraded extent
+        so the job keeps training either way. Returns whether the fleet
+        grew."""
+        if not self.degraded():
+            return False
+        prev_chips = self.current_chips
+        self.terminate_cluster()
+        launched = self._launch_at(self._target_chips, max_attempts=1,
+                                   raise_on_failure=False)
+        if launched is not None:
+            self._record_extent(self._target_chips, 'grow')
+            self._record_location()
+            return True
+        # Capacity still tight: resume at the extent we had.
+        self._launch_at(prev_chips, max_attempts=None,
+                        raise_on_failure=True)
+        self._record_extent(prev_chips, 'grow_failed')
+        self._record_location()
+        return False
 
 
 class EagerFailoverStrategyExecutor(FailoverStrategyExecutor):

@@ -93,6 +93,15 @@ def _create_table(cursor: sqlite3.Cursor, conn: sqlite3.Connection) -> None:
     # of a local process; queue/cancel then RPC to that cluster.
     db_utils.add_column_if_not_exists(cursor, 'job_info', 'remote_cluster',
                                       'TEXT')
+    # ELASTIC recovery bookkeeping: the chip extent the task currently
+    # runs at, and the JSON preemption lineage (every resize event —
+    # launch/preemption/grow — with from/to extents and timestamps), so
+    # `jobs queue` can show a degraded fleet and post-mortems can replay
+    # a storm (docs/resilience.md "Elastic training lifecycle").
+    db_utils.add_column_if_not_exists(cursor, 'spot', 'elastic_extent',
+                                      'INTEGER')
+    db_utils.add_column_if_not_exists(cursor, 'spot',
+                                      'preemption_lineage', 'TEXT')
     conn.commit()
 
 
@@ -287,6 +296,47 @@ def set_failed(job_id: int, task_id: Optional[int],
                  task_id))
 
 
+def record_preemption_event(job_id: int, task_id: int,
+                            event: Dict[str, Any]) -> None:
+    """Append one resize/preemption event to the task's lineage and
+    mirror the resulting extent into elastic_extent. The lineage is an
+    append-only JSON list — the storm post-mortem record."""
+    import json
+    lineage = get_preemption_lineage(job_id, task_id)
+    lineage.append(event)
+    fields: Dict[str, Any] = {'preemption_lineage': json.dumps(lineage)}
+    if 'to_chips' in event:
+        fields['elastic_extent'] = int(event['to_chips'])
+    _set(job_id, task_id, **fields)
+
+
+def get_preemption_lineage(job_id: int, task_id: int) -> List[Dict[str, Any]]:
+    import json
+    db = _get_db()
+    with db.cursor() as cursor:
+        row = cursor.execute(
+            'SELECT preemption_lineage FROM spot '
+            'WHERE job_id = ? AND task_id = ?',
+            (job_id, task_id)).fetchone()
+    if row is None or not row[0]:
+        return []
+    try:
+        lineage = json.loads(row[0])
+    except ValueError:
+        return []
+    return lineage if isinstance(lineage, list) else []
+
+
+def get_elastic_extent(job_id: int, task_id: int) -> Optional[int]:
+    db = _get_db()
+    with db.cursor() as cursor:
+        row = cursor.execute(
+            'SELECT elastic_extent FROM spot '
+            'WHERE job_id = ? AND task_id = ?',
+            (job_id, task_id)).fetchone()
+    return None if row is None or row[0] is None else int(row[0])
+
+
 def _set(job_id: int, task_id: int, **fields: Any) -> None:
     db = _get_db()
     cols = ', '.join(f'{k} = ?' for k in fields)
@@ -327,7 +377,8 @@ def sync_remote_records(job_id: int, records: List[Dict[str, Any]]) -> None:
 
 _COLUMNS = ('job_id', 'task_id', 'task_name', 'resources', 'cluster_name',
             'submitted_at', 'status', 'run_timestamp', 'start_at', 'end_at',
-            'last_recovered_at', 'recovery_count', 'failure_reason')
+            'last_recovered_at', 'recovery_count', 'failure_reason',
+            'elastic_extent', 'preemption_lineage')
 
 
 def _row_to_record(row) -> Dict[str, Any]:

@@ -1,3 +1,17 @@
+import jax as _jax
+
+# Mesh-invariant random init: with the legacy (non-partitionable) threefry
+# lowering, a jitted init whose out-shardings differ — fsdp=8 vs pp=2 vs a
+# tp serving mesh — generates DIFFERENT random values for the sharded
+# leaves, so "same seed" did not mean "same model" across topologies. That
+# broke the pp2-vs-pp1 loss-parity pin (the long-standing test_pipeline
+# rel=2e-4 failure: the two runs compared different inits, ~1% apart) and
+# it would break elastic training's bit-parity contract the moment a run
+# cold-starts at a reduced dp extent. The partitionable lowering generates
+# every shard from its global counter offsets, so values depend only on
+# (key, shape) — never on the mesh.
+_jax.config.update('jax_threefry_partitionable', True)
+
 from skypilot_tpu.parallel.distributed import ProcessTopology
 from skypilot_tpu.parallel.distributed import initialize
 from skypilot_tpu.parallel.distributed import topology_from_env

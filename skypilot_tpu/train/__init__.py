@@ -1,11 +1,13 @@
 from skypilot_tpu.train.trainer import (TrainConfig, TrainState,
                                         create_sharded_state,
-                                        cross_entropy_loss, make_optimizer,
+                                        cross_entropy_loss,
+                                        make_elastic_train_step,
+                                        make_optimizer,
                                         make_eval_step, make_train_step,
                                         synthetic_batch)
 
 __all__ = [
     'TrainConfig', 'TrainState', 'create_sharded_state',
-    'cross_entropy_loss', 'make_eval_step', 'make_optimizer',
-    'make_train_step', 'synthetic_batch',
+    'cross_entropy_loss', 'make_elastic_train_step', 'make_eval_step',
+    'make_optimizer', 'make_train_step', 'synthetic_batch',
 ]

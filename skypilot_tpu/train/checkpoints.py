@@ -27,9 +27,26 @@ from __future__ import annotations
 import inspect
 import logging
 import os
-from typing import Any, Optional
+import threading
+import time
+from typing import Any, Optional, Tuple
+
+from skypilot_tpu.observability import metrics as _obs
+from skypilot_tpu.utils import fault_injection
 
 logger = logging.getLogger(__name__)
+
+# Preemption-notice discipline (docs/resilience.md "Elastic training
+# lifecycle"): how long a deadline-bounded save took to COMMIT, and how
+# often the newest checkpoint had to be skipped as torn/corrupt.
+_SAVE_SECONDS = _obs.histogram(
+    'skytpu_train_checkpoint_save_seconds',
+    'Wall time for a training checkpoint save to commit (async saves '
+    'observe at wait/deadline time)')
+_RESTORE_FALLBACKS = _obs.counter(
+    'skytpu_train_checkpoint_restore_fallbacks_total',
+    'Restores that skipped a corrupt/torn newest checkpoint and fell '
+    'back to an older step')
 
 
 class CheckpointManager:
@@ -53,11 +70,64 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._manager.latest_step()
 
+    def all_steps(self) -> list:
+        """Committed checkpoint steps, ascending (uncommitted/torn async
+        saves never appear — the orbax commit marker is the publish)."""
+        return sorted(self._manager.all_steps())
+
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Async save; returns whether a save was initiated."""
         import orbax.checkpoint as ocp
+        fault_injection.point('train.save')
         return self._manager.save(
             step, args=ocp.args.StandardSave(state), force=force)
+
+    def save_within_deadline(self, step: int, state: Any,
+                             deadline_s: float) -> bool:
+        """Deadline-bounded forced save — the preemption-notice path
+        (the PR-6 export discipline applied to checkpoints): initiate a
+        save of `step` and wait up to `deadline_s` for it to COMMIT.
+        Returns whether the checkpoint committed within the budget.
+
+        A save that cannot commit in time publishes NOTHING (orbax
+        writes into an uncommitted temp dir; latest_step() never sees
+        it), so a kill right after the deadline leaves the previous
+        checkpoint as the intact fallback — losing the save is fine,
+        publishing a torn one is not. The lingering commit thread is
+        daemonized: on a real preemption the process is about to die
+        anyway, and in tests a late commit is harmless (it publishes a
+        VALID checkpoint, just after we stopped waiting for it)."""
+        import orbax.checkpoint as ocp
+        fault_injection.point('train.save')
+        start = time.monotonic()
+
+        def _bounded_wait() -> bool:
+            waiter = threading.Thread(
+                target=self._manager.wait_until_finished, daemon=True)
+            waiter.start()
+            waiter.join(timeout=max(
+                0.0, deadline_s - (time.monotonic() - start)))
+            return not waiter.is_alive()
+
+        # Fold any in-flight periodic async save into the budget first —
+        # initiating a second save of the same step over it would error.
+        drained = _bounded_wait()
+        latest = self.latest_step()
+        committed = drained and latest is not None and latest >= step
+        if drained and not committed:
+            self._manager.save(
+                step, args=ocp.args.StandardSave(state), force=True)
+            if _bounded_wait():
+                latest = self.latest_step()
+                committed = latest is not None and latest >= step
+        elapsed = time.monotonic() - start
+        _SAVE_SECONDS.observe(elapsed)
+        if not committed:
+            logger.warning(
+                'checkpoint step %d did not commit within the %.1fs '
+                'notice budget (%.1fs elapsed); the previous checkpoint '
+                'remains the resume point', step, deadline_s, elapsed)
+        return committed
 
     def restore(self, state: Any, step: Optional[int] = None) -> Any:
         """Restore into the sharding/structure of `state` (an abstract or
@@ -104,6 +174,39 @@ class CheckpointManager:
         logger.info('Restoring checkpoint step %d from %s', step,
                     self.directory)
         return self.restore(state, step), step
+
+    def restore_latest_valid(self, state: Any) -> Tuple[Any, int]:
+        """(state, start_step): restore the NEWEST checkpoint that
+        actually loads, walking back past corrupt/torn newer ones — the
+        PR-6 corrupt-newest-falls-back-older artifact rule applied to
+        training checkpoints. A slice that died mid-life can leave its
+        newest step damaged (a half-written shard on a flaky mount, an
+        out-of-band truncation); refusing to train until an operator
+        intervenes would forfeit the surviving fleet, and keep-newest-N
+        pruning guarantees older fallbacks exist. Returns the input
+        state untouched with step 0 when NO checkpoint loads (a fresh
+        dir, or every step damaged — logged loudly)."""
+        steps = self.all_steps()
+        for step in reversed(steps):
+            try:
+                restored = self.restore(state, step)
+            except Exception as e:  # pylint: disable=broad-except
+                _RESTORE_FALLBACKS.inc()
+                logger.warning(
+                    'checkpoint step %d in %s failed to restore (%s: '
+                    '%s); falling back to the next older step', step,
+                    self.directory, type(e).__name__, e)
+                continue
+            if step != (steps[-1] if steps else None):
+                logger.warning(
+                    'resumed from OLDER checkpoint step %d (newest was '
+                    'damaged); steps after it will be re-trained', step)
+            return restored, step
+        if steps:
+            logger.error(
+                'every checkpoint in %s failed to restore (%s); '
+                'starting from step 0', self.directory, steps)
+        return state, 0
 
     def wait(self) -> None:
         self._manager.wait_until_finished()

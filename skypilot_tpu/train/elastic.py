@@ -1,0 +1,328 @@
+"""Preemption-native elastic training: survive spot storms by resharding
+the dp fleet live.
+
+Serving already rides preemption as a rehearsed event (the PR-6
+notice→drain→export→failover→pre-warm lifecycle); this module gives
+training the same discipline. A preemption notice no longer means "die
+and relaunch the world from the last full checkpoint" — it means:
+
+1. **Notice** — SIGTERM (the cloud's spot warning) or a programmatic
+   `PreemptionNotice.deliver()` sets a flag the step loop polls between
+   steps. At most the in-flight step is lost, by construction.
+2. **Deadline-bounded checkpoint** — the run force-saves its dp-sharded
+   state via `CheckpointManager.save_within_deadline` inside the notice
+   budget (`SKYTPU_TRAIN_PREEMPT_NOTICE_BUDGET`, default 30s — the GCP
+   spot-TPU warning window). A save that cannot commit publishes
+   nothing; the previous checkpoint stays the resume point.
+3. **Relaunch at the surviving extent** — the managed-jobs ELASTIC
+   recovery strategy (jobs/recovery_strategy.py) relaunches at the dp
+   extent capacity actually offers instead of waiting for full
+   capacity; `surviving_extent` picks the largest divisor of the
+   canonical extent the surviving devices support.
+4. **Resume via reshard** — the PR-9 template-authoritative restore
+   reads each device's byte ranges straight into the new extent's
+   shardings; `ElasticTrainLoop.run` then steps with the
+   extent-invariant `make_elastic_train_step`, so the loss curve is
+   BIT-IDENTICAL to a never-preempted run over the same data order
+   (pinned by tests/elastic_driver.py across a dp=4→2→4 storm).
+5. **Grow back** — when capacity returns, the next incarnation runs at
+   the target extent again; the sidecar lineage records every resize
+   (`skytpu_train_elastic_resizes_total{direction}`).
+
+The run-scoped facts that must survive relaunches — the extent the run
+last trained at, and the resize lineage — live in an `elastic.json`
+sidecar next to the checkpoints (the lora.json pattern), not in process
+memory.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu.observability import metrics as _obs
+from skypilot_tpu.utils import fault_injection
+
+logger = logging.getLogger(__name__)
+
+_PREEMPTIONS = _obs.counter(
+    'skytpu_train_preemptions_total',
+    'Preemption notices the elastic training loop handled (checkpoint '
+    'within the notice budget, then yield for relaunch)')
+_RESIZES = _obs.counter(
+    'skytpu_train_elastic_resizes_total',
+    'dp-extent changes across elastic incarnations', ('direction',))
+
+
+def record_preemption() -> None:
+    """Count a handled preemption notice (the run.py and
+    ElasticTrainLoop notice paths share this counter)."""
+    _PREEMPTIONS.inc()
+
+
+def notice_budget_seconds() -> float:
+    """The training preemption-notice budget: how long the run has
+    between the notice and the kill to commit its checkpoint."""
+    try:
+        return float(os.environ.get(
+            'SKYTPU_TRAIN_PREEMPT_NOTICE_BUDGET', 30.0))
+    except ValueError:
+        return 30.0
+
+
+class PreemptionNotice:
+    """Thread-safe preemption flag the step loop polls between steps.
+
+    `install_sigterm()` wires the cloud's spot warning to it; tests and
+    the chaos driver call `deliver()` directly (optionally armed via the
+    `train.notice` injection point — a failure there simulates a notice
+    that never reaches the trainer, so the kill lands with no final
+    checkpoint and the run falls back to the last periodic save)."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._delivered_at: Optional[float] = None
+
+    def deliver(self) -> None:
+        fault_injection.point('train.notice')
+        self._delivered_at = time.monotonic()
+        self._event.set()
+
+    def pending(self) -> bool:
+        return self._event.is_set()
+
+    def clear(self) -> None:
+        self._event.clear()
+        self._delivered_at = None
+
+    def remaining_budget(self, budget_s: float) -> float:
+        """How much of the notice budget is LEFT, measured from notice
+        delivery — the kill clock starts when the cloud sends the
+        warning, not when the step loop gets around to polling it. A
+        notice that lands mid-step can eat most of the budget before
+        the save even starts; the save must only wait out what
+        remains."""
+        if self._delivered_at is None:
+            return budget_s
+        return max(0.0, budget_s - (time.monotonic() - self._delivered_at))
+
+    def install_sigterm(self) -> None:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _handler(signum, frame):  # pylint: disable=unused-argument
+            logger.warning('SIGTERM: preemption notice — checkpointing '
+                           'within the notice budget')
+            try:
+                self.deliver()
+            except fault_injection.InjectedFault:
+                # An armed notice fault simulates the notice being lost
+                # in delivery; swallow it here (a signal handler must
+                # not raise) — the loop simply never sees the flag.
+                pass
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+
+        signal.signal(signal.SIGTERM, _handler)
+
+
+def surviving_extent(canonical_dp: int, available_devices: int) -> int:
+    """Largest dp extent that (a) divides the canonical extent — the
+    elastic step's invariance requirement — and (b) fits the surviving
+    devices. This is the extent a post-preemption relaunch runs at
+    instead of waiting for full capacity."""
+    if canonical_dp < 1:
+        raise ValueError(f'canonical_dp must be >= 1, got {canonical_dp}')
+    if available_devices < 1:
+        raise ValueError('no surviving devices')
+    dp = min(canonical_dp, available_devices)
+    while canonical_dp % dp:
+        dp -= 1
+    return dp
+
+
+@dataclasses.dataclass
+class ElasticMeta:
+    """The elastic.json sidecar: run-scoped extent + lineage that must
+    survive relaunches (the lora.json pattern)."""
+    canonical_dp: int
+    dp: int
+    lineage: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def path(cls, checkpoint_dir: str) -> str:
+        return os.path.join(os.path.expanduser(checkpoint_dir),
+                            'elastic.json')
+
+    @classmethod
+    def load(cls, checkpoint_dir: str) -> Optional['ElasticMeta']:
+        try:
+            with open(cls.path(checkpoint_dir), encoding='utf-8') as f:
+                raw = json.load(f)
+            return cls(canonical_dp=int(raw['canonical_dp']),
+                       dp=int(raw['dp']),
+                       lineage=list(raw.get('lineage', [])))
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            # A sidecar that parses but lacks the schema (older tool,
+            # hand edit) is as unusable as a torn one — treat it as
+            # absent with a loud log rather than crash-looping every
+            # relaunch on the same file.
+            if os.path.exists(cls.path(checkpoint_dir)):
+                logger.warning('ignoring unreadable elastic sidecar %s '
+                               '(%s: %s)', cls.path(checkpoint_dir),
+                               type(e).__name__, e)
+            return None
+
+    def save(self, checkpoint_dir: str) -> None:
+        path = self.path(checkpoint_dir)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + '.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(dataclasses.asdict(self), f)
+        os.replace(tmp, path)  # atomic publish, never a torn sidecar
+
+
+def revalidate_extent(checkpoint_dir: str, canonical_dp: int, dp: int,
+                      step: int) -> ElasticMeta:
+    """dp-extent revalidation at incarnation start: check the requested
+    extent against the run's sidecar, record the resize (direction
+    metric + lineage) when the extent changed, and refuse a canonical
+    extent that contradicts the one the run was started with — resizing
+    the CANONICAL extent would silently void the bit-parity contract."""
+    meta = ElasticMeta.load(checkpoint_dir)
+    if meta is None:
+        meta = ElasticMeta(canonical_dp=canonical_dp, dp=dp)
+        meta.save(checkpoint_dir)
+        return meta
+    if meta.canonical_dp != canonical_dp:
+        raise ValueError(
+            f'elastic run in {checkpoint_dir!r} was started with '
+            f'canonical extent {meta.canonical_dp}, not {canonical_dp}: '
+            f'the canonical extent is fixed for the life of a run (it '
+            f'defines the bit-parity contract); resume with '
+            f'--dp {meta.canonical_dp} or use a fresh checkpoint dir')
+    if meta.dp != dp:
+        direction = 'up' if dp > meta.dp else 'down'
+        _RESIZES.labels(direction=direction).inc()
+        meta.lineage.append({'step': step, 'from_dp': meta.dp,
+                             'to_dp': dp, 'at': time.time()})
+        logger.warning('elastic resize %s: dp %d -> %d at step %d '
+                       '(lineage depth %d)', direction, meta.dp, dp,
+                       step, len(meta.lineage))
+        meta.dp = dp
+        meta.save(checkpoint_dir)
+    return meta
+
+
+@dataclasses.dataclass
+class IncarnationResult:
+    """What one `ElasticTrainLoop.run` call accomplished."""
+    next_step: int            # first step NOT yet trained
+    preempted: bool           # stopped on a notice (vs ran to target)
+    checkpoint_committed: bool  # the notice-time save made it in time
+    dp: int                   # extent this incarnation ran at
+    resume_latency_s: float   # restore + revalidate wall time
+    series: List[Any]         # (loss, grad_norm) per completed step
+
+
+class ElasticTrainLoop:
+    """One relaunchable elastic training run over a checkpoint dir.
+
+    Each `run()` call is ONE incarnation at a given live extent: build
+    the dp mesh, init + restore the newest VALID checkpoint onto it
+    (corrupt-newest falls back older), revalidate the extent, then step
+    with the extent-invariant elastic step until `total_steps` or a
+    preemption notice. The managed-jobs controller (or the chaos
+    driver) decides each incarnation's extent; the loop never chooses.
+
+    NOTE: steps run WITHOUT the `with mesh:` context on purpose — the
+    elastic step's bit-parity contract requires it (see
+    make_elastic_train_step)."""
+
+    def __init__(self, cfg, train_config, checkpoint_dir: str, *,
+                 canonical_dp: int, save_every: int = 1,
+                 zero_sharding: bool = True,
+                 max_to_keep: int = 3) -> None:
+        self.cfg = cfg
+        self.train_config = train_config
+        self.checkpoint_dir = checkpoint_dir
+        self.canonical_dp = canonical_dp
+        self.save_every = save_every
+        self.zero_sharding = zero_sharding
+        self.max_to_keep = max_to_keep
+
+    def run(self, dp: int, batch_for: Callable[[int], Dict[str, Any]],
+            total_steps: int,
+            notice: Optional[PreemptionNotice] = None,
+            notice_budget_s: Optional[float] = None) -> IncarnationResult:
+        import jax
+
+        from skypilot_tpu.parallel import train_mesh
+        from skypilot_tpu.train.checkpoints import CheckpointManager
+        from skypilot_tpu.train.trainer import (create_sharded_state,
+                                                make_elastic_train_step)
+
+        budget = (notice_budget_seconds() if notice_budget_s is None
+                  else notice_budget_s)
+        t0 = time.monotonic()
+        mesh = train_mesh(dp)
+        state, shardings = create_sharded_state(
+            self.cfg, mesh, jax.random.PRNGKey(0), self.train_config,
+            zero_sharding=self.zero_sharding)
+        manager = CheckpointManager(self.checkpoint_dir,
+                                    max_to_keep=self.max_to_keep,
+                                    save_interval_steps=self.save_every)
+        skip_close = False
+        try:
+            state, start_step = manager.restore_latest_valid(state)
+            revalidate_extent(self.checkpoint_dir, self.canonical_dp,
+                              dp, start_step)
+            step_fn = make_elastic_train_step(self.cfg, mesh, shardings,
+                                              self.canonical_dp)
+            resume_latency = time.monotonic() - t0
+            series: List[Any] = []
+            step = start_step
+            while step < total_steps:
+                if notice is not None and notice.pending():
+                    record_preemption()
+                    # Drains any in-flight periodic save and publishes
+                    # the current step, all inside what REMAINS of the
+                    # notice budget (the kill clock started at
+                    # delivery, possibly mid-step).
+                    committed = manager.save_within_deadline(
+                        step, state, notice.remaining_budget(budget))
+                    # close() would block on the very save the deadline
+                    # logic abandoned (wait_until_finished has no
+                    # timeout): the kill is imminent — leave the daemon
+                    # waiter behind instead of outliving the budget.
+                    skip_close = not committed
+                    return IncarnationResult(
+                        next_step=step, preempted=True,
+                        checkpoint_committed=committed, dp=dp,
+                        resume_latency_s=resume_latency, series=series)
+                fault_injection.point('train.step')
+                state, metrics = step_fn(state, batch_for(step))
+                series.append((float(metrics['loss']),
+                               float(metrics['grad_norm'])))
+                step += 1
+                manager.save(step, state)
+            if manager.latest_step() != total_steps:
+                manager.save(total_steps, state, force=True)
+            manager.wait()
+            return IncarnationResult(
+                next_step=step, preempted=False,
+                checkpoint_committed=True, dp=dp,
+                resume_latency_s=resume_latency, series=series)
+        finally:
+            if skip_close:
+                logger.warning(
+                    'leaving the checkpoint manager open: an '
+                    'uncommitted save is still draining and the '
+                    'process is about to die')
+            else:
+                manager.close()

@@ -61,6 +61,21 @@ def main(argv=None) -> int:
                              'optimizer-state HBM of a dp-replicated '
                              'run divided by dp. Checkpoints stay '
                              'restorable across dp extents')
+    parser.add_argument('--elastic', action='store_true',
+                        help='preemption-native elastic training: on a '
+                             'preemption notice (SIGTERM) the run '
+                             'checkpoints within '
+                             '$SKYTPU_TRAIN_PREEMPT_NOTICE_BUDGET and '
+                             'exits 75 so the managed-jobs ELASTIC '
+                             'strategy relaunches it at the surviving '
+                             'dp extent; steps use the extent-'
+                             'invariant elastic step, so the loss '
+                             'curve is bit-identical across dp resizes '
+                             '(docs/resilience.md "Elastic training '
+                             'lifecycle"). Requires --dp and '
+                             '--checkpoint-dir; the FIRST launch\'s '
+                             '--dp fixes the canonical extent; '
+                             'relaunches pass the surviving extent')
     parser.add_argument('--probe-hlo', action='store_true',
                         help='AOT-compile the train step once more and '
                              'publish its collective-op counts '
@@ -128,11 +143,51 @@ def main(argv=None) -> int:
             '`python -m skypilot_tpu.models.export_tool` against the '
             'Orbax checkpoint afterwards')
 
-    # 2. Mesh over every chip in the job.
-    mesh_cfg = infer_mesh_config(jax.device_count(), tp=args.tp,
-                                 sp=args.sp, dp=args.dp, ep=args.ep,
-                                 pp=args.pp)
-    mesh = build_mesh(mesh_cfg)
+    # 2. Mesh over every chip in the job — except under --elastic,
+    # which must run a PURE-dp mesh: infer_mesh_config sends spare
+    # devices to fsdp, and an fsdp>1 axis would pull the elastic step's
+    # canonical-group batch axis onto ('dp','fsdp') shards, breaking
+    # the device-major group alignment its bit-parity contract depends
+    # on (make_elastic_train_step docstring).
+    elastic_ctx = None
+    if args.elastic:
+        from skypilot_tpu.parallel.mesh import MeshConfig
+        from skypilot_tpu.train import elastic as elastic_lib
+        if not args.checkpoint_dir:
+            raise SystemExit('--elastic requires --checkpoint-dir: the '
+                             'notice handler has nowhere to commit the '
+                             'final checkpoint without one')
+        if args.dp is None:
+            raise SystemExit('--elastic requires an explicit --dp (the '
+                             'live extent; the first launch fixes the '
+                             'canonical extent)')
+        if (args.pp or 1) > 1 or args.microbatches \
+                or args.grad_accum > 1 or args.lora_rank \
+                or (args.tp or 1) > 1 or (args.sp or 1) > 1 \
+                or (args.ep or 1) > 1:
+            raise SystemExit('--elastic composes with dp/ZeRO-1 only '
+                             'for now: drop --pp/--microbatches/'
+                             '--grad-accum/--lora-rank/--tp/--sp/--ep')
+        if args.dp > jax.device_count():
+            raise SystemExit(f'--elastic --dp {args.dp} exceeds the '
+                             f'{jax.device_count()} local devices')
+        mesh_cfg = MeshConfig(dp=args.dp)
+        mesh = build_mesh(mesh_cfg, list(jax.devices())[:args.dp])
+        meta = elastic_lib.ElasticMeta.load(args.checkpoint_dir)
+        canonical_dp = meta.canonical_dp if meta else mesh_cfg.dp
+        if canonical_dp % mesh_cfg.dp:
+            raise SystemExit(
+                f'--elastic: live dp={mesh_cfg.dp} must divide the '
+                f'run\'s canonical extent {canonical_dp} (from '
+                f'{elastic_lib.ElasticMeta.path(args.checkpoint_dir)})')
+        notice = elastic_lib.PreemptionNotice()
+        notice.install_sigterm()
+        elastic_ctx = (elastic_lib, canonical_dp, notice)
+    else:
+        mesh_cfg = infer_mesh_config(jax.device_count(), tp=args.tp,
+                                     sp=args.sp, dp=args.dp, ep=args.ep,
+                                     pp=args.pp)
+        mesh = build_mesh(mesh_cfg)
     logger.info('mesh: %s', mesh_cfg)
     if args.zero1 and mesh_cfg.dp <= 1:
         # Silent-no-op guard: the default mesh sends every spare device
@@ -203,7 +258,16 @@ def main(argv=None) -> int:
                 os.makedirs(os.path.dirname(lora_meta), exist_ok=True)
                 with open(lora_meta, 'w', encoding='utf-8') as f:
                     json.dump(meta, f)
-        state, start_step = manager.maybe_restore(state)
+        if elastic_ctx is not None:
+            # Corrupt-newest falls back older + resize bookkeeping
+            # (lineage sidecar, skytpu_train_elastic_resizes_total).
+            state, start_step = manager.restore_latest_valid(state)
+            elastic_lib, canonical_dp, _ = elastic_ctx
+            elastic_lib.revalidate_extent(args.checkpoint_dir,
+                                          canonical_dp, mesh_cfg.dp,
+                                          start_step)
+        else:
+            state, start_step = manager.maybe_restore(state)
     if args.init_from_hf and start_step == 0:
         # Fine-tune from a local HF checkpoint: convert on host, place
         # each leaf straight onto its mesh sharding. Skipped entirely on
@@ -275,10 +339,15 @@ def main(argv=None) -> int:
                 f'explicitly')
         logger.info('pipeline: pp=%d, defaulting to %d microbatches',
                     mesh_cfg.pp, microbatches)
-    step_fn = make_train_step(cfg, mesh, shardings,
-                              microbatches=microbatches,
-                              pipeline_repeats=args.pipeline_repeats,
-                              grad_accum=args.grad_accum)
+    if elastic_ctx is not None:
+        from skypilot_tpu.train import make_elastic_train_step
+        step_fn = make_elastic_train_step(cfg, mesh, shardings,
+                                          elastic_ctx[1])
+    else:
+        step_fn = make_train_step(cfg, mesh, shardings,
+                                  microbatches=microbatches,
+                                  pipeline_repeats=args.pipeline_repeats,
+                                  grad_accum=args.grad_accum)
     callbacks.init(total_steps=args.steps)
     dataset = None
     if args.data_dir and args.sft_data:
@@ -376,8 +445,37 @@ def main(argv=None) -> int:
                        'profile (start_step=%d, steps=%d)', start_step,
                        args.steps)
     profiling = False
-    with mesh:
+    import contextlib
+    # The elastic step's bit-parity contract requires running WITHOUT
+    # the mesh context (make_elastic_train_step docstring); placements
+    # are carried entirely by the jit shardings either way.
+    loop_ctx = (contextlib.nullcontext() if elastic_ctx is not None
+                else mesh)
+    with loop_ctx:
         for step in range(start_step, args.steps):
+            if elastic_ctx is not None and elastic_ctx[2].pending():
+                from skypilot_tpu.train import elastic as elastic_lib
+                elastic_lib.record_preemption()
+                # Only what REMAINS of the budget: the kill clock
+                # started at notice delivery, possibly mid-step.
+                committed = manager.save_within_deadline(
+                    step, state, elastic_ctx[2].remaining_budget(
+                        elastic_lib.notice_budget_seconds()))
+                logger.warning(
+                    'preempted at step %d: checkpoint %s, exiting 75 '
+                    'for an elastic relaunch', step,
+                    'committed' if committed else
+                    'did NOT commit within the notice budget — the '
+                    'previous checkpoint is the resume point')
+                if dataset is not None:
+                    dataset.close()
+                if committed:
+                    manager.close()
+                # else: close() would block on the same stuck save the
+                # deadline logic just abandoned (wait_until_finished has
+                # no timeout) — the kill is imminent, leave the daemon
+                # waiter behind and EXIT inside the notice window.
+                raise SystemExit(75)
             if args.profile_dir and step == profile_start:
                 jax.profiler.start_trace(args.profile_dir)
                 profiling = True

@@ -347,6 +347,153 @@ def make_train_step(
     )
 
 
+def make_elastic_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    state_shardings: Any,
+    canonical_dp: int,
+) -> Callable[[TrainState, Dict[str, jax.Array]],
+              Tuple[TrainState, Dict[str, jax.Array]]]:
+    """The dp-extent-invariant train step for elastic (preemption-native)
+    training: loss AND gradients are bit-identical whether the mesh runs
+    dp = canonical_dp or any divisor of it — the property that lets a
+    spot run reshard dp=4→2 mid-storm and grow back to 4 with the final
+    loss bit-equal to a never-preempted run over the same data order
+    (pinned by tests/elastic_driver.py).
+
+    Why the plain step can't promise this: XLA sums gradient partials in
+    whatever association the current extent induces (a dp=4 all-reduce
+    of four partials vs a dp=2 local-sum-then-all-reduce of two), so a
+    resize perturbs the low bits and the runs diverge step by step.
+    This step removes every extent-dependent reduction:
+
+    1. CANONICAL GROUPS — the global batch is split into `canonical_dp`
+       fixed groups (device-major, so a device's contiguous batch shard
+       holds its own groups). A lax.scan runs canonical_dp/dp rounds;
+       each round vmaps one group per device, so the per-group forward/
+       backward always runs at the same local shapes no matter the live
+       extent — the compiled per-group kernels cannot differ.
+    2. FIXED COMBINE — per-group loss/mask SUMS and gradients gather
+       replicated (pure data movement), then combine through an explicit
+       left-to-right chain of elementwise adds. A jnp.sum over the group
+       axis would let the SPMD partitioner rewrite it as local-partial-
+       reduce + collective — reassociating by extent, exactly the drift
+       being removed. Elementwise adds cannot be reassociated.
+    3. NO MESH CONTEXT — callers must NOT wrap calls in `with mesh:`;
+       every placement is carried by explicit NamedShardings. Under the
+       mesh context the partitioner makes extent-dependent sharding
+       choices inside the vmapped backward (observed: low-bit drift in
+       every dense-kernel gradient at dp=2 vs dp=4).
+
+    The price: per-group gradients materialize stacked ([canonical_dp] ×
+    the gradient tree, replicated for the combine), and the loss is
+    computed as sum-of-group-sums / sum-of-group-masks — mathematically
+    the same mean, numerically NOT bit-comparable to make_train_step.
+    Bit-parity is promised among elastic runs sharing a canonical extent
+    and data order, not across step implementations
+    (docs/resilience.md "Elastic training lifecycle").
+
+    ZeRO-1 rides along unchanged: dp-sharded Adam moments make XLA
+    scatter the (replicated, extent-invariant) update and all-gather
+    params back — elementwise, so the resharding never perturbs values.
+    """
+    if canonical_dp < 1:
+        raise ValueError(f'canonical_dp must be >= 1, got {canonical_dp}')
+    dp = mesh.shape.get('dp', 1) if hasattr(mesh, 'shape') else 1
+    if canonical_dp % dp:
+        raise ValueError(
+            f'elastic step: live dp={dp} must divide the canonical '
+            f'extent {canonical_dp} — resize to a divisor (e.g. '
+            f'{canonical_dp}→{canonical_dp // 2}) so the canonical '
+            f'groups tile the surviving devices')
+    model = Transformer(cfg)
+    unboxed_shardings = nn.unbox(state_shardings)
+    replicated = sharding_lib.replicated(mesh)
+    rounds = canonical_dp // dp
+
+    def loss_sums(params, group):
+        logits = model.apply({'params': params}, group['inputs'])
+        logits = logits.astype(jnp.float32)
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits, group['targets'])
+        mask = group.get('mask')
+        mask = (jnp.ones_like(losses) if mask is None
+                else mask.astype(jnp.float32))
+        return jnp.sum(losses * mask), jnp.sum(mask)
+
+    grad_fn = jax.value_and_grad(loss_sums, has_aux=True)
+
+    def fixed_sum(x):
+        # Explicit left-to-right chain over the canonical-group axis:
+        # elementwise adds, which the partitioner cannot reassociate.
+        return functools.reduce(lambda a, b: a + b,
+                                [x[i] for i in range(canonical_dp)])
+
+    def step(state: TrainState, batch):
+        rows = batch['inputs'].shape[0]
+        if rows % canonical_dp:
+            raise ValueError(f'batch {rows} not divisible by '
+                             f'canonical_dp={canonical_dp}')
+        groups = {
+            k: sharding_lib.constrain(
+                v.reshape((dp, rounds, rows // canonical_dp)
+                          + v.shape[1:]),
+                'batch', None, None, 'seq')
+            for k, v in batch.items()
+        }
+
+        def round_fn(_, r):
+            g = {k: jax.lax.dynamic_index_in_dim(v, r, axis=1,
+                                                 keepdims=False)
+                 for k, v in groups.items()}
+            g = {k: sharding_lib.constrain(v, 'batch', None, 'seq')
+                 for k, v in g.items()}
+            (lsum, msum), grads = jax.vmap(grad_fn, in_axes=(None, 0))(
+                state.params, g)
+            return None, (lsum, msum, grads)
+
+        _, (lsums, msums, grads) = jax.lax.scan(
+            round_fn, None, jnp.arange(rounds))
+
+        def canonical(x):
+            # [rounds, dp, ...] -> replicated [canonical_dp, ...] in
+            # group order (group g = device*rounds + round, matching the
+            # device-major batch reshape above). Pure data movement.
+            x = jax.lax.with_sharding_constraint(x, replicated)
+            return jnp.swapaxes(x, 0, 1).reshape((canonical_dp,)
+                                                 + x.shape[2:])
+
+        lsums, msums = canonical(lsums), canonical(msums)
+        grads = jax.tree.map(canonical, grads)
+        total_mask = fixed_sum(msums)
+        loss = fixed_sum(lsums) / total_mask
+        grads = jax.tree.map(
+            lambda g, p: (fixed_sum(g.astype(jnp.float32)) /
+                          total_mask).astype(p.dtype),
+            grads, state.params)
+        # Same anchor as make_train_step: pin the combined gradients to
+        # the PARAMS' placement so the clip/global-norm reductions stay
+        # whole-leaf in both the plain and the ZeRO-1 trainer.
+        grads = jax.lax.with_sharding_constraint(
+            grads, unboxed_shardings.params)
+        new_state = state.apply_gradients(grads=grads)
+        metrics = {
+            'loss': loss,
+            'grad_norm': optax.global_norm(grads),
+            'step': new_state.step,
+        }
+        return new_state, metrics
+
+    return jax.jit(
+        step,
+        in_shardings=(unboxed_shardings, batch_sharding(mesh)),
+        out_shardings=(unboxed_shardings,
+                       {'loss': replicated, 'grad_norm': replicated,
+                        'step': replicated}),
+        donate_argnums=(0,),
+    )
+
+
 def compiled_step_collectives(step_fn, state, batch,
                               dp: Optional[int] = None
                               ) -> Dict[str, Any]:

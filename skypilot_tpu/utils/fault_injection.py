@@ -30,6 +30,17 @@ on the framework's failure-critical paths:
                     response header; a failure simulates a corrupt
                     digest on the wire (routing must fall back to
                     least-loaded, never error)
+    train.step      train/elastic.ElasticTrainLoop — before each train
+                    step dispatch; a failure simulates the slice dying
+                    mid-step (the in-flight step is lost, nothing else)
+    train.save      train/checkpoints.CheckpointManager.save[_within_
+                    deadline] — before a checkpoint save initiates; a
+                    failure simulates a dead checkpoint mount (the run
+                    must fall back to the last committed step)
+    train.notice    train/elastic.PreemptionNotice.deliver — as the
+                    preemption notice reaches the trainer; a failure
+                    simulates a notice lost in delivery (the kill lands
+                    with no final checkpoint)
 
 Disarmed (the default, always in production) a point is a single
 module-level boolean check: no allocation, no locks, no behavior change
@@ -73,6 +84,9 @@ KNOWN_POINTS = (
     'storage.export',
     'storage.import',
     'lb.digest',
+    'train.step',
+    'train.save',
+    'train.notice',
 )
 
 

@@ -1,0 +1,288 @@
+"""Preemption-native elastic training — storm driver.
+
+Run by tests/test_train_elastic.py through the sharded_subprocess
+fixture (8 fake CPU devices), so the SPMD compiles never touch the main
+pytest process's jit caches.
+
+Scenario (ISSUE-11 tentpole; ROADMAP open item 4, arxiv 2004.13336 +
+2011.03641):
+
+1. BASELINE — one unpreempted ElasticTrainLoop incarnation at dp=4
+   (canonical extent 4) trains 12 steps on the zero1 fixture (test-tiny
+   fp32, clipping ACTIVE below the observed grad norms).
+2. STORM — the same 12 steps across six incarnations, under a 3-notice
+   preemption storm with fault injection armed:
+     inc1 dp=4  clean notice → deadline-bounded checkpoint → relaunch
+                at the SURVIVING extent dp=2 (the PR-9 reshard path);
+     inc2 dp=2  clean notice mid-storm (still degraded);
+     inc3 dp=2  `train.step` armed fail:1 — the slice dies MID-STEP
+                with no notice; only the in-flight step re-runs;
+     inc4 dp=2  `train.notice` armed fail:1 — the notice is LOST in
+                delivery, the kill lands with no final checkpoint → the
+                run falls back to the last periodic save;
+     inc5 dp=2  clean notice (the 3rd delivered notice);
+     inc6 dp=4  capacity returns → grow back, run to completion.
+   Pins: each incarnation resumes at the expected extent, the resize
+   lineage records down→up, NO completed step is ever re-trained (zero
+   steps lost beyond the in-flight one — checkpoint-frontier
+   bookkeeping per incident), and every captured step of the storm's
+   loss series — the final loss included — is BIT-IDENTICAL to the
+   baseline (the uncaptured killed-incarnation spans are pinned
+   transitively: any divergence would propagate into every later step).
+3. TORN/CORRUPT — the PR-6 artifact rules applied to checkpoints:
+   truncating the newest checkpoint's largest blob makes
+   restore_latest_valid fall back to the next-older step (counted in
+   skytpu_train_checkpoint_restore_fallbacks_total), and keep-newest-N
+   pruning has kept older steps to fall back TO.
+4. GAUGES — preemptions/resizes counters and the checkpoint-save
+   histogram land in the registry and survive to exposition.
+
+Emits ONE JSON row; the pytest side asserts on it.
+"""
+import dataclasses
+import glob
+import json
+import os
+import sys
+import tempfile
+
+
+def main() -> int:
+    import jax
+
+    from skypilot_tpu.models import get_config
+    from skypilot_tpu.observability import metrics as obs
+    from skypilot_tpu.train import TrainConfig, synthetic_batch
+    from skypilot_tpu.train.checkpoints import CheckpointManager
+    from skypilot_tpu.train.elastic import (ElasticMeta, ElasticTrainLoop,
+                                            PreemptionNotice,
+                                            surviving_extent)
+    from skypilot_tpu.utils import fault_injection
+
+    # Counters increment during the storm — recording must be on before
+    # it starts (gauge re-reads after the fact are separately pinned by
+    # the zero1 driver's late-exporter test).
+    obs.enable()
+
+    cfg = dataclasses.replace(get_config('test-tiny'), dtype='float32',
+                              param_dtype='float32')
+    tc = TrainConfig(warmup_steps=1, total_steps=12, learning_rate=3e-2,
+                     grad_clip_norm=0.5)
+    total_steps = 12
+    batches = [synthetic_batch(jax.random.PRNGKey(i), 16, 64,
+                               cfg.vocab_size)
+               for i in range(total_steps)]
+
+    def batch_for(step):
+        return batches[step]
+
+    # --- 1: unpreempted baseline --------------------------------------
+    base_dir = tempfile.mkdtemp(prefix='skytpu-elastic-base-')
+    base_loop = ElasticTrainLoop(cfg, tc, base_dir, canonical_dp=4)
+    base = base_loop.run(4, batch_for, total_steps)
+    clip_active = all(norm > tc.grad_clip_norm
+                      for _, norm in base.series[:3])
+
+    # --- 2: the 3-notice storm ----------------------------------------
+    storm_dir = tempfile.mkdtemp(prefix='skytpu-elastic-storm-')
+    loop = ElasticTrainLoop(cfg, tc, storm_dir, canonical_dp=4)
+    notice = PreemptionNotice()
+    dp_survive = surviving_extent(4, 2)  # 2 of the 4 chips survive
+    series = {}
+    incarnations = []
+    frontiers = []
+
+    def frontier():
+        mgr = CheckpointManager(storm_dir)
+        step = mgr.latest_step()
+        mgr.close()
+        return step
+
+    def record(result):
+        start = result.next_step - len(result.series)
+        for i, v in enumerate(result.series):
+            series[start + i] = v
+        incarnations.append({
+            'dp': result.dp, 'start': start, 'next': result.next_step,
+            'preempted': result.preempted,
+            'committed': result.checkpoint_committed,
+            'resume_latency_s': round(result.resume_latency_s, 3),
+        })
+
+    def trigger_notice_at(step):
+        def f(s):
+            if s == step:
+                notice.deliver()
+            return batches[s]
+        return f
+
+    # inc1 @ dp=4: clean notice after step 2 completes → frontier 3.
+    notice.clear()
+    record(loop.run(4, trigger_notice_at(2), total_steps, notice=notice))
+    frontiers.append(frontier())
+
+    # inc2 @ dp=2: clean notice after step 4 completes → frontier 5.
+    notice.clear()
+    record(loop.run(dp_survive, trigger_notice_at(4), total_steps,
+                    notice=notice))
+    frontiers.append(frontier())
+
+    # inc3 @ dp=2: train.step armed mid-run — the slice dies IN-FLIGHT
+    # at step 6 with no notice; step 5 committed → frontier 6.
+    def arm_midstep_kill_at(step):
+        def f(s):
+            if s == step:
+                fault_injection.arm('train.step', 'fail:1')
+            return batches[s]
+        return f
+
+    killed_midstep = False
+    notice.clear()
+    try:
+        loop.run(dp_survive, arm_midstep_kill_at(5), total_steps,
+                 notice=notice)
+    except fault_injection.InjectedFault:
+        killed_midstep = True
+    fault_injection.disarm_all()
+    frontiers.append(frontier())
+
+    # inc4 @ dp=2: the notice is LOST in delivery (train.notice armed);
+    # the kill lands one step later with no final checkpoint — the last
+    # periodic save (step 8, after step 7 completed) is the fallback.
+    fault_injection.arm('train.notice', 'fail:1')
+    notice_lost = False
+    notice.clear()
+
+    def deliver_lost_at(step):
+        def f(s):
+            if s == step:
+                try:
+                    notice.deliver()
+                except fault_injection.InjectedFault:
+                    nonlocal notice_lost
+                    notice_lost = True
+                    fault_injection.arm('train.step', 'fail:1')
+            return batches[s]
+        return f
+
+    killed_after_lost_notice = False
+    try:
+        loop.run(dp_survive, deliver_lost_at(7), total_steps,
+                 notice=notice)
+    except fault_injection.InjectedFault:
+        killed_after_lost_notice = True
+    fault_injection.disarm_all()
+    frontiers.append(frontier())
+
+    # inc5 @ dp=2: the 3rd delivered notice, after step 9 → frontier 10.
+    notice.clear()
+    record(loop.run(dp_survive, trigger_notice_at(9), total_steps,
+                    notice=notice))
+    frontiers.append(frontier())
+
+    # inc6 @ dp=4: capacity returned — grow back and run to the end.
+    notice.clear()
+    record(loop.run(4, batch_for, total_steps, notice=notice))
+    frontiers.append(frontier())
+
+    # Zero completed steps re-trained: each incident's resume point
+    # equals the exact frontier the previous incarnation reached.
+    expected_frontiers = [3, 5, 6, 8, 10, total_steps]
+    grew_back = incarnations[-1]['dp'] == 4
+    meta = ElasticMeta.load(storm_dir)
+    lineage_dirs = [(e['from_dp'], e['to_dp']) for e in meta.lineage]
+
+    mismatches = [s for s, v in series.items() if v != base.series[s]]
+    final_parity = series.get(total_steps - 1) == base.series[-1]
+
+    # --- 3: torn/corrupt checkpoint edges -----------------------------
+    def blobs(step):
+        return sorted(
+            (p for p in glob.glob(os.path.join(storm_dir, str(step),
+                                               '**'), recursive=True)
+             if os.path.isfile(p) and os.sep + 'd' + os.sep in p),
+            key=os.path.getsize)
+
+    mgr = CheckpointManager(storm_dir)
+    kept_steps = mgr.all_steps()
+    newest = kept_steps[-1]
+    victim = blobs(newest)[-1]
+    with open(victim, 'r+b') as f:
+        f.truncate(os.path.getsize(victim) // 2)
+
+    from skypilot_tpu.parallel import train_mesh
+    from skypilot_tpu.train import create_sharded_state
+    tmpl_state, _ = create_sharded_state(cfg, train_mesh(4),
+                                         jax.random.PRNGKey(0), tc,
+                                         zero_sharding=True)
+    _, fb_step = mgr.restore_latest_valid(tmpl_state)
+    corrupt_fell_back = fb_step in kept_steps and 0 < fb_step < newest
+    pruning_kept_fallbacks = len(kept_steps) >= 2
+    mgr.close()
+
+    # --- 4: exposition ------------------------------------------------
+    from skypilot_tpu.observability.exposition import (
+        generate_latest, parse_prometheus_text)
+    families = parse_prometheus_text(generate_latest())
+
+    def sample(name, labels=(), sample_name=None):
+        fam = families.get(name)
+        if not fam:
+            return None
+        return fam['samples'].get((sample_name or name, labels))
+
+    preemptions = sample('skytpu_train_preemptions_total')
+    resizes_down = sample('skytpu_train_elastic_resizes_total',
+                          (('direction', 'down'),))
+    resizes_up = sample('skytpu_train_elastic_resizes_total',
+                        (('direction', 'up'),))
+    save_count = sample('skytpu_train_checkpoint_save_seconds',
+                        sample_name='skytpu_train_checkpoint_save_'
+                        'seconds_count')
+    fallbacks = sample('skytpu_train_checkpoint_restore_fallbacks_total')
+
+    row = {
+        'clip_active': clip_active,
+        'dp_survive': dp_survive,
+        'baseline_final': base.series[-1],
+        'incarnations': incarnations,
+        'frontiers': frontiers,
+        'expected_frontiers': expected_frontiers,
+        'killed_midstep': killed_midstep,
+        'notice_lost': notice_lost,
+        'killed_after_lost_notice': killed_after_lost_notice,
+        'grew_back': grew_back,
+        'lineage': lineage_dirs,
+        'captured_steps': sorted(series),
+        'parity_mismatches': mismatches,
+        'final_parity': final_parity,
+        'kept_steps': kept_steps,
+        'corrupt_fallback_step': fb_step,
+        'corrupt_fell_back': corrupt_fell_back,
+        'pruning_kept_fallbacks': pruning_kept_fallbacks,
+        'gauge_preemptions': preemptions,
+        'gauge_resizes_down': resizes_down,
+        'gauge_resizes_up': resizes_up,
+        'gauge_save_count': save_count,
+        'gauge_restore_fallbacks': fallbacks,
+    }
+    row['ok'] = bool(
+        clip_active and dp_survive == 2
+        and not mismatches and final_parity
+        and killed_midstep and notice_lost and killed_after_lost_notice
+        and frontiers == expected_frontiers
+        and all(inc['committed'] for inc in incarnations)
+        and [inc['dp'] for inc in incarnations] == [4, 2, 2, 4]
+        and grew_back
+        and lineage_dirs == [(4, 2), (2, 4)]
+        and corrupt_fell_back and pruning_kept_fallbacks
+        and preemptions == 3.0
+        and resizes_down == 1.0 and resizes_up == 1.0
+        and (save_count or 0) >= 1.0
+        and (fallbacks or 0) >= 1.0)
+    print(json.dumps(row))
+    return 0 if row['ok'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -198,6 +198,53 @@ class TestFleetDryrunDispatch:
         assert bench.main() == 0
         assert calls['dry'] == ['--dryrun-train-zero1']
 
+    def test_dryrun_train_elastic_skips_tpu_preflight(self, monkeypatch):
+        """--dryrun-train-elastic is the MULTICHIP elastic-training
+        proxy (the chip unreachable is its whole reason to exist): the
+        no-preflight dryrun supervisor, never the TPU probe ladder."""
+        bench = _load_bench()
+        calls = {}
+
+        def fake_dryrun(argv):
+            calls['dry'] = argv
+            return 0
+
+        monkeypatch.setattr(bench, '_supervise_dryrun', fake_dryrun)
+        monkeypatch.setattr(
+            bench, '_supervise',
+            lambda argv: (_ for _ in ()).throw(
+                AssertionError('TPU preflight path taken')))
+        monkeypatch.setattr(sys, 'argv',
+                            ['bench.py', '--dryrun-train-elastic'])
+        assert bench.main() == 0
+        assert calls['dry'] == ['--dryrun-train-elastic']
+
+    def test_dryrun_train_elastic_skip_on_too_few_devices(
+            self, monkeypatch, capsys):
+        """An incompatible device count is a deterministic verdict: the
+        worker emits the structured {"skipped": true} line and rc=3
+        (the supervisor forwards it verbatim, never the retry ladder)."""
+        bench = _load_bench()
+        monkeypatch.setitem(
+            sys.modules, '__graft_entry__',
+            type(sys)('__graft_entry__'))
+        sys.modules['__graft_entry__']._force_cpu_devices = \
+            lambda n: None
+
+        class _FakeJax:
+            @staticmethod
+            def devices():
+                return [object()] * 2  # fewer than the 8 the row needs
+
+        monkeypatch.setitem(sys.modules, 'jax', _FakeJax())
+        rc = bench._dryrun_train_elastic(
+            bench._parse_args(['--dryrun-train-elastic', '--worker']))
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        row = json.loads(out)
+        assert rc == 3
+        assert row['skipped'] is True
+        assert row['combo'] == {'canonical_dp': 4, 'n_devices': 2}
+
     def test_dryrun_train_zero1_skip_on_too_few_devices(
             self, monkeypatch, capsys):
         """An incompatible device count is a deterministic verdict: the

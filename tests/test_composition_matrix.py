@@ -30,7 +30,13 @@ import jax
 from skypilot_tpu import exceptions
 from skypilot_tpu.utils import fault_injection
 
-PROMPT = [3, 1, 4, 1, 5, 9, 2, 6]
+# Chosen so the int8-KV reference stream visibly DIVERGES from fp within
+# a few tokens (the refs-fixture sanity check: int8 must demonstrably
+# engage). The mesh-invariant init landed by parallel/
+# (jax_threefry_partitionable) changed the seeded test-tiny weights, and
+# with the previous prompt ([3,1,4,1,5,9,2,6], pi digits) the int8
+# rounding no longer flipped any greedy argmax in the whole window.
+PROMPT = [9, 9, 8, 8, 7, 7, 6, 6]
 
 
 def _cfg(**kw):

@@ -89,7 +89,7 @@ class TestStrategyRegistry:
 
     def test_registry_and_default(self):
         assert set(recovery_strategy.RECOVERY_STRATEGIES) == {
-            'FAILOVER', 'EAGER_NEXT_REGION'
+            'FAILOVER', 'EAGER_NEXT_REGION', 'ELASTIC'
         }
         ex = recovery_strategy.StrategyExecutor.make('c', _task())
         assert ex.NAME == 'EAGER_NEXT_REGION'
@@ -111,6 +111,279 @@ class TestStrategyRegistry:
         })
         with pytest.raises(ValueError, match='Unknown job_recovery'):
             recovery_strategy.StrategyExecutor.make('c', task)
+
+
+class TestStrategyRetryLadder:
+    """The strategy executors' retries ride the shared utils/retry.py
+    jittered-backoff ladder (ISSUE-11 satellite: PR 1 converted
+    jobs/remote.py, the executors still hand-rolled fixed sleeps)."""
+
+    def _spy(self, monkeypatch, sleeps):
+        from skypilot_tpu.utils import retry as retry_lib
+        real = retry_lib.call_with_retry
+        seen = {}
+
+        def spy(fn, **kw):
+            seen.update(kw)
+            kw.setdefault('sleep', sleeps.append)
+            return real(fn, **kw)
+
+        monkeypatch.setattr(recovery_strategy.retry_lib,
+                            'call_with_retry', spy)
+        return seen
+
+    def test_terminate_rides_shared_ladder(self, monkeypatch):
+        sleeps = []
+        seen = self._spy(monkeypatch, sleeps)
+        monkeypatch.setattr(
+            global_user_state, 'get_cluster_from_name',
+            lambda name: {'handle': object()})
+        import skypilot_tpu.core as core
+        monkeypatch.setattr(
+            core, 'down',
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError('rpc')))
+        strat = recovery_strategy.StrategyExecutor.make('rl-cl', _task())
+        from skypilot_tpu import exceptions
+        with pytest.raises(exceptions.ClusterTeardownError):
+            strat.terminate_cluster()
+        assert seen['attempts'] == 3
+        assert len(sleeps) == 2          # backoff between the 3 attempts
+        assert all(s > 0 for s in sleeps)
+        assert sleeps[0] != sleeps[1]    # exponential + jitter, not fixed
+
+    def test_launch_rides_shared_ladder(self, monkeypatch):
+        sleeps = []
+        seen = self._spy(monkeypatch, sleeps)
+        from skypilot_tpu import exceptions, execution
+        monkeypatch.setattr(
+            execution, 'launch',
+            lambda *a, **k: (_ for _ in ()).throw(
+                exceptions.ResourcesUnavailableError('stockout')))
+        strat = recovery_strategy.StrategyExecutor.make('rl2-cl', _task())
+        with pytest.raises(exceptions.ManagedJobReachedMaxRetriesError):
+            strat._launch()  # pylint: disable=protected-access
+        assert seen['attempts'] == jobs_constants.MAX_LAUNCH_RETRIES
+        assert len(sleeps) == jobs_constants.MAX_LAUNCH_RETRIES - 1
+
+    def test_precheck_error_never_retried(self, monkeypatch):
+        sleeps = []
+        self._spy(monkeypatch, sleeps)
+        from skypilot_tpu import exceptions, execution
+        calls = {'n': 0}
+
+        def boom(*a, **k):
+            calls['n'] += 1
+            raise exceptions.ProvisionPrechecksError('bad spec')
+
+        monkeypatch.setattr(execution, 'launch', boom)
+        strat = recovery_strategy.StrategyExecutor.make('rl3-cl', _task())
+        with pytest.raises(exceptions.ProvisionPrechecksError):
+            strat._launch()  # pylint: disable=protected-access
+        assert calls['n'] == 1 and sleeps == []
+
+
+def _elastic_task(acc='tpu-v5e-8', min_chips=None, name='el'):
+    args = ({'elastic_min_chips': min_chips}
+            if min_chips is not None else None)
+    task = sky.Task(name=name, run='sleep 120')
+    task.set_resources({sky.Resources(cloud='fake', accelerators=acc,
+                                      job_recovery='elastic',
+                                      accelerator_args=args)})
+    return task
+
+
+def _fake_capacity(monkeypatch, max_chips, launches):
+    """execution.launch stub: capacity exists only for slices up to
+    `max_chips`; every attempt's chip count is recorded."""
+    from skypilot_tpu import exceptions, execution, topology
+
+    def fake_launch(task, cluster_name=None, **kwargs):
+        r = next(iter(task.resources))
+        chips = topology.parse_accelerator(r.accelerators).chips
+        launches.append(chips)
+        if chips > max_chips:
+            raise exceptions.ResourcesUnavailableError('stockout')
+        return 1, object()
+
+    monkeypatch.setattr(execution, 'launch', fake_launch)
+
+
+class TestElasticStrategy:
+    """ELASTIC recovery: relaunch at the surviving extent instead of
+    waiting for full capacity, lineage recorded, grow-back when
+    capacity returns (ISSUE-11 tentpole, jobs side)."""
+
+    def _strategy(self, **kwargs):
+        job_id = jobs_state.set_job_info('el', '/tmp/dag.yaml')
+        jobs_state.set_pending(job_id, 0, 'el', 'tpu-v5e-8')
+        task = _elastic_task(**{k: v for k, v in kwargs.items()
+                                if k in ('acc', 'min_chips')})
+        return recovery_strategy.StrategyExecutor.make(
+            'el-cl', task, job_id=job_id, task_id=0), job_id
+
+    def test_selected_by_job_recovery(self):
+        strat, _ = self._strategy()
+        assert strat.NAME == 'ELASTIC'
+        assert strat.current_chips == 8 and not strat.degraded()
+
+    def test_recover_steps_down_to_surviving_extent(self, monkeypatch):
+        launches = []
+        _fake_capacity(monkeypatch, max_chips=2, launches=launches)
+        strat, job_id = self._strategy()
+        strat.recover()
+        # Full extent once, then the halving ladder — one attempt per
+        # rung, capacity decides: 8 → 4 → 2 (success).
+        assert launches == [8, 4, 2]
+        assert strat.current_chips == 2 and strat.degraded()
+        assert strat.task.envs[
+            recovery_strategy.ELASTIC_NUM_CHIPS_ENV_VAR] == '2'
+        assert jobs_state.get_elastic_extent(job_id, 0) == 2
+        lineage = jobs_state.get_preemption_lineage(job_id, 0)
+        assert lineage[-1]['reason'] == 'preemption'
+        assert lineage[-1]['from_chips'] == 8
+        assert lineage[-1]['to_chips'] == 2
+
+    def test_min_chips_floor_gets_full_retry_ladder(self, monkeypatch):
+        monkeypatch.setattr(jobs_constants, 'MAX_LAUNCH_RETRIES', 2)
+        launches = []
+        _fake_capacity(monkeypatch, max_chips=0, launches=launches)
+        strat, _ = self._strategy(min_chips=4)
+        from skypilot_tpu import exceptions
+        with pytest.raises(exceptions.ManagedJobReachedMaxRetriesError):
+            strat.recover()
+        # 8 once, then the floor rung (4) gets the remaining budget —
+        # never a 2- or 1-chip slice below the floor.
+        assert launches == [8, 4, 4]
+        assert min(launches) >= 4
+
+    def test_try_grow_returns_to_target(self, monkeypatch):
+        launches = []
+        _fake_capacity(monkeypatch, max_chips=2, launches=launches)
+        strat, job_id = self._strategy()
+        strat.recover()          # degraded to 2 chips
+        launches.clear()
+        _fake_capacity(monkeypatch, max_chips=8, launches=launches)
+        assert strat.try_grow()
+        assert launches == [8]
+        assert strat.current_chips == 8 and not strat.degraded()
+        lineage = jobs_state.get_preemption_lineage(job_id, 0)
+        assert lineage[-1]['reason'] == 'grow'
+        assert lineage[-1]['from_chips'] == 2
+        assert lineage[-1]['to_chips'] == 8
+        assert strat.task.envs[
+            recovery_strategy.ELASTIC_NUM_CHIPS_ENV_VAR] == '8'
+
+    def test_failed_grow_falls_back_to_degraded_extent(self, monkeypatch):
+        launches = []
+        _fake_capacity(monkeypatch, max_chips=2, launches=launches)
+        strat, job_id = self._strategy()
+        strat.recover()
+        launches.clear()
+        assert not strat.try_grow()
+        # Target probe failed → straight back to the degraded extent;
+        # the job keeps training either way.
+        assert launches == [8, 2]
+        assert strat.current_chips == 2
+        assert jobs_state.get_preemption_lineage(job_id, 0)[-1][
+            'reason'] == 'grow_failed'
+
+    def test_ladder_rungs_always_divide_the_target(self):
+        """A relaunched --elastic run refuses a dp that does not divide
+        the canonical extent, and a rung with no valid physical
+        topology would crash the Resources copy before any attempt —
+        every ladder rung must be a divisor of the target AND a real
+        slice for the generation."""
+        strat, _ = self._strategy()          # tpu-v5e-8
+        assert strat._extent_ladder() == [4, 2, 1]  # pylint: disable=protected-access
+        task = _elastic_task(acc='tpu-v5p-24', min_chips=2)  # 12 chips
+        strat12 = recovery_strategy.StrategyExecutor.make('el12-cl', task)
+        ladder = strat12._extent_ladder()  # pylint: disable=protected-access
+        assert ladder
+        assert all(12 % c == 0 and c >= 2 for c in ladder)
+        from skypilot_tpu import topology
+        for c in ladder:  # every rung is launchable as-is
+            topology.parse_accelerator(
+                strat12._accelerator_for(c))  # pylint: disable=protected-access
+
+    def test_try_grow_noop_at_target(self):
+        strat, _ = self._strategy()
+        assert not strat.try_grow()
+
+    def test_non_tpu_task_rejected(self):
+        task = sky.Task(name='cpu', run='true')
+        task.set_resources({sky.Resources(cloud='fake',
+                                          job_recovery='elastic')})
+        with pytest.raises(ValueError, match='TPU accelerator'):
+            recovery_strategy.StrategyExecutor.make('x-cl', task)
+
+
+class TestPreemptedExitContract:
+    """`train.run --elastic` exits 75 after its notice-time checkpoint;
+    the agent driver maps rc 75 to the PREEMPTED job status and the
+    controller routes it into RECOVERY — never the user-failure restart
+    budget, even when the slice outlives the notice window."""
+
+    def test_preempted_is_a_terminal_job_status(self):
+        from skypilot_tpu.agent import job_lib
+        assert job_lib.JobStatus.PREEMPTED.is_terminal()
+
+    def test_driver_maps_exit_75_to_preempted(self):
+        """The rc→status mapping in agent/driver.py main: any host
+        exiting 75 marks the job PREEMPTED (not FAILED)."""
+        import inspect
+
+        from skypilot_tpu.agent import driver
+        src = inspect.getsource(driver.main)
+        assert 'rc == 75' in src and 'PREEMPTED' in src
+
+    def test_controller_recovers_on_preempted_status(
+            self, tmp_path, monkeypatch):
+        import yaml
+
+        from skypilot_tpu.jobs import controller as controller_mod
+
+        dag_yaml = tmp_path / 'dag.yaml'
+        dag_yaml.write_text(yaml.safe_dump(
+            {'name': 'el', 'run': 'sleep 120',
+             'resources': {'cloud': 'fake',
+                           'accelerators': 'tpu-v5e-1'}}))
+        job_id = jobs_state.set_job_info('el', str(dag_yaml))
+        jobs_state.set_pending(job_id, 0, 'el', 'tpu-v5e-1')
+
+        class _Stub:
+            cluster_name = 'el-1'
+            recovered = 0
+
+            def launch(self):
+                return 0.0
+
+            def recover(self):
+                _Stub.recovered += 1
+                return 0.0
+
+            def terminate_cluster(self, max_retry=3):
+                pass
+
+            def should_restart_on_failure(self):
+                raise AssertionError(
+                    'PREEMPTED must not consume the user-failure '
+                    'restart budget')
+
+        monkeypatch.setattr(
+            recovery_strategy.StrategyExecutor, 'make',
+            classmethod(lambda cls, *a, **k: _Stub()))
+        ctrl = controller_mod.JobsController(job_id, str(dag_yaml))
+        statuses = iter(['PREEMPTED', 'SUCCEEDED'])
+        monkeypatch.setattr(
+            ctrl, '_job_status_on_cluster',
+            lambda name: next(statuses, 'SUCCEEDED'))
+        monkeypatch.setattr(ctrl, '_cluster_is_up', lambda name: True)
+        task = next(iter(ctrl.dag.topological_order()))
+        assert ctrl._run_one_task(0, task)  # pylint: disable=protected-access
+        assert _Stub.recovered == 1
+        recs = jobs_state.get_task_records(job_id)
+        assert recs[0]['status'] == ManagedJobStatus.SUCCEEDED
+        assert recs[0]['recovery_count'] == 1
 
 
 class TestManagedJobEndToEnd:
