@@ -125,6 +125,14 @@ def _parse_args(argv=None):
                              'p50/p99 routed TTFT per policy, and pins '
                              'that miss/stale/corrupt-digest routing '
                              'falls back instead of erroring')
+    parser.add_argument('--dryrun-lint', action='store_true',
+                        help='emit the SKYLINT proxy row (no chip, no '
+                             'jax): run the AST correctness analyzer '
+                             '(skytpu lint, docs/static-analysis.md) '
+                             'over skypilot_tpu/ and report unwaived '
+                             'findings — 0 is the pinned bar, so the '
+                             'dryrun supervisor surfaces lint '
+                             'regressions next to the perf proxies')
     parser.add_argument('--no-serve-row', action='store_true',
                         help='skip the serve row in the default sweep')
     parser.add_argument('--quantize', default=None, choices=['int8'],
@@ -1098,7 +1106,38 @@ def _tune_attn(args) -> dict:
     return best
 
 
+def _dryrun_lint(args) -> int:  # pylint: disable=unused-argument
+    """SKYLINT: the static-analysis proxy row (pure CPU stdlib — no
+    jax, no devices, no fake-device env). Mirrors the MULTICHIP_r0x
+    dryrun contract: ONE JSON row, ok == zero unwaived findings, the
+    per-checker breakdown as extra keys so a regression names the
+    checker that caught it."""
+    from skypilot_tpu import analysis
+    try:
+        result = analysis.run_lint()
+    except analysis.LintError as e:
+        _emit_skip(f'skylint internal error: {e}')
+        return 2
+    summary = result.to_dict()['summary']
+    row = {
+        'metric': 'SKYLINT dryrun',
+        'value': float(summary['unwaived']),
+        'unit': 'unwaived findings',
+        'vs_baseline': 0.0,            # the pinned bar IS zero
+        'ok': result.ok,
+        'skipped': False,
+        'checkers': len(result.selected),
+        'waived': summary['waived'],
+        'by_checker': summary['by_checker'],
+        'duration_s': summary['duration_s'],
+    }
+    print(json.dumps(row))
+    return 0 if result.ok else 1
+
+
 def _worker(args) -> int:
+    if args.dryrun_lint:
+        return _dryrun_lint(args)
     if args.dryrun_serve_sharded:
         # CPU-only by design; forces its own fake-device backend
         # BEFORE any jax.devices() call.
@@ -1278,6 +1317,10 @@ def main() -> int:
     if args.worker:
         return _worker(args)
     argv = [a for a in sys.argv[1:] if a != '--worker']
+    if args.dryrun_lint:
+        # No subprocess, no fake devices: the analyzer is stdlib-only
+        # and deterministic — run it right here.
+        return _dryrun_lint(args)
     if (args.dryrun_serve_sharded or args.dryrun_serve_fleet or
             args.dryrun_train_zero1 or args.dryrun_train_elastic):
         return _supervise_dryrun(argv)

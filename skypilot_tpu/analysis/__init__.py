@@ -1,0 +1,38 @@
+"""skylint — the AST-based correctness analyzer behind `skytpu lint`.
+
+Checkers (docs/static-analysis.md has the catalog with rationale):
+
+- hot-path-host-sync   no host syncs reachable from the decode tick /
+                       train-step factories outside the audited funnels
+- lock-discipline      lock-guarded attributes never mutated lock-free
+- wall-clock-duration  time.time() deltas are not durations
+- sharding-containment PartitionSpec strings / collective axis names /
+                       the rule table confined to parallel/
+- injection-drift      fault points ↔ KNOWN_POINTS ↔ tests ↔ docs
+- metrics-drift        skytpu_* registrations ↔ docs/observability.md
+
+Usage: `skytpu lint [--select ids] [--json]`, or in-process:
+
+    from skypilot_tpu import analysis
+    result = analysis.run_lint()
+    assert result.ok, '\\n'.join(map(str, result.unwaived))
+
+Reviewed debt lives in analysis/waivers.toml; the tier-1 pin
+(tests/test_skylint.py) holds the real tree at zero unwaived
+findings.
+"""
+from skypilot_tpu.analysis.core import (Checker, Finding, LintError,
+                                        LintResult, ProjectTree,
+                                        all_checker_ids, register,
+                                        run_lint)
+
+__all__ = [
+    'Checker',
+    'Finding',
+    'LintError',
+    'LintResult',
+    'ProjectTree',
+    'all_checker_ids',
+    'register',
+    'run_lint',
+]

@@ -1,0 +1,192 @@
+"""A conservative intra-package call graph for the hot-path checker.
+
+Indexes every function/method in the tree by qualified name, then
+resolves three call shapes from each body:
+
+- ``self.m(...)`` / ``cls.m(...)`` → methods of the enclosing class
+  (plus base classes resolvable by name within the package);
+- ``f(...)`` → a function in the same module, a symbol imported from
+  a package module, or a package class (whose ``__init__`` is
+  followed);
+- ``mod.f(...)`` → a function in an imported package module.
+
+Unresolvable calls (stdlib, jax, dynamic dispatch, callbacks passed
+as values) are simply not edges — the reachable set under-approximates
+rather than exploding, which is the right polarity for a checker that
+pins *zero* findings on the hot path.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis.core import (ImportMap, Module, ProjectTree,
+                                        dotted_of)
+
+FuncKey = Tuple[str, str]          # (module rel, qualname-in-module)
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    module: Module
+    qualname: str                  # 'make_train_step' or 'Cls.meth'
+    node: ast.AST                  # FunctionDef / AsyncFunctionDef
+    class_name: Optional[str]
+
+    @property
+    def key(self) -> FuncKey:
+        return (self.module.rel, self.qualname)
+
+
+class CallGraph:
+
+    def __init__(self, tree: ProjectTree) -> None:
+        self.tree = tree
+        self.functions: Dict[FuncKey, FuncInfo] = {}
+        self.imports: Dict[str, ImportMap] = {}
+        # class name -> (module rel, base-class names) for self-call
+        # resolution through single inheritance inside the package.
+        self.class_bases: Dict[Tuple[str, str], List[str]] = {}
+        self._by_dotted: Dict[str, Module] = {}
+        for mod in tree.modules.values():
+            self._by_dotted[mod.dotted] = mod
+            self.imports[mod.rel] = tree.import_map(mod)
+            self._index_module(mod)
+
+    def _index_module(self, mod: Module) -> None:
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = FuncInfo(mod, node.name, node, None)
+                self.functions[info.key] = info
+            elif isinstance(node, ast.ClassDef):
+                bases = [dotted_of(b) for b in node.bases]
+                self.class_bases[(mod.rel, node.name)] = [
+                    b for b in bases if b]
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        info = FuncInfo(
+                            mod, f'{node.name}.{item.name}', item,
+                            node.name)
+                        self.functions[info.key] = info
+
+    # -- resolution --
+
+    def find_roots(self, root_qualnames: Iterable[str]) -> \
+            List[FuncInfo]:
+        """Functions whose module-level qualname matches one of
+        `root_qualnames` ('Cls.meth' or 'func'), wherever defined."""
+        wanted = set(root_qualnames)
+        return [info for info in self.functions.values()
+                if info.qualname in wanted]
+
+    def _module_for_dotted(self, dotted: str) -> Optional[Module]:
+        return self._by_dotted.get(dotted)
+
+    def _resolve_in_module(self, mod: Module, name: str) -> \
+            List[FuncInfo]:
+        """`name` as a function or class constructor in `mod`."""
+        info = self.functions.get((mod.rel, name))
+        if info is not None:
+            return [info]
+        init = self.functions.get((mod.rel, f'{name}.__init__'))
+        if init is not None:
+            return [init]
+        return []
+
+    def _resolve_method(self, mod: Module, class_name: str,
+                        method: str, seen: Optional[Set] = None) -> \
+            List[FuncInfo]:
+        seen = seen or set()
+        if (mod.rel, class_name) in seen:
+            return []
+        seen.add((mod.rel, class_name))
+        info = self.functions.get(
+            (mod.rel, f'{class_name}.{method}'))
+        if info is not None:
+            return [info]
+        for base in self.class_bases.get((mod.rel, class_name), []):
+            base_name = base.split('.')[-1]
+            base_mod = mod
+            imports = self.imports[mod.rel]
+            if base_name in imports.symbols:
+                prefix, sym = imports.symbols[base_name]
+                resolved = self._module_for_dotted(prefix)
+                if resolved is not None:
+                    base_mod, base_name = resolved, sym
+            found = self._resolve_method(base_mod, base_name, method,
+                                         seen)
+            if found:
+                return found
+        return []
+
+    def callees(self, info: FuncInfo) -> List[FuncInfo]:
+        mod = info.module
+        imports = self.imports[mod.rel]
+        out: List[FuncInfo] = []
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                base = func.value
+                if isinstance(base, ast.Name) and \
+                        base.id in ('self', 'cls') and info.class_name:
+                    out.extend(self._resolve_method(
+                        mod, info.class_name, func.attr))
+                    continue
+                chain = dotted_of(base)
+                if chain is None:
+                    continue
+                head, _, rest = chain.partition('.')
+                target = imports.resolve_module(head)
+                if target is None:
+                    continue
+                dotted = f'{target}.{rest}' if rest else target
+                target_mod = self._module_for_dotted(dotted)
+                if target_mod is not None:
+                    out.extend(self._resolve_in_module(
+                        target_mod, func.attr))
+            elif isinstance(func, ast.Name):
+                name = func.id
+                if name in imports.symbols:
+                    prefix, sym = imports.symbols[name]
+                    target_mod = self._module_for_dotted(prefix)
+                    if target_mod is not None:
+                        out.extend(self._resolve_in_module(
+                            target_mod, sym))
+                        continue
+                    # `from pkg.mod import name` where pkg.mod.name is
+                    # itself a module was handled via resolve_module.
+                    target_mod = self._module_for_dotted(
+                        f'{prefix}.{sym}' if prefix else sym)
+                    if target_mod is not None:
+                        continue   # module call like mod(...) — n/a
+                else:
+                    out.extend(self._resolve_in_module(mod, name))
+        return out
+
+    def reachable(self, root_qualnames: Iterable[str],
+                  stop: Iterable[str] = ()) -> \
+            Dict[FuncKey, Tuple[FuncInfo, str]]:
+        """BFS closure from the named roots. `stop` names functions
+        (by bare name or qualname) whose bodies are NOT descended
+        into — the audited funnels. Returns key -> (info, root) where
+        root is the qualname that first reached it."""
+        stop_set = set(stop)
+        out: Dict[FuncKey, Tuple[FuncInfo, str]] = {}
+        frontier = [(info, info.qualname)
+                    for info in self.find_roots(root_qualnames)]
+        while frontier:
+            info, root = frontier.pop()
+            if info.key in out:
+                continue
+            short = info.qualname.split('.')[-1]
+            if short in stop_set or info.qualname in stop_set:
+                continue
+            out[info.key] = (info, root)
+            for callee in self.callees(info):
+                if callee.key not in out:
+                    frontier.append((callee, root))
+        return out

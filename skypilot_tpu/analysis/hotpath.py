@@ -1,0 +1,190 @@
+"""hot-path-host-sync: no host⇄device synchronization on the decode
+tick or the train-step factories, outside the audited funnels.
+
+The device-resident decode loop (PR 5's async ring) and the jitted
+train step live or die on never blocking the host: one stray
+`np.asarray(device_value)`, `jax.device_get`, `.block_until_ready()`
+or `float(jnp.…)` serializes the pipeline the profiler worked to
+overlap (the Gemma-on-TPU comparison attributes most of the TPU/GPU
+gap to exactly this class of host-synchronization compounding).
+
+Rules, applied to every function in the call graph reachable from
+`ContinuousBatchingEngine._tick`, `make_train_step`, and
+`make_elastic_train_step`:
+
+- `jax.device_get(...)`, `jax.device_put(...)`, `jnp.asarray(...)`,
+  `jnp.array(...)` → flagged (raw transfers; uploads go through the
+  `_upload` funnel, downloads through `_land`).
+- `.block_until_ready()` / `.item()` → flagged (host blocks).
+- `np.asarray(x)` / `np.array(x)` → flagged unless `x` is a host
+  literal (list/tuple/comprehension/constant): in hot-path code a
+  bare asarray of a name is how device values sneak to host.
+- `float(x)` / `int(x)` → flagged when `x` is device-sourced: its
+  expression contains a `jax.*`/`jnp.*` call, or a local name
+  assigned from one in the same function.
+
+Allowlist: the documented funnels `_upload` and `_land` (their bodies
+are not descended into, and a value passing through them launders to
+host for the dataflow rule) and `copy_to_host_async` (the async
+transfer the ring protocol is built on).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from skypilot_tpu.analysis import callgraph
+from skypilot_tpu.analysis.core import (Checker, Finding, ImportMap,
+                                        ProjectTree, dotted_of,
+                                        register, resolves_to)
+
+HOT_ROOTS = ('ContinuousBatchingEngine._tick', 'make_train_step',
+             'make_elastic_train_step')
+ALLOWED_FUNNELS = ('_upload', '_land')
+ALLOWED_METHODS = ('copy_to_host_async',)
+_BLOCKING_METHODS = ('block_until_ready', 'item')
+_RAW_TRANSFERS = ('jax.device_get', 'jax.device_put',
+                  'jax.numpy.asarray', 'jax.numpy.array',
+                  'jax.numpy.device_put', 'jax.block_until_ready')
+_NP_LANDINGS = ('numpy.asarray', 'numpy.array')
+_HOST_LITERALS = (ast.List, ast.Tuple, ast.ListComp, ast.Constant,
+                  ast.Dict, ast.GeneratorExp)
+
+
+def _is_funnel_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    name = func.attr if isinstance(func, ast.Attribute) else (
+        func.id if isinstance(func, ast.Name) else None)
+    return name in ALLOWED_FUNNELS
+
+
+def _walk_skipping_funnels(node: ast.AST):
+    """ast.walk, but a funnel call's whole subtree is opaque: what
+    `_upload`/`_land` consume has, by contract, been reviewed."""
+    stack = [node]
+    while stack:
+        current = stack.pop()
+        if _is_funnel_call(current):
+            continue
+        yield current
+        stack.extend(ast.iter_child_nodes(current))
+
+
+def _device_call(imports: ImportMap, node: ast.AST) -> bool:
+    """A call into the jax/jnp namespaces (produces/handles device
+    values)."""
+    if not isinstance(node, ast.Call):
+        return False
+    chain = dotted_of(node.func)
+    if chain is None:
+        return False
+    head = chain.split('.')[0]
+    target = imports.resolve_module(head) or head
+    return target == 'jax' or target.startswith('jax.')
+
+
+def _device_names(imports: ImportMap, func_node: ast.AST) -> Set[str]:
+    """Local names assigned (transitively) from jax/jnp calls within
+    this function — the one-function dataflow behind the float()/int()
+    rule."""
+    tainted: Set[str] = set()
+    assigns = [n for n in ast.walk(func_node)
+               if isinstance(n, ast.Assign)]
+    changed = True
+    while changed:
+        changed = False
+        for node in assigns:
+            value_tainted = any(
+                _device_call(imports, sub) or (
+                    isinstance(sub, ast.Name) and sub.id in tainted)
+                for sub in _walk_skipping_funnels(node.value))
+            if not value_tainted:
+                continue
+            for target in node.targets:
+                for t in ast.walk(target):
+                    if isinstance(t, ast.Name) and t.id not in tainted:
+                        tainted.add(t.id)
+                        changed = True
+    return tainted
+
+
+def _expr_device_sourced(imports: ImportMap, node: ast.AST,
+                         tainted: Set[str]) -> bool:
+    return any(
+        _device_call(imports, sub) or (
+            isinstance(sub, ast.Name) and sub.id in tainted)
+        for sub in _walk_skipping_funnels(node))
+
+
+@register
+class HotPathHostSyncChecker(Checker):
+
+    id = 'hot-path-host-sync'
+    description = ('no host synchronization (device_get, '
+                   'block_until_ready, np.asarray/float/int on device '
+                   'values, jnp uploads) in code reachable from the '
+                   'decode tick or the train-step factories; crossings '
+                   'go through the _upload/_land funnels or '
+                   'copy_to_host_async')
+
+    roots = HOT_ROOTS
+
+    def run(self, tree: ProjectTree) -> List[Finding]:
+        graph = callgraph.CallGraph(tree)
+        reachable = graph.reachable(self.roots, stop=ALLOWED_FUNNELS)
+        findings: List[Finding] = []
+        for info, root in reachable.values():
+            findings.extend(self._scan_function(graph, info, root))
+        return findings
+
+    def _scan_function(self, graph: callgraph.CallGraph,
+                       info: callgraph.FuncInfo,
+                       root: str) -> List[Finding]:
+        imports = graph.imports[info.module.rel]
+        tainted = _device_names(imports, info.node)
+        findings: List[Finding] = []
+
+        def flag(node: ast.AST, what: str, hint: str) -> None:
+            findings.append(Finding(
+                self.id, info.module.repo_rel, node.lineno,
+                f'{what} in {info.qualname} (hot path via {root}): '
+                f'{hint}'))
+
+        for node in _walk_skipping_funnels(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in ALLOWED_METHODS:
+                # The async-transfer primitive the ring protocol is
+                # built on: blessed before any flag rule looks at it.
+                continue
+            if isinstance(func, ast.Attribute):
+                if func.attr in _BLOCKING_METHODS and not node.args:
+                    flag(node, f'host block .{func.attr}()',
+                         'use copy_to_host_async at dispatch and land '
+                         'through _land')
+                    continue
+            if resolves_to(imports, func, _RAW_TRANSFERS):
+                flag(node, f'raw device transfer '
+                     f'{dotted_of(func)}(...)',
+                     'route uploads through _upload and downloads '
+                     'through _land')
+                continue
+            if resolves_to(imports, func, _NP_LANDINGS):
+                if node.args and isinstance(node.args[0],
+                                            _HOST_LITERALS):
+                    continue
+                flag(node, f'host landing {dotted_of(func)}(...)',
+                     'a device value materializing on host must go '
+                     'through the _land funnel')
+                continue
+            if isinstance(func, ast.Name) and func.id in (
+                    'float', 'int') and len(node.args) == 1:
+                if _expr_device_sourced(imports, node.args[0], tainted):
+                    flag(node, f'{func.id}() on a device value',
+                         'forces a blocking device→host sync; land '
+                         'through _land first')
+        return findings

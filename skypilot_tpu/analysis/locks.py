@@ -1,0 +1,247 @@
+"""lock-discipline: state a class mutates under one of its locks must
+never be mutated outside that lock.
+
+Inference, per class:
+
+1. Lock attributes: `self.X = threading.Lock()/RLock()` anywhere in
+   the class (any `threading` alias, or `Lock` imported directly).
+2. Guarded set: every attribute assigned (`self.Y = …`, `self.Y += …`,
+   `self.Y[…] = …`, `del self.Y`) inside a `with self.X:` block —
+   the class's own code declares which state the lock protects.
+3. Lock-held methods: a method whose intra-class call sites ALL sit
+   inside `with self.X:` blocks (or inside other lock-held methods —
+   computed to a fixed point) is analyzed as holding X.
+4. Violation: any other mutation of a guarded attribute outside a
+   `with` on (one of) its lock(s). `__init__` is exempt: construction
+   happens-before any sharing.
+
+This is exactly the bug class grep cannot see (PRs 1/5/6 each burned
+review rounds on it): the engine's generation-guarded state swaps,
+the replica manager's claim lock, the metrics children. Single-writer
+designs that intentionally skip the lock on a hot path document that
+choice in analysis/waivers.toml instead of silently diverging.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis.core import (Checker, Finding, Module,
+                                        ProjectTree, register,
+                                        resolves_to)
+
+_LOCK_FACTORIES = ('threading.Lock', 'threading.RLock',
+                   'threading.Condition')
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'Y' for an expression `self.Y`, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id == 'self':
+        return node.attr
+    return None
+
+
+def _mutated_attrs_shallow(stmt: ast.AST) -> List[Tuple[str, int]]:
+    """Mutations in THIS statement only (no recursion into child
+    statements — the scoped walker visits every statement itself)."""
+    targets: list = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Delete):
+        targets = stmt.targets
+    out: List[Tuple[str, int]] = []
+    for target in targets:
+        nodes = [target]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            nodes = list(target.elts)
+        for t in nodes:
+            attr = _self_attr(t)
+            if attr is None and isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+            if attr is not None:
+                out.append((attr, stmt.lineno))
+    return out
+
+
+class _ClassAnalysis:
+
+    def __init__(self, module: Module, imports, cls: ast.ClassDef) \
+            -> None:
+        self.module = module
+        self.cls = cls
+        self.imports = imports
+        self.methods = {
+            item.name: item for item in cls.body
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.lock_attrs = self._find_lock_attrs()
+        # attr -> {lock name -> first mutation line under that lock}
+        self.guarded: Dict[str, Dict[str, int]] = {}
+        # method -> set of locks held at its intra-class call sites
+        # (None = a lock-free site) for lock-held inference
+        self._calls_under: Dict[str, Set[Optional[str]]] = {}
+        for fn in self.methods.values():
+            self._scoped_walk(fn, None, self._record)
+
+    def _find_lock_attrs(self) -> Set[str]:
+        out: Set[str] = set()
+        for fn in self.methods.values():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        resolves_to(self.imports, node.value.func,
+                                    _LOCK_FACTORIES):
+                    for target in node.targets:
+                        attr = _self_attr(target)
+                        if attr is not None:
+                            out.add(attr)
+        return out
+
+    def _with_lock(self, node: ast.With) -> Optional[str]:
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr in self.lock_attrs:
+                return attr
+        return None
+
+    def _scoped_walk(self, node: ast.AST, lock: Optional[str],
+                     visit: Callable[[ast.AST, Optional[str]], None]) \
+            -> None:
+        """THE lock-scope walker (every analysis pass shares it):
+        calls `visit(descendant, lock_held_there)` for every node
+        under `node`, entering `with self.<lock>:` scopes and
+        resetting to lock-free inside nested def/lambda bodies — they
+        run later, under whoever calls them."""
+        for child in ast.iter_child_nodes(node):
+            inner = lock
+            if isinstance(child, ast.With):
+                inner = self._with_lock(child) or lock
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                inner = None
+            visit(child, lock)
+            self._scoped_walk(child, inner, visit)
+
+    def _is_method_call(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            attr = _self_attr(node.func)
+            if attr is not None and attr in self.methods:
+                return attr
+        return None
+
+    def _record(self, node: ast.AST, lock: Optional[str]) -> None:
+        if lock is not None:
+            for attr, line in _mutated_attrs_shallow(node):
+                if attr not in self.lock_attrs:
+                    self.guarded.setdefault(attr, {}).setdefault(
+                        lock, line)
+        callee = self._is_method_call(node)
+        if callee is not None:
+            self._calls_under.setdefault(callee, set()).add(lock)
+
+    def lock_held_methods(self) -> Dict[str, str]:
+        """method -> lock for methods whose every intra-class call
+        site holds that one lock (fixed point: call sites inside
+        already-held methods count as under that lock)."""
+        held: Dict[str, str] = {}
+        changed = True
+        while changed:
+            changed = False
+            for name, sites in self._calls_under.items():
+                if name in held:
+                    continue
+                if sites and None not in sites and len(sites) == 1:
+                    held[name] = next(iter(sites))  # type: ignore
+                    changed = True
+            if changed:
+                self._calls_under = self._recount(held)
+        return held
+
+    def _recount(self, held: Dict[str, str]) -> \
+            Dict[str, Set[Optional[str]]]:
+        counts: Dict[str, Set[Optional[str]]] = {}
+
+        def record(node: ast.AST, lock: Optional[str]) -> None:
+            callee = self._is_method_call(node)
+            if callee is not None:
+                counts.setdefault(callee, set()).add(lock)
+
+        for name, fn in self.methods.items():
+            self._scoped_walk(fn, held.get(name), record)
+        return counts
+
+    def inconsistent_guards(self) -> List[Tuple[str, List[str], int]]:
+        """(attr, locks, line): attributes mutated under two DIFFERENT
+        locks — each writer thinks it holds "the" lock while excluding
+        nobody on the other one; this is the lost-update race itself,
+        not a missing-lock variant of it. Reported at the second
+        lock's first mutation site."""
+        out = []
+        for attr, locks in self.guarded.items():
+            if len(locks) > 1:
+                out.append((attr, sorted(locks),
+                            sorted(locks.values())[-1]))
+        return out
+
+    def violations(self) -> List[Tuple[str, str, int, str]]:
+        """(method, attr, line, lock) mutations of guarded attrs
+        without the lock."""
+        if not self.guarded:
+            return []
+        held = self.lock_held_methods()
+        out: List[Tuple[str, str, int, str]] = []
+        for name, fn in self.methods.items():
+            if name == '__init__':
+                continue
+
+            def check(node: ast.AST, lock: Optional[str],
+                      method: str = name) -> None:
+                for attr, line in _mutated_attrs_shallow(node):
+                    locks = self.guarded.get(attr)
+                    if locks and lock not in locks:
+                        out.append(
+                            (method, attr, line, sorted(locks)[0]))
+
+            self._scoped_walk(fn, held.get(name), check)
+        return out
+
+
+@register
+class LockDisciplineChecker(Checker):
+
+    id = 'lock-discipline'
+    description = ('attributes a class assigns under `with self.<lock>:`'
+                   ' must not be mutated by other methods without '
+                   'holding the same lock (single-writer exceptions are '
+                   'waived, not silent)')
+
+    def run(self, tree: ProjectTree) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in tree.modules.values():
+            imports = tree.import_map(mod)
+            for node in mod.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                analysis = _ClassAnalysis(mod, imports, node)
+                if not analysis.lock_attrs:
+                    continue
+                for attr, locks, line in \
+                        analysis.inconsistent_guards():
+                    findings.append(Finding(
+                        self.id, mod.repo_rel, line,
+                        f'{node.name} mutates self.{attr} under '
+                        f'DIFFERENT locks ({", ".join("self." + l for l in locks)}) '
+                        f'— writers exclude nobody on the other lock; '
+                        f'pick one lock for this state'))
+                for method, attr, line, lock in analysis.violations():
+                    findings.append(Finding(
+                        self.id, mod.repo_rel, line,
+                        f'{node.name}.{method} mutates self.{attr} '
+                        f'without holding self.{lock} (the class '
+                        f'mutates it under that lock elsewhere)'))
+        return findings

@@ -1,0 +1,175 @@
+"""sharding-containment: physical axis names live in `parallel/` only.
+
+The PR-7 extraction put every logical→physical sharding decision in
+one rule table (`parallel/sharding.py::LOGICAL_AXIS_RULES`); train and
+serving code spell layouts through `spec_for`/`constrain`/
+`tree_shardings` and thread collective axis names in as parameters.
+This checker is the AST re-implementation of the two grep lints that
+pinned that invariant (tests/test_sharding_rules.py) — no more
+balanced-paren string scanning, no comment false-positives:
+
+- `PartitionSpec(...)` (any alias, including `P = PartitionSpec`
+  rebinding and `jax.sharding.PartitionSpec`) carrying a string
+  constant anywhere in its arguments, outside `parallel/` → a second
+  rule table waiting to drift. Bare `PartitionSpec()` (explicit
+  replication) is fine.
+- `lax.psum / psum_scatter / all_gather / reduce_scatter / ppermute`
+  with a string constant in the call's arguments outside `parallel/`
+  → a hardcoded physical-axis dependency; axis names arrive through a
+  parameter or a `parallel/` helper (the ring-attention pattern).
+- Exactly one module-level `LOGICAL_AXIS_RULES` table, in
+  `parallel/sharding.py`; duplicates (or a parallel/ tree missing the
+  table) are flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from skypilot_tpu.analysis.core import (Checker, Finding, ImportMap,
+                                        Module, ProjectTree,
+                                        dotted_of, register)
+
+_PSPEC_TARGETS = ('jax.sharding.PartitionSpec',
+                  'jax.interpreters.pxla.PartitionSpec')
+_COLLECTIVES = ('psum', 'psum_scatter', 'all_gather', 'reduce_scatter',
+                'ppermute', 'pmean', 'pmax', 'pmin', 'all_to_all',
+                'axis_index')
+_RULE_TABLE = 'LOGICAL_AXIS_RULES'
+_CONTAINMENT_DIR = 'parallel'
+
+
+def _pspec_names(mod: Module, imports: ImportMap) -> Set[str]:
+    """Local names bound to PartitionSpec: direct imports plus
+    module-level rebindings (`P = PartitionSpec`)."""
+    names: Set[str] = set()
+    for name, (prefix, sym) in imports.symbols.items():
+        if f'{prefix}.{sym}' in _PSPEC_TARGETS or \
+                sym == 'PartitionSpec':
+            names.add(name)
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in names:
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    names.add(target.id)
+    return names
+
+
+def _is_pspec_call(node: ast.Call, names: Set[str],
+                   imports: ImportMap) -> bool:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id in names
+    chain = dotted_of(func)
+    if chain is None:
+        return False
+    head, _, rest = chain.partition('.')
+    target = imports.resolve_module(head)
+    if target is not None and rest:
+        return f'{target}.{rest}' in _PSPEC_TARGETS
+    return False
+
+
+def _collective_name(node: ast.Call,
+                     imports: ImportMap) -> Optional[str]:
+    func = node.func
+    if not isinstance(func, ast.Attribute) or \
+            func.attr not in _COLLECTIVES:
+        return None
+    chain = dotted_of(func.value)
+    if chain is None:
+        return None
+    head, _, rest = chain.partition('.')
+    target = imports.resolve_module(head) or head
+    base = f'{target}.{rest}' if rest else target
+    if base in ('jax.lax', 'lax'):
+        return func.attr
+    # `from jax import lax` arrives as a symbol import.
+    if head in imports.symbols:
+        prefix, sym = imports.symbols[head]
+        if f'{prefix}.{sym}' == 'jax.lax' and not rest:
+            return func.attr
+    return None
+
+
+def _string_args(node: ast.Call) -> List[str]:
+    out = []
+    for sub in list(node.args) + [kw.value for kw in node.keywords]:
+        for n in ast.walk(sub):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                out.append(n.value)
+    return out
+
+
+def rule_table_sites(tree: ProjectTree) -> List[tuple]:
+    """(repo_rel, rel, line) of every module-level LOGICAL_AXIS_RULES
+    assignment — exported for the tests/test_sharding_rules.py thin
+    wrapper."""
+    sites = []
+    for mod in tree.modules.values():
+        for node in mod.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            for target in targets:
+                if isinstance(target, ast.Name) and \
+                        target.id == _RULE_TABLE:
+                    sites.append((mod.repo_rel, mod.rel, node.lineno))
+    return sites
+
+
+@register
+class ShardingContainmentChecker(Checker):
+
+    id = 'sharding-containment'
+    description = ('PartitionSpec axis-name strings, quoted collective '
+                   'axes, and the LOGICAL_AXIS_RULES table are confined '
+                   'to parallel/ — one rule table, no drift')
+
+    def run(self, tree: ProjectTree) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in tree.modules.values():
+            if mod.rel.split('/')[0] == _CONTAINMENT_DIR:
+                continue
+            imports = tree.import_map(mod)
+            pspec_names = _pspec_names(mod, imports)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _is_pspec_call(node, pspec_names, imports):
+                    strings = _string_args(node)
+                    if strings:
+                        findings.append(Finding(
+                            self.id, mod.repo_rel, node.lineno,
+                            f'PartitionSpec with axis-name string(s) '
+                            f'{strings} outside {_CONTAINMENT_DIR}/ — '
+                            f'use sharding.spec_for / tree_shardings'))
+                    continue
+                coll = _collective_name(node, imports)
+                if coll is not None:
+                    strings = _string_args(node)
+                    if strings:
+                        findings.append(Finding(
+                            self.id, mod.repo_rel, node.lineno,
+                            f'lax.{coll} with hardcoded axis name(s) '
+                            f'{strings} outside {_CONTAINMENT_DIR}/ — '
+                            f'thread the axis in, or add a parallel/ '
+                            f'helper'))
+        sites = rule_table_sites(tree)
+        canonical = f'{_CONTAINMENT_DIR}/sharding.py'
+        for repo_rel, rel, line in sites:
+            if rel != canonical:
+                findings.append(Finding(
+                    self.id, repo_rel, line,
+                    f'{_RULE_TABLE} defined outside {canonical} — '
+                    f'exactly one logical-axis rule table exists'))
+        if tree.has_dir(_CONTAINMENT_DIR) and not any(
+                rel == canonical for _, rel, _ in sites):
+            findings.append(Finding(
+                self.id, f'{tree.pkg_name}/{canonical}', 1,
+                f'{_RULE_TABLE} table missing from {canonical}'))
+        return findings

@@ -1,0 +1,153 @@
+"""Reviewed lint debt: `analysis/waivers.toml` parsing and matching.
+
+A waiver entry looks like:
+
+    [[waiver]]
+    checker = "lock-discipline"
+    path = "skypilot_tpu/models/inference.py"
+    contains = "_heartbeat"           # optional message substring
+    line = 2366                       # optional exact line pin
+    reason = "engine-thread single-writer; gen-guarded (see _tick)"
+    expires = "2027-01-01"            # optional review-by date
+
+Matching: checker and repo-relative path must equal; `contains`
+(substring of the message) and `line` narrow further when present.
+Prefer `contains` over `line` — lines shift under unrelated edits and
+a stale waiver resurfaces as a `waivers` finding.
+
+The container pins no TOML library (py3.10, no tomllib), so this
+module carries a deliberately tiny parser for exactly the subset the
+file uses: `[[waiver]]` array-of-tables headers, `key = "string"`,
+`key = <int>`, `key = true|false`, full-line/trailing comments. A
+file outside that subset raises LintError (exit 2) — the waiver file
+is reviewed code, not config sprawl.
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import re
+from typing import List, Optional
+
+from skypilot_tpu.analysis.core import Finding, LintError
+
+_HEADER_RE = re.compile(r'^\[\[\s*waiver\s*\]\]$')
+_KV_RE = re.compile(
+    r'^(?P<key>[A-Za-z_][A-Za-z0-9_-]*)\s*=\s*(?P<value>.+)$')
+
+
+@dataclasses.dataclass
+class Waiver:
+    checker: str
+    path: str
+    reason: str
+    line: int                       # line of the entry in waivers.toml
+    contains: Optional[str] = None
+    finding_line: Optional[int] = None
+    expires: Optional[datetime.date] = None
+
+    def expired(self, today: Optional[datetime.date] = None) -> bool:
+        if self.expires is None:
+            return False
+        return (today or datetime.date.today()) > self.expires
+
+    def matches(self, finding: Finding) -> bool:
+        if finding.checker != self.checker or \
+                finding.path != self.path:
+            return False
+        if self.finding_line is not None and \
+                finding.line != self.finding_line:
+            return False
+        if self.contains is not None and \
+                self.contains not in finding.message:
+            return False
+        return True
+
+
+def _parse_value(raw: str, path: str, lineno: int):
+    raw = raw.strip()
+    if raw.startswith(('"', "'")):
+        quote = raw[0]
+        end = raw.find(quote, 1)
+        if end < 0:
+            raise LintError(f'{path}:{lineno}: unterminated string')
+        trailing = raw[end + 1:].strip()
+        if trailing and not trailing.startswith('#'):
+            raise LintError(
+                f'{path}:{lineno}: trailing junk after string')
+        return raw[1:end]
+    raw = raw.split('#', 1)[0].strip()
+    if raw in ('true', 'false'):
+        return raw == 'true'
+    try:
+        return int(raw)
+    except ValueError as e:
+        raise LintError(
+            f'{path}:{lineno}: unsupported TOML value {raw!r} (the '
+            f'waiver parser accepts strings, ints, and booleans)') \
+            from e
+
+
+def load_waivers(path: str) -> List[Waiver]:
+    try:
+        with open(path, encoding='utf-8') as f:
+            lines = f.readlines()
+    except OSError as e:
+        raise LintError(f'cannot read waiver file {path}: {e}') from e
+
+    entries: List[dict] = []
+    current: Optional[dict] = None
+    for lineno, line in enumerate(lines, 1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith('#'):
+            continue
+        if _HEADER_RE.match(stripped):
+            current = {'_line': lineno}
+            entries.append(current)
+            continue
+        m = _KV_RE.match(stripped)
+        if not m:
+            raise LintError(
+                f'{path}:{lineno}: expected `[[waiver]]` or '
+                f'`key = value`, got {stripped!r}')
+        if current is None:
+            raise LintError(
+                f'{path}:{lineno}: key outside a [[waiver]] table')
+        current[m.group('key')] = _parse_value(
+            m.group('value'), path, lineno)
+
+    waivers = []
+    for entry in entries:
+        lineno = entry.pop('_line')
+        missing = [k for k in ('checker', 'path', 'reason')
+                   if not entry.get(k)]
+        if missing:
+            raise LintError(
+                f'{path}:{lineno}: waiver missing required '
+                f'key(s) {missing} — every waiver states what it '
+                f'suppresses and why')
+        expires = None
+        if 'expires' in entry:
+            try:
+                expires = datetime.date.fromisoformat(
+                    str(entry['expires']))
+            except ValueError as e:
+                raise LintError(
+                    f'{path}:{lineno}: bad expires date '
+                    f'{entry["expires"]!r} (want YYYY-MM-DD)') from e
+        known = {'checker', 'path', 'reason', 'contains', 'line',
+                 'expires'}
+        unknown = set(entry) - known
+        if unknown:
+            raise LintError(
+                f'{path}:{lineno}: unknown waiver key(s) '
+                f'{sorted(unknown)}')
+        waivers.append(Waiver(
+            checker=str(entry['checker']),
+            path=str(entry['path']),
+            reason=str(entry['reason']),
+            line=lineno,
+            contains=entry.get('contains'),
+            finding_line=entry.get('line'),
+            expires=expires))
+    return waivers

@@ -1,0 +1,114 @@
+"""wall-clock-duration: `time.time()` subtraction is not a duration.
+
+The PR-2 monotonic sweep moved every in-process elapsed/timeout
+measurement to `time.monotonic()` — wall clock steps under NTP and
+leaps backwards across suspends, so `time.time() - t0` is a latency
+lie waiting for a clock sync. This checker enforces the sweep instead
+of re-auditing it: within one function, any subtraction whose BOTH
+operands are wall-clock values (a direct `time.time()` call, or a
+local name assigned from one) is flagged.
+
+Scope is deliberately local and both-sided: `time.time() - cutoff`
+against a persisted epoch (file mtimes, checkpoint rows, absolute
+request deadlines from the serve contract) is legitimate wall
+arithmetic and stays out of scope — those operands are attributes or
+calls the checker does not taint. What cannot be justified is taking
+two wall readings in one function and calling their difference a
+duration.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from skypilot_tpu.analysis.core import (Checker, Finding, ImportMap,
+                                        ProjectTree, register,
+                                        resolves_to)
+
+_WALL_CALLS = ('time.time',)
+
+
+def _is_wall_call(imports: ImportMap, node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and \
+        resolves_to(imports, node.func, _WALL_CALLS)
+
+
+def _scope_walk(func: ast.AST):
+    """Walk one function's own scope: nested def/lambda bodies are
+    their own scopes and are scanned separately."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _wall_names(imports: ImportMap, func: ast.AST) -> Set[str]:
+    """Local names holding wall-clock values: assigned from
+    `time.time()` directly, or from `<wall> + x` / `x + <wall>`
+    (`deadline = t0 + timeout` is still a wall value) — iterated to a
+    fixed point so the taint flows through chains of such
+    assignments."""
+    names: Set[str] = set()
+    assigns = [n for n in _scope_walk(func)
+               if isinstance(n, ast.Assign)]
+    changed = True
+    while changed:
+        changed = False
+        for node in assigns:
+
+            def wallish(expr: ast.AST) -> bool:
+                return _is_wall_call(imports, expr) or (
+                    isinstance(expr, ast.Name) and expr.id in names)
+
+            value = node.value
+            tainted = wallish(value) or (
+                isinstance(value, ast.BinOp) and
+                isinstance(value.op, ast.Add) and
+                (wallish(value.left) or wallish(value.right)))
+            if not tainted:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name) and \
+                        target.id not in names:
+                    names.add(target.id)
+                    changed = True
+    return names
+
+
+@register
+class WallClockDurationChecker(Checker):
+
+    id = 'wall-clock-duration'
+    description = ('durations measured by subtracting two time.time() '
+                   'readings in one function must use time.monotonic() '
+                   'instead (NTP steps make wall deltas lie)')
+
+    def run(self, tree: ProjectTree) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in tree.modules.values():
+            imports = tree.import_map(mod)
+            funcs = [n for n in ast.walk(mod.tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+            for func in funcs:
+                names = _wall_names(imports, func)
+
+                def wall(node: ast.AST) -> bool:
+                    return _is_wall_call(imports, node) or (
+                        isinstance(node, ast.Name) and
+                        node.id in names)        # noqa: B023
+
+                for node in _scope_walk(func):
+                    if isinstance(node, ast.BinOp) and \
+                            isinstance(node.op, ast.Sub) and \
+                            wall(node.left) and wall(node.right):
+                        findings.append(Finding(
+                            self.id, mod.repo_rel, node.lineno,
+                            f'wall-clock duration in {func.name}: '
+                            f'both operands of this subtraction come '
+                            f'from time.time() — measure elapsed '
+                            f'time with time.monotonic()'))
+        return findings

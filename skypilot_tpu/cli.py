@@ -473,6 +473,56 @@ def metrics(url, raw, pattern):
 
 
 @cli.command()
+@click.option('--select', default=None,
+              help='Comma-separated checker ids to run (default: all; '
+                   'see docs/static-analysis.md for the catalog).')
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='Emit one machine-readable JSON row (schema '
+                   'skylint/1) instead of human output.')
+@click.option('--root', default=None,
+              help='Package root to lint (default: the installed '
+                   'skypilot_tpu tree).')
+def lint(select, as_json, root):
+    """Run skylint: the AST-based correctness analyzer.
+
+    Checks hot-path host-sync discipline, lock discipline, wall-clock
+    durations, sharding/collective containment, and the injection-
+    point / metrics-catalog drift invariants. Reviewed debt lives in
+    analysis/waivers.toml. Exit codes: 0 clean, 1 unwaived findings,
+    2 internal error.
+    """
+    import json as json_lib
+
+    from skypilot_tpu import analysis
+    try:
+        selected = ([s.strip() for s in select.split(',') if s.strip()]
+                    if select else None)
+        result = analysis.run_lint(root=root, select=selected)
+    except analysis.LintError as e:
+        if as_json:
+            click.echo(json_lib.dumps(
+                {'schema': 'skylint/1', 'ok': False, 'error': str(e)}))
+        else:
+            click.secho(f'skylint error: {e}', fg='red', err=True)
+        sys.exit(2)
+    if as_json:
+        # Bench-harness style: ONE JSON object on one line, so the
+        # dryrun supervisor / CI can json.loads the last stdout line.
+        click.echo(json_lib.dumps(result.to_dict()))
+    else:
+        for finding in result.findings:
+            color = 'yellow' if finding.waived else 'red'
+            click.secho(str(finding), fg=color)
+        summary = result.to_dict()['summary']
+        click.echo(
+            f"skylint: {summary['unwaived']} finding(s), "
+            f"{summary['waived']} waived, "
+            f"{len(result.selected)} checker(s) over "
+            f"{result.root} in {summary['duration_s']}s")
+    sys.exit(0 if result.ok else 1)
+
+
+@cli.command()
 def check():
     """Probe cloud credentials; cache the enabled-cloud list."""
     # Not sky.check(): the skypilot_tpu.check SUBMODULE shadows the lazy

@@ -138,7 +138,7 @@ class JobsController:
         gap = constants.job_status_check_gap_seconds()
         grow_gap = constants.elastic_grow_gap_seconds()
         grow_backoff = 1          # doubles per failed grow, capped 8x
-        last_grow_check = time.time()
+        last_grow_check = time.monotonic()
         while True:
             if self._cancelled():
                 jobs_state.set_cancelling(job_id)
@@ -167,7 +167,7 @@ class JobsController:
                 # only a successful grow earns back the base gap (a
                 # grow attempt that died mid-flight routes through here
                 # and must not erase its own backoff).
-                last_grow_check = time.time()
+                last_grow_check = time.monotonic()
                 continue
 
             if status == 'PREEMPTED':
@@ -177,7 +177,7 @@ class JobsController:
                 # is still up (aborted preemption, manual SIGTERM) —
                 # never the user-failure restart budget.
                 self._recover(task_id)
-                last_grow_check = time.time()
+                last_grow_check = time.monotonic()
                 continue
 
             if status in ('FAILED', 'FAILED_SETUP'):
@@ -194,7 +194,7 @@ class JobsController:
                     self._best_effort_teardown()
                     return False
                 self._recover(task_id)
-                last_grow_check = time.time()
+                last_grow_check = time.monotonic()
                 continue
 
             if status == 'CANCELLED':
@@ -224,9 +224,9 @@ class JobsController:
                     # setting-up relaunch must not be torn down to
                     # re-probe capacity before it trains a single step.
                     and status == 'RUNNING'
-                    and time.time() - last_grow_check >=
+                    and time.monotonic() - last_grow_check >=
                     grow_gap * grow_backoff):
-                last_grow_check = time.time()
+                last_grow_check = time.monotonic()
                 jobs_state.set_recovering(job_id, task_id)
                 try:
                     grew = self.strategy.try_grow()

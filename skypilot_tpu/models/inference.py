@@ -200,6 +200,21 @@ def _upload(value, dtype=None, sharding=None):
     return arr
 
 
+def _land(value) -> np.ndarray:
+    """The download twin of `_upload`: the engine's single device→host
+    landing funnel. Every hot-path materialization of a device value on
+    the host routes through here so skylint's hot-path-host-sync
+    checker (docs/static-analysis.md) can pin raw `np.asarray`/
+    `jax.device_get`/`.block_until_ready()` crossings to exactly one
+    reviewed site — a landing is a host sync by definition, and the
+    protocol decides where that block is paid: the async ring starts
+    the copy at dispatch (`copy_to_host_async`) so landing the oldest
+    entry here is a wait on an already-in-flight transfer, while the
+    sync path (async_depth=0) pays the full transfer because it has
+    nothing to overlap it with."""
+    return np.asarray(value)
+
+
 # Monotone per-request ids: the device-feed / lookahead signatures key
 # on (seq, next_pos) so a finished request and its slot's next occupant
 # can never alias (unlike id(), which recycles).
@@ -1350,8 +1365,8 @@ class ContinuousBatchingEngine:
             _upload(positions, jnp.int32, self._repl),
             _upload(temps, jnp.float32, self._repl), rng, tables)
         self._commit_gen(gen, lambda: setattr(self, '_cache', cache))
-        out = np.asarray(out)
-        accepted = np.asarray(accepted)
+        out_cols = _land(out)
+        acc = _land(accepted)
         # Acceptance-rate bookkeeping counts only slots that contributed
         # a real prompt-lookup draft; [0]*k fillers for greedy slots
         # whose n-gram lookup came up empty would inflate the
@@ -1359,13 +1374,13 @@ class ContinuousBatchingEngine:
         drafted_active = [i for i in active if i in real_draft_slots]
         self.spec_stats['ticks'] += 1
         self.spec_stats['drafted'] += k * len(drafted_active)
-        self.spec_stats['accepted'] += int(accepted[drafted_active].sum())
+        self.spec_stats['accepted'] += int(acc[drafted_active].sum())
         _SPEC_DRAFTED.inc(k * len(drafted_active))
-        _SPEC_ACCEPTED.inc(int(accepted[drafted_active].sum()))
+        _SPEC_ACCEPTED.inc(int(acc[drafted_active].sum()))
         if self.paged_block_size:
-            _SPEC_PAGED_ACCEPTED.inc(int(accepted[drafted_active].sum()))
-        valid = accepted + 1          # emit accepted drafts + 1 bonus
-        return out, valid
+            _SPEC_PAGED_ACCEPTED.inc(int(acc[drafted_active].sum()))
+        valid = acc + 1               # emit accepted drafts + 1 bonus
+        return out_cols, valid
 
     def _ensure_thread(self) -> None:
         import threading
@@ -1500,13 +1515,16 @@ class ContinuousBatchingEngine:
             fn()
 
     def _sample(self, logits_row, temperature: float) -> int:
+        # Prefill-time first-token sampling: a once-per-request host
+        # sync, paid at admission (never in the steady decode loop) —
+        # the landings route through the audited _land funnel.
         if temperature <= 0:
-            return int(jnp.argmax(logits_row))
+            return int(_land(jnp.argmax(logits_row)))
         self._rng, rng = jax.random.split(self._rng)
         scaled = apply_logit_filters(
             logits_row.astype(jnp.float32) / max(temperature, 1e-6),
             self.top_k, self.top_p)
-        return int(jax.random.categorical(rng, scaled))
+        return int(_land(jax.random.categorical(rng, scaled)))
 
     def _bucket(self, length: int) -> int:
         bucket = 16
@@ -2516,7 +2534,7 @@ class ContinuousBatchingEngine:
                    self._can_chain(slots, active, k)):
                 self._dispatch(slots, active, k, gen, chain=ring[-1])
             return
-        out_cols = np.asarray(out_dev)
+        out_cols = _land(out_dev)
         self._last_ready = time_lib.monotonic()
         self._emit(slots, active, out_cols, None)
 
@@ -2677,7 +2695,8 @@ class ContinuousBatchingEngine:
         finishes while deeper entries are still pending sheds their
         columns the same way, up to async_depth steps late."""
         infl = self._ring.popleft()
-        out_cols = np.asarray(infl.out)   # blocks until that step lands
+        out_cols = _land(infl.out)   # waits on the copy the dispatch
+                                     # already started async
         self._last_ready = time_lib.monotonic()
         # The wait above may span a watchdog recovery: never emit into
         # a successor's world.

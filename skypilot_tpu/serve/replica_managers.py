@@ -252,12 +252,13 @@ class SkyPilotReplicaManager:
             self._threads.append(thread)
 
     def join(self, timeout: Optional[float] = None) -> None:
-        deadline = None if timeout is None else time.time() + timeout
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         with self.lock:
             threads = list(self._threads)
         for thread in threads:
             remaining = (None if deadline is None
-                         else max(0.0, deadline - time.time()))
+                         else max(0.0, deadline - time.monotonic()))
             thread.join(remaining)
 
     # ---------------- workers ----------------
@@ -613,8 +614,9 @@ class SkyPilotReplicaManager:
         SIGTERM, so it holds DRAINING — the same observable window the
         POST /preempt path produces — until it stops answering or the
         notice budget lapses, and only then is deleted and replaced."""
-        deadline = time.time() + constants.preempt_notice_budget_seconds()
-        while time.time() < deadline:
+        deadline = (time.monotonic() +
+                    constants.preempt_notice_budget_seconds())
+        while time.monotonic() < deadline:
             with self.lock:
                 info = self.replicas.get(replica_id)
                 if info is None or \
@@ -622,7 +624,7 @@ class SkyPilotReplicaManager:
                     return  # already handled elsewhere
             if self._probe_one(info) == 'down':
                 break  # drain body finished; the process exited
-            time.sleep(min(2.0, max(0.1, deadline - time.time())))
+            time.sleep(min(2.0, max(0.1, deadline - time.monotonic())))
         with self.lock:
             info = self.replicas.get(replica_id)
             if info is None or info.status != ReplicaStatus.DRAINING:

@@ -35,7 +35,7 @@ class TestBasicCommands:
         for command in ('launch', 'exec', 'status', 'queue', 'logs',
                         'cancel', 'stop', 'start', 'down', 'autostop',
                         'cost-report', 'check', 'show-tpus', 'storage',
-                        'jobs', 'serve'):
+                        'jobs', 'serve', 'lint'):
             assert command in result.output
 
     def test_status_empty(self, runner):

@@ -21,7 +21,6 @@ Tier-1 (CPU-only, deterministic — no sleeps drive any assertion):
 import asyncio
 import math
 import os
-import re
 import socket
 import threading
 import time
@@ -636,42 +635,22 @@ class TestPrefixCacheChurn:
 
 class TestMetricsCatalogLint:
     """CI satellite (the PR-6 injection-point-lint pattern, applied to
-    metrics): every `skytpu_*` metric name registered anywhere in the
-    tree must have a catalog row in docs/observability.md, and every
-    `skytpu_*` name the doc mentions must exist in the tree — metric
-    names must not drift into undocumented telemetry or stale docs."""
-
-    _REG_RE = re.compile(
-        r"(?:counter|gauge|histogram)\(\s*'(skytpu_[A-Za-z0-9_]+)'")
-    _DOC_RE = re.compile(r'(skytpu_[A-Za-z0-9_]+)')
-
-    def _tree_names(self):
-        root = os.path.join(os.path.dirname(__file__), '..',
-                            'skypilot_tpu')
-        names = set()
-        for dirpath, _dirnames, filenames in os.walk(root):
-            for fname in filenames:
-                if not fname.endswith('.py'):
-                    continue
-                with open(os.path.join(dirpath, fname),
-                          encoding='utf-8') as f:
-                    names |= set(self._REG_RE.findall(f.read()))
-        return names
+    metrics), now a thin wrapper over skylint's metrics-drift checker
+    (skypilot_tpu/analysis/drift.py) — the single implementation of
+    the registered-names ↔ docs/observability.md lockstep rule, both
+    directions; tests/test_skylint.py carries the seeded-drift
+    fixture coverage."""
 
     def test_every_registered_metric_documented_and_vice_versa(self):
-        tree = self._tree_names()
-        assert len(tree) > 40, (
-            f'registration scan found only {len(tree)} metrics — '
-            f'lint regex broken?')
-        doc_path = os.path.join(os.path.dirname(__file__), '..',
-                                'docs', 'observability.md')
-        with open(doc_path, encoding='utf-8') as f:
-            doc = set(self._DOC_RE.findall(f.read()))
-        undocumented = tree - doc
-        assert not undocumented, (
-            f'metrics registered in tree but missing from '
-            f'docs/observability.md: {sorted(undocumented)}')
-        phantom = doc - tree
-        assert not phantom, (
-            f'docs/observability.md names metrics no code registers '
-            f'(stale rows?): {sorted(phantom)}')
+        from skypilot_tpu import analysis
+        from skypilot_tpu.analysis import core as skylint_core
+        from skypilot_tpu.analysis import drift
+        root = os.path.join(os.path.dirname(__file__), '..',
+                            'skypilot_tpu')
+        registered = drift.collect_metrics(skylint_core.ProjectTree(root))
+        assert len(registered) > 40, (
+            f'registration scan found only {len(registered)} metrics '
+            f'— checker collection broken?')
+        result = analysis.run_lint(select=['metrics-drift'])
+        assert not result.unwaived, '\n'.join(
+            str(f) for f in result.unwaived)

@@ -30,7 +30,6 @@ slept; no wall-clock fault timing anywhere.
 import dataclasses
 import os
 import random
-import re
 import socket
 import threading
 import time
@@ -852,53 +851,29 @@ class TestLoadBalancerDrainRouting:
 
 
 class TestInjectionPointLint:
-
-    def _tree_points(self):
-        root = os.path.join(os.path.dirname(__file__), '..',
-                            'skypilot_tpu')
-        pat = re.compile(r"fault_injection\.point\(\s*['\"]([^'\"]+)")
-        found = set()
-        for dirpath, _dirnames, filenames in os.walk(root):
-            for fname in filenames:
-                if not fname.endswith('.py'):
-                    continue
-                with open(os.path.join(dirpath, fname),
-                          encoding='utf-8') as f:
-                    found |= set(pat.findall(f.read()))
-        return found
+    """Thin wrapper over skylint's injection-drift checker
+    (skypilot_tpu/analysis/drift.py) — the single implementation of
+    the KNOWN_POINTS ↔ call sites ↔ tests ↔ docs/resilience.md
+    lockstep rule; tests/test_skylint.py carries the seeded-drift
+    fixture coverage."""
 
     def test_every_point_known_exercised_and_documented(self):
         """CI satellite: every fault_injection.point(name) in the tree
         must be (1) listed in KNOWN_POINTS, (2) exercised by at least
-        one test (its name appears in tests/), and (3) documented in
-        docs/resilience.md — injection points must not drift into
-        dead, untested chaos seams."""
-        tree_points = self._tree_points()
-        assert tree_points, 'no injection points found — lint broken?'
-        known = set(fault_injection.KNOWN_POINTS)
-        assert tree_points <= known, (
-            f'undeclared injection points: {tree_points - known} — '
-            f'add them to fault_injection.KNOWN_POINTS')
-        assert known <= tree_points, (
-            f'KNOWN_POINTS with no call site: {known - tree_points} — '
-            f'dead chaos seams mislead chaos-test authors')
-
-        tests_dir = os.path.dirname(__file__)
-        tests_blob = ''
-        for fname in os.listdir(tests_dir):
-            if fname.endswith('.py'):
-                with open(os.path.join(tests_dir, fname),
-                          encoding='utf-8') as f:
-                    tests_blob += f.read()
-        unexercised = {p for p in known if f"'{p}'" not in tests_blob}
-        assert not unexercised, (
-            f'injection points never exercised by any test: '
-            f'{unexercised}')
-
-        doc_path = os.path.join(tests_dir, '..', 'docs', 'resilience.md')
-        with open(doc_path, encoding='utf-8') as f:
-            doc = f.read()
-        undocumented = {p for p in known if f'`{p}`' not in doc}
-        assert not undocumented, (
-            f'injection points missing from docs/resilience.md: '
-            f'{undocumented}')
+        one test, and (3) documented in docs/resilience.md — injection
+        points must not drift into dead, untested chaos seams."""
+        from skypilot_tpu import analysis
+        from skypilot_tpu.analysis import core as skylint_core
+        from skypilot_tpu.analysis import drift
+        root = os.path.join(os.path.dirname(__file__), '..',
+                            'skypilot_tpu')
+        tree = skylint_core.ProjectTree(root)
+        sites = drift.collect_points(tree)
+        assert sites, 'no injection points found — lint broken?'
+        # The AST walker sees the same seams the runtime registry
+        # declares (sanity that the checker scans the right tree).
+        assert {name for name, _path, _line in sites} == \
+            set(fault_injection.KNOWN_POINTS)
+        result = analysis.run_lint(select=['injection-drift'])
+        assert not result.unwaived, '\n'.join(
+            str(f) for f in result.unwaived)

@@ -13,7 +13,6 @@ rules shared by train/ and inference.
 - hlo_probe.collective_stats parses counts and bytes from HLO text.
 """
 import os
-import re
 
 import pytest
 
@@ -25,88 +24,32 @@ PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
 
 
 class TestNoDuplicateRuleTables:
+    """Thin wrappers over skylint's sharding-containment checker
+    (skypilot_tpu/analysis/sharding.py) — the AST re-implementation of
+    the grep lints that used to live here, so exactly ONE
+    implementation of each rule exists. tests/test_skylint.py carries
+    the fixture coverage (seeded violations, alias rebinding, comment
+    immunity)."""
 
-    def test_no_partition_spec_rules_outside_parallel(self):
-        """Any PartitionSpec(...) carrying axis-name STRINGS outside
-        parallel/ is a second rule table waiting to drift: model and
-        ops code must spell layouts with logical names through
-        spec_for/constrain/tree_shardings. Bare PartitionSpec() —
-        explicit replication — is fine."""
-        offenders = []
-        for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
-            rel = os.path.relpath(dirpath, PKG_ROOT)
-            if rel.split(os.sep)[0] == 'parallel':
-                continue
-            for fname in filenames:
-                if not fname.endswith('.py'):
-                    continue
-                path = os.path.join(dirpath, fname)
-                with open(path, encoding='utf-8') as f:
-                    text = f.read()
-                for m in re.finditer(r'PartitionSpec\(([^)]*)\)', text):
-                    if re.search(r'[\'\"]', m.group(1)):
-                        offenders.append(
-                            f'{os.path.relpath(path, PKG_ROOT)}: '
-                            f'PartitionSpec({m.group(1)})')
-        assert not offenders, (
-            'physical sharding rules outside parallel/ (use '
-            'sharding.spec_for / tree_shardings):\n' +
-            '\n'.join(offenders))
+    def test_sharding_containment_checker_clean(self):
+        """PartitionSpec axis-name strings and quoted collective axes
+        stay inside parallel/; layouts flow through spec_for /
+        constrain / tree_shardings and collectives take their axis as
+        a parameter."""
+        from skypilot_tpu import analysis
+        result = analysis.run_lint(select=['sharding-containment'])
+        assert not result.unwaived, '\n'.join(
+            str(f) for f in result.unwaived)
 
-    def test_no_hardcoded_collective_axis_outside_parallel(self):
-        """The PartitionSpec lint's collective-call sibling (the ISSUE-10
-        CI satellite): any `jax.lax.psum` / `psum_scatter` (the jax
-        spelling of reduce-scatter) / `all_gather` / `ppermute` call
-        whose ARGUMENTS carry a quoted axis-name string outside
-        parallel/ is a hardcoded physical-axis dependency waiting to
-        drift from the rule table — collective axis names must arrive
-        through a parameter or a parallel/ helper (the ring-attention
-        pattern: `axis_name` threaded in, spec_for for layouts)."""
-        call_re = re.compile(
-            r'\blax\.(?:psum|psum_scatter|all_gather|reduce_scatter|'
-            r'ppermute)\s*\(')
-        offenders = []
-        for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
-            rel = os.path.relpath(dirpath, PKG_ROOT)
-            if rel.split(os.sep)[0] == 'parallel':
-                continue
-            for fname in filenames:
-                if not fname.endswith('.py'):
-                    continue
-                path = os.path.join(dirpath, fname)
-                with open(path, encoding='utf-8') as f:
-                    text = f.read()
-                for m in call_re.finditer(text):
-                    depth, i = 1, m.end()
-                    while i < len(text) and depth:
-                        depth += {'(': 1, ')': -1}.get(text[i], 0)
-                        i += 1
-                    args = text[m.end():i - 1]
-                    # Strip comments: an apostrophe in a trailing
-                    # remark must not read as a hardcoded axis string.
-                    args = re.sub(r'#[^\n]*', '', args)
-                    if re.search(r'[\'\"]', args):
-                        offenders.append(
-                            f'{os.path.relpath(path, PKG_ROOT)}: '
-                            f'{text[m.start():i][:80]}')
-        assert not offenders, (
-            'collective calls with hardcoded axis-name strings outside '
-            'parallel/ (thread the axis in, or add a parallel/ '
-            'helper):\n' + '\n'.join(offenders))
-
-    def test_no_logical_rule_table_outside_parallel(self):
+    def test_exactly_one_rule_table_in_parallel(self):
         """Exactly one logical-axis rule table exists, and it lives in
-        parallel/sharding.py."""
-        hits = []
-        for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
-            for fname in filenames:
-                if not fname.endswith('.py'):
-                    continue
-                path = os.path.join(dirpath, fname)
-                with open(path, encoding='utf-8') as f:
-                    if 'LOGICAL_AXIS_RULES: ' in f.read():
-                        hits.append(os.path.relpath(path, PKG_ROOT))
-        assert hits == [os.path.join('parallel', 'sharding.py')], hits
+        parallel/sharding.py (AST assignment sites, not text scan)."""
+        from skypilot_tpu.analysis import core as skylint_core
+        from skypilot_tpu.analysis import sharding as sharding_checker
+        tree = skylint_core.ProjectTree(PKG_ROOT)
+        sites = sharding_checker.rule_table_sites(tree)
+        assert [rel for _repo_rel, rel, _line in sites] == \
+            ['parallel/sharding.py'], sites
 
 
 class TestDecodeRules:
