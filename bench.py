@@ -125,6 +125,19 @@ def _parse_args(argv=None):
                              'p50/p99 routed TTFT per policy, and pins '
                              'that miss/stale/corrupt-digest routing '
                              'falls back instead of erroring')
+    parser.add_argument('--dryrun-serve-disagg', action='store_true',
+                        help='emit the DISAGG_serve proxy row on CPU '
+                             '(no chip needed): a tiered fleet of real '
+                             'engines (1 prefill + 2 decode) runs a '
+                             'long-prompt storm through the two-stage '
+                             'KV handoff while a phase-aware '
+                             'monolithic 3-replica fleet runs the same '
+                             'storm — reports short-prompt (decode-'
+                             'tier) TTFT under the storm for both, '
+                             'measured handoff chunk/byte counters '
+                             'pinned against the expected block math, '
+                             'and greedy bit-identity vs a monolithic '
+                             'oracle for every request')
     parser.add_argument('--dryrun-lint', action='store_true',
                         help='emit the SKYLINT proxy row (no chip, no '
                              'jax): run the AST correctness analyzer '
@@ -721,6 +734,221 @@ def _dryrun_serve_fleet(args) -> int:
     return 0 if ok else 1
 
 
+def _dryrun_serve_disagg(args) -> int:
+    """DISAGG_serve: the disaggregated prefill/decode proxy row on CPU
+    (runs with the chip unreachable — the FLEET_serve pattern applied
+    to the two-stage KV handoff; docs/serving.md "Disaggregated
+    serving").
+
+    Two fleets of REAL engines run the same long-prompt storm plus
+    short interactive traffic:
+
+    - disaggregated: 1 prefill-tier + 2 decode-tier engines. Long
+      prompts route through the policy's two-stage handoff — the
+      prefill engine chunk-prefills, serializes CRC'd chunks, the
+      decode engine ingests them — then decode as ASYNC in-flight work
+      on the decode tier while short-prompt TTFT is measured.
+    - monolithic: 3 engines behind the phase-aware policy at its
+      DEFAULT knobs (fleet of 3 < the specialization floor of 4, so
+      routing is uniform — the honest PR-8 baseline at this size).
+      The same longs scatter as in-flight work, so shorts compete
+      with long-prompt CHUNKED PREFILL instead of mere decode.
+
+    Pins: every output (longs and shorts, both fleets) bit-identical
+    to a monolithic oracle; measured handoff chunks == longs ×
+    ceil(blocks/chunk_blocks) and payload bytes == blocks × the
+    per-block leaf math; zero chunks rejected; short-prompt p50 TTFT
+    on the disaggregated decode tier STRICTLY below the monolithic
+    fleet's. Emits ONE JSON row."""
+    del args
+    import dataclasses
+    import math as math_lib
+    import time as time_lib
+
+    import numpy as np
+
+    os.environ.setdefault('SKYTPU_SERVE_LB_DISAGG_THRESHOLD', '32')
+    from skypilot_tpu.models import get_config
+    from skypilot_tpu.models import inference as inference_lib
+    from skypilot_tpu.models import kv_cache as kv_cache_lib
+    from skypilot_tpu.serve.load_balancing_policies import \
+        PrefixAwarePolicy
+
+    cfg = dataclasses.replace(
+        get_config('test-tiny'), dtype='float32', param_dtype='float32',
+        max_seq_len=64, remat=False)
+    block_size = 8
+    chunk_blocks = 2
+    longs = [list(range(s, s + 48)) for s in (1, 60, 120, 180)]
+    shorts = [[7, 8, 9 + i] for i in range(6)]
+    long_new, short_new = 16, 4
+
+    def make_engine(tier='monolithic'):
+        return inference_lib.ContinuousBatchingEngine(
+            cfg, num_slots=4, paged_block_size=block_size,
+            prefix_cache=8, tier=tier)
+
+    try:
+        oracle = make_engine()
+    except ValueError as e:
+        # An unconstructable engine combination is a deterministic
+        # verdict — the structured skip, never the retry ladder.
+        _emit_skip(f'unsupported disagg combination: {e}',
+                   combo={'paged_block_size': block_size,
+                          'prefix_cache': 8})
+        return 3
+    ref_long = {i: oracle.generate(ids, max_new_tokens=long_new,
+                                   timeout=600)[0]
+                for i, ids in enumerate(longs)}
+    ref_short = {i: oracle.generate(ids, max_new_tokens=short_new,
+                                    timeout=600)[0]
+                 for i, ids in enumerate(shorts)}
+    oracle.stop()
+
+    def p50(values):
+        ordered = sorted(values)
+        return ordered[len(ordered) // 2]
+
+    def run_storm(engines, route_long):
+        """Submit every long ASYNC via `route_long` (returns the
+        engine that will decode it), then measure each short's TTFT
+        while the longs are in flight. Returns (short ttfts,
+        long-output mismatches)."""
+        futures = [(i, route_long(i, ids).submit(
+            ids, max_new_tokens=long_new)) for i, ids in
+            enumerate(longs)]
+        ttfts = []
+        mismatches = 0
+        for i, ids in enumerate(shorts):
+            engine = engines[i % len(engines)]
+            out, stats = engine.generate(ids, max_new_tokens=short_new,
+                                         timeout=600)
+            ttfts.append(stats['ttft_s'])
+            if out != ref_short[i]:
+                mismatches += 1
+        for i, future in futures:
+            out, _stats = future.result(timeout=600)
+            if out != ref_long[i]:
+                mismatches += 1
+        return ttfts, mismatches
+
+    # ---- disaggregated fleet: 1 prefill + 2 decode ----
+    pre = make_engine('prefill')
+    decs = [make_engine('decode') for _ in range(2)]
+    policy = PrefixAwarePolicy()
+    urls = ['replica://pre', 'replica://d0', 'replica://d1']
+    policy.set_ready_replicas(urls)
+    policy.set_replica_tiers({'replica://pre': 'prefill',
+                              'replica://d0': 'decode',
+                              'replica://d1': 'decode'})
+    by_url = {'replica://d0': decs[0], 'replica://d1': decs[1]}
+    handoff_chunks = 0
+    handoff_payload_bytes = 0
+    handoff_blocks = 0
+    handoffs = 0
+
+    def route_long_disagg(i, ids):
+        nonlocal handoff_chunks, handoff_payload_bytes, handoffs, \
+            handoff_blocks
+        url, info = policy.select(hint={'token_ids': ids,
+                                        'prompt_len': len(ids)})
+        assert info['result'] == 'handoff', info
+        pre.prefill_prefix(ids, timeout=600)
+        chunks = pre.export_prefix_chunks(ids, f'dry-{i}',
+                                          chunk_blocks=chunk_blocks)
+        dec = by_url[url]
+        for chunk in chunks:
+            result = dec.ingest_chunk(chunk)
+            _header, payload = kv_cache_lib.unpack_kv_chunk(chunk)
+            handoff_payload_bytes += len(payload)
+        handoff_chunks += len(chunks)
+        handoff_blocks += result['imported_blocks']
+        handoffs += 1
+        policy.note_routed(url)
+        return dec
+
+    t0 = time_lib.time()
+    disagg_ttfts, disagg_mismatch = run_storm(decs, route_long_disagg)
+    disagg_wall = time_lib.time() - t0
+    ingest_rejected = sum(e.ingest_stats['chunks_rejected']
+                          for e in decs)
+    prewarm_hits = sum(e.prefix_stats['prewarm_hits'] for e in decs)
+    for engine in decs:
+        engine._pool.check()  # pylint: disable=protected-access
+    meta = pre._expected_leaf_meta()  # pylint: disable=protected-access
+    per_block_bytes = sum(
+        int(np.prod(m['shape'], dtype=np.int64)) *
+        np.dtype(m['dtype']).itemsize for m in meta)
+    for engine in [pre] + decs:
+        engine.stop()
+
+    # ---- monolithic phase-aware fleet (PR-8 baseline, default knobs:
+    # a 3-replica fleet sits below the phase floor → uniform) ----
+    monos = [make_engine() for _ in range(3)]
+    mono_policy = PrefixAwarePolicy()
+    mono_urls = [f'replica://m{i}' for i in range(3)]
+    mono_policy.set_ready_replicas(mono_urls)
+    mono_by_url = dict(zip(mono_urls, monos))
+
+    def route_long_mono(_i, ids):
+        url, _info = mono_policy.select(hint={'token_ids': ids,
+                                              'prompt_len': len(ids)})
+        mono_policy.note_routed(url)
+        return mono_by_url[url]
+
+    t0 = time_lib.time()
+    mono_ttfts, mono_mismatch = run_storm(monos, route_long_mono)
+    mono_wall = time_lib.time() - t0
+    for engine in monos:
+        engine.stop()
+
+    blocks_per_long = -(-len(longs[0]) // block_size)
+    expect_blocks = len(longs) * blocks_per_long
+    expect_chunks = len(longs) * math_lib.ceil(
+        blocks_per_long / chunk_blocks)
+    expect_bytes = expect_blocks * per_block_bytes
+    disagg_p50 = p50(disagg_ttfts)
+    mono_p50 = p50(mono_ttfts)
+    ok = bool(
+        disagg_mismatch == 0 and mono_mismatch == 0
+        and handoffs == len(longs)
+        and handoff_chunks == expect_chunks
+        and handoff_blocks == expect_blocks
+        and handoff_payload_bytes == expect_bytes
+        and ingest_rejected == 0
+        and prewarm_hits >= len(longs)
+        and disagg_p50 < mono_p50)
+    row = {
+        'metric': 'DISAGG_serve dryrun storm short-prompt TTFT',
+        'value': round(disagg_p50 * 1e3, 2),
+        'unit': 'ms',
+        'vs_baseline': round(mono_p50 / max(1e-9, disagg_p50), 2),
+        'ok': ok,
+        'skipped': False,
+        'prefill_replicas': 1,
+        'decode_replicas': 2,
+        'long_prompts': len(longs),
+        'long_prompt_tokens': len(longs[0]),
+        'short_prompts': len(shorts),
+        'handoffs': handoffs,
+        'handoff_chunks': handoff_chunks,
+        'expected_chunks': expect_chunks,
+        'handoff_payload_bytes': handoff_payload_bytes,
+        'expected_payload_bytes': expect_bytes,
+        'per_block_bytes': per_block_bytes,
+        'blocks_per_long': blocks_per_long,
+        'ingest_chunks_rejected': ingest_rejected,
+        'prewarm_hits': prewarm_hits,
+        'output_mismatches': disagg_mismatch + mono_mismatch,
+        'disagg_short_ttft_p50_ms': round(disagg_p50 * 1e3, 2),
+        'mono_short_ttft_p50_ms': round(mono_p50 * 1e3, 2),
+        'disagg_wall_s': round(disagg_wall, 1),
+        'mono_wall_s': round(mono_wall, 1),
+    }
+    print(json.dumps(row))
+    return 0 if ok else 1
+
+
 def _dryrun_train_zero1(args) -> int:
     """MULTICHIP_train_zero1: the ZeRO-1 weight-update-sharding proxy
     row on 8 fake CPU devices (runs with the chip unreachable — the
@@ -1144,6 +1372,8 @@ def _worker(args) -> int:
         return _dryrun_serve_sharded(args)
     if args.dryrun_serve_fleet:
         return _dryrun_serve_fleet(args)
+    if args.dryrun_serve_disagg:
+        return _dryrun_serve_disagg(args)
     if args.dryrun_train_zero1:
         # CPU-only by design; forces its own fake-device backend
         # BEFORE any jax.devices() call.
@@ -1322,7 +1552,8 @@ def main() -> int:
         # and deterministic — run it right here.
         return _dryrun_lint(args)
     if (args.dryrun_serve_sharded or args.dryrun_serve_fleet or
-            args.dryrun_train_zero1 or args.dryrun_train_elastic):
+            args.dryrun_serve_disagg or args.dryrun_train_zero1 or
+            args.dryrun_train_elastic):
         return _supervise_dryrun(argv)
     return _supervise(argv)
 

@@ -821,14 +821,18 @@ def serve_status(service_name, endpoint_only):
         # their preemption lineage, and PREWARM shows whether the
         # replacement came up with the fleet's hot prefixes restored
         # (docs/resilience.md "Preemption lifecycle").
+        # TIER: prefill/decode for disaggregated fleets (docs/
+        # serving.md), monolithic otherwise; old rows without the
+        # field show monolithic.
         rows = [[i['replica_id'], i['status'], i['url'] or '-',
+                 i.get('tier') or 'monolithic',
                  'spot' if i['is_spot'] else 'on-demand', i['version'],
                  i.get('preemption_count', 0) or '-',
                  _prewarm_cell(i)]
                 for i in r['replica_info']]
         _print_table(rows,
-                     ['REPLICA', 'STATUS', 'URL', 'CAPACITY', 'VERSION',
-                      'PREEMPTS', 'PREWARM'])
+                     ['REPLICA', 'STATUS', 'URL', 'TIER', 'CAPACITY',
+                      'VERSION', 'PREEMPTS', 'PREWARM'])
 
 
 @serve.command('update')

@@ -135,6 +135,34 @@ _PREFIX_PREWARM_HIT = obs.counter(
     'skytpu_prefix_prewarm_hit_total',
     'Admission prefix-cache hits served from a PRE-WARMED (imported) '
     'entry — the TTFT saved across a preemption')
+_HANDOFF_EXPORT_CHUNKS = obs.counter(
+    'skytpu_handoff_export_chunks_total',
+    'KV handoff chunks serialized by the prefill tier for '
+    'engine→engine streaming (docs/serving.md "Disaggregated '
+    'serving")')
+_HANDOFF_EXPORT_BYTES = obs.counter(
+    'skytpu_handoff_export_bytes_total',
+    'KV handoff payload bytes serialized by the prefill tier')
+_HANDOFF_INGEST_CHUNKS = obs.counter(
+    'skytpu_handoff_ingest_chunks_total',
+    'KV handoff chunks received on the decode side, by result: ok '
+    '(applied), duplicate (retried seq acknowledged idempotently), '
+    'rejected (corrupt / out-of-order / layout mismatch), shed '
+    '(decode-side pool pressure — 503 rather than corruption)',
+    ('result',))
+_INGEST_OK = _HANDOFF_INGEST_CHUNKS.labels(result='ok')
+_INGEST_DUP = _HANDOFF_INGEST_CHUNKS.labels(result='duplicate')
+_INGEST_REJECTED = _HANDOFF_INGEST_CHUNKS.labels(result='rejected')
+_INGEST_SHED = _HANDOFF_INGEST_CHUNKS.labels(result='shed')
+_HANDOFF_INGEST_STREAMS = obs.counter(
+    'skytpu_handoff_ingest_streams_total',
+    'KV handoff streams resolved on the decode side: completed '
+    '(published to the prefix index), aborted (sender abort or apply '
+    'failure — blocks rolled back to refcount 0), expired (TTL sweep '
+    'reclaimed a stream whose sender died mid-handoff)', ('outcome',))
+_HANDOFF_INGEST_BLOCKS = obs.counter(
+    'skytpu_handoff_ingest_blocks_total',
+    'KV pool blocks published from completed handoff streams')
 _TP_SIZE = obs.gauge(
     'skytpu_engine_tp_size',
     'Tensor-parallel degree of the serving mesh (1 = single-chip)')
@@ -329,6 +357,43 @@ def infer_serving_tp(cfg: ModelConfig, n_devices: int) -> int:
             continue
         best = t
     return best
+
+
+ENGINE_TIERS = ('monolithic', 'prefill', 'decode')
+
+
+class _IngestSession:
+    """One in-flight prefill→decode handoff stream being assembled on
+    the decode side (docs/serving.md "Disaggregated serving").
+
+    Blocks are allocated from the pool as chunks land (so pool
+    pressure surfaces immediately as a shed, before any data is
+    staged), but the payload is STAGED host-side — nothing touches the
+    device pool until the final chunk's batched apply runs in the
+    engine tick thread. Rollback is therefore exact: releasing
+    `blocks` returns the stream to refcount-0 with the pool invariant
+    (`check()`) intact, no matter how many chunks had landed.
+
+    `pool` pins the BlockPool object the blocks came from: a watchdog
+    recovery or tick-failure reset swaps the engine's pool wholesale,
+    and a stale session must release against ITS pool (harmless on an
+    abandoned object), never against the successor's."""
+
+    __slots__ = ('stream_id', 'pool', 'blocks', 'next_seq',
+                 'staged_idx', 'staged_arr', 'chunks', 'bytes',
+                 'touched')
+
+    def __init__(self, stream_id: str, pool, now: float,
+                 n_leaves: int) -> None:
+        self.stream_id = stream_id
+        self.pool = pool
+        self.blocks: list = []
+        self.next_seq = 0
+        self.staged_idx: list = [[] for _ in range(n_leaves)]
+        self.staged_arr: list = [[] for _ in range(n_leaves)]
+        self.chunks = 0
+        self.bytes = 0
+        self.touched = now
 
 
 class _Inflight:
@@ -788,7 +853,9 @@ class ContinuousBatchingEngine:
                  paged_block_size: int = 0,
                  paged_num_blocks: Optional[int] = None,
                  prefill_chunk: int = 0,
-                 async_depth: int = 0) -> None:
+                 async_depth: int = 0,
+                 tier: str = 'monolithic',
+                 ingest_ttl: float = 60.0) -> None:
         import queue as queue_lib
         import threading
         self.cfg, self.params = _resolve_cfg_and_params(
@@ -922,6 +989,40 @@ class ContinuousBatchingEngine:
         # Cached routing-digest header value, keyed on (index identity,
         # index epoch) — see prefix_digest().
         self._digest_cache: Optional[tuple] = None
+        # -------- disaggregated serving (docs/serving.md) --------
+        # tier labels this engine's role in a disaggregated fleet:
+        # 'prefill' computes KV and streams it out (prefill_prefix +
+        # export_prefix_chunks), 'decode' assembles incoming streams
+        # into its own pool (ingest_chunk) so handed-off requests admit
+        # as full-prefix cache hits, 'monolithic' (default) does both
+        # phases locally. The tier is routing metadata — the engine
+        # surface is identical — but the specialized tiers REQUIRE the
+        # paged pool + prefix cache (block identity is the handoff
+        # unit).
+        if tier not in ENGINE_TIERS:
+            raise ValueError(f'unknown engine tier {tier!r}; expected '
+                             f'one of {ENGINE_TIERS}')
+        if tier != 'monolithic' and not (self.paged_block_size and
+                                         self.prefix_cache):
+            raise ValueError(
+                f'tier={tier!r} requires paged_block_size and '
+                f'prefix_cache (KV streams are block-granular and land '
+                f'in the prefix index)')
+        self.tier = tier
+        self._ingest_ttl = max(1.0, ingest_ttl)
+        self._ingest_lock = threading.Lock()
+        self._ingest_sessions: Dict[str, _IngestSession] = {}
+        self._ingest_meta: Optional[list] = None
+        self._ingest_elems: Optional[list] = None
+        self.ingest_stats = {'streams_completed': 0,
+                             'streams_aborted': 0, 'streams_expired': 0,
+                             'chunks_ok': 0, 'chunks_duplicate': 0,
+                             'chunks_rejected': 0, 'chunks_shed': 0,
+                             'blocks_ingested': 0}
+        # Work items needing exclusive access to the device pool tree
+        # (handoff gathers, ingest finalizes) run in the engine tick
+        # thread between dispatches — see _run_in_tick.
+        self._engine_work: 'collections.deque' = collections.deque()
         self.model = Transformer(self.cfg)
         self._rng = jax.random.PRNGKey(rng_seed)
         # -------- tensor-parallel serving (docs/performance.md) -----
@@ -1448,6 +1549,11 @@ class ContinuousBatchingEngine:
             self._generation += 1
             old_slots = self._slots
             old_queue = self._queue
+            # Pending engine-thread work (handoff gathers, ingest
+            # finalizes) dies with the generation: the successor must
+            # not run it against fresh state.
+            old_work = list(self._engine_work)
+            self._engine_work.clear()
             self._slots = [None] * self.num_slots
             self._queue = queue_lib.Queue()
             # The wedged thread may hold (or have donated) the old
@@ -1484,6 +1590,9 @@ class ContinuousBatchingEngine:
         _WEDGE_RECOVERIES.inc()
         err = exceptions.EngineWedgedError(
             f'{why}; request aborted by the engine watchdog')
+        for _fn, future in old_work:
+            if not future.done():
+                future.set_exception(err)
         for req in old_slots:
             if req is not None:
                 self._fail_request(req, err)
@@ -2074,6 +2183,426 @@ class ContinuousBatchingEngine:
             else '')
         return stats
 
+    # ---------- disaggregated prefill/decode handoff (hot path) ------
+    #
+    # docs/serving.md "Disaggregated serving". The prefill tier computes
+    # a prompt's KV into pool blocks (prefill_prefix), serializes them
+    # into CRC'd, sequence-numbered chunks (export_prefix_chunks —
+    # kv_cache.pack_kv_chunk framing) and the serve layer pushes them to
+    # a decode replica's POST /kv/ingest, which assembles them into ITS
+    # pool (ingest_chunk) and publishes the prefix entry — the
+    # handed-off request then admits there as a full-prefix cache hit
+    # (the PR-6 pre-warm path, bit-identity already pinned). Unlike
+    # export/import_prefixes — the whole-index, quiesced-engine
+    # preemption-RESCUE path — this is incremental and runs on LIVE
+    # engines: device access happens in the engine tick thread between
+    # dispatches (_run_in_tick), host staging on the caller's thread,
+    # and a torn/duplicated/reordered transfer rolls back or dedups
+    # instead of poisoning the pool.
+
+    def _run_in_tick(self, fn, timeout: float = 120.0):
+        """Run `fn(gen)` inside the engine tick thread (between
+        dispatches) and return its result. The decode/prefill jits
+        DONATE the cache, so any other thread touching the pool tree
+        races the donation cycle — everything device-facing in the
+        handoff path funnels through here instead."""
+        import concurrent.futures
+        future: 'concurrent.futures.Future' = concurrent.futures.Future()
+        # Enqueue under _thread_lock: _recover_from_wedge snapshots and
+        # clears this deque under the same lock, so the item lands
+        # either before the snapshot (and is failed by the recovery) or
+        # after the clear (and is served by the successor thread) —
+        # never in the gap, where it would be wiped with its future
+        # unresolved (the submit()/queue-swap discipline, applied
+        # here).
+        with self._thread_lock:
+            self._engine_work.append((fn, future))
+        self._ensure_thread()
+        self._wake.set()
+        return future.result(timeout=timeout)
+
+    def _drain_engine_work(self, gen: int) -> None:
+        """Run queued engine-thread work items. A failing item resolves
+        its own future and never kills the tick; a stale-generation
+        abort propagates (the thread must exit without touching its
+        successor's state)."""
+        while self._engine_work:
+            try:
+                fn, future = self._engine_work.popleft()
+            except IndexError:
+                return
+            try:
+                result = fn(gen)
+            except _StaleEngineError:
+                if not future.done():
+                    future.set_exception(exceptions.EngineWedgedError(
+                        'engine recovery interrupted the operation'))
+                raise
+            except BaseException as e:  # pylint: disable=broad-except
+                if not future.done():
+                    future.set_exception(e)
+            else:
+                if not future.done():
+                    future.set_result(result)
+
+    def _expected_leaf_meta(self) -> list:
+        """Per-leaf {shape, dtype} of the pool WITHOUT materializing it
+        (ingest validates chunk layout before the first tick ever
+        runs)."""
+        if self._ingest_meta is None:
+            shapes = nn.unbox(_abstract_init(self.model, self.cfg,
+                                             1)['cache'])
+            leaves = jax.tree.leaves(
+                shapes, is_leaf=lambda x: hasattr(x, 'shape'))
+            self._ingest_meta = self._pool_leaf_meta(leaves)
+            self._ingest_elems = [
+                int(np.prod(m['shape'], dtype=np.int64))
+                for m in self._ingest_meta]
+        return self._ingest_meta
+
+    def prefill_prefix(self, ids, timeout: float = 300.0
+                       ) -> Dict[str, Any]:
+        """Prefill-tier entry point: compute `ids`' KV into pool blocks
+        and publish them to the prefix index (the chunked-prefill path
+        a normal admission takes; the single sampled token is
+        discarded). Returns {'prompt_tokens', 'ttft_s', 'cached'} —
+        cached=False means the index evicted the entry already (storm
+        pressure) and a subsequent export will fail retryably."""
+        ids = [int(t) for t in ids]
+        if not (self.paged_block_size and self.prefix_cache):
+            raise ValueError('prefill_prefix requires paged_block_size '
+                             'and prefix_cache')
+        _out, stats = self.generate(ids, max_new_tokens=1,
+                                    temperature=0.0, timeout=timeout)
+        return {'prompt_tokens': len(ids), 'ttft_s': stats['ttft_s'],
+                'cached': tuple(ids) in self._prefix_entries}
+
+    def export_prefix_chunks(self, ids, stream_id: str,
+                             chunk_blocks: int = 4) -> List[bytes]:
+        """Serialize the cached prefix for exactly `ids` into framed
+        handoff chunks (list of packed bytes, seq order). The device
+        gather runs in the engine tick thread and reads ONLY the
+        prefix's own blocks (a few KB–MB), never the whole pool — this
+        is the hot path, not the preemption export. Raises ValueError
+        when the prefix is not cached (evicted / never prefilled):
+        retryable — the caller re-prefills or falls back monolithic."""
+        if not (self.paged_block_size and self.prefix_cache):
+            raise ValueError('export_prefix_chunks requires '
+                             'paged_block_size and prefix_cache')
+        key = tuple(int(t) for t in ids)
+        chunk_blocks = max(1, int(chunk_blocks))
+
+        def gather(gen):
+            del gen
+            blocks = self._prefix_entries.get(key)
+            if not isinstance(blocks, list) or not blocks:
+                raise ValueError(
+                    'prefix not cached on this replica (evicted or '
+                    'never prefilled); retry or fall back monolithic')
+            if self._cache is None:
+                raise ValueError('engine pool not initialized')
+            leaves, _treedef = jax.tree.flatten(self._cache)
+            groups = [blocks[i:i + chunk_blocks]
+                      for i in range(0, len(blocks), chunk_blocks)]
+            out = []
+            for grp in groups:
+                idx = _upload(list(grp), jnp.int32, self._repl)
+                parts = []
+                for leaf in leaves:
+                    axis = self._block_axis(leaf)
+                    sub = jnp.moveaxis(
+                        jnp.take(leaf, idx, axis=axis), axis, 0)
+                    parts.append(_land(sub).tobytes())
+                out.append((len(grp), b''.join(parts)))
+            return out, len(blocks)
+
+        payloads, total = self._run_in_tick(gather)
+        meta = self._expected_leaf_meta()
+        chunks: List[bytes] = []
+        start = 0
+        for seq, (nblk, payload) in enumerate(payloads):
+            final = seq == len(payloads) - 1
+            chunks.append(kv_cache_lib.pack_kv_chunk(
+                stream_id, seq, start, self.paged_block_size, meta,
+                payload, nblk, final=final,
+                key=list(key) if final else None,
+                total_blocks=total if final else None))
+            start += nblk
+            _HANDOFF_EXPORT_CHUNKS.inc()
+            _HANDOFF_EXPORT_BYTES.inc(len(payload))
+        return chunks
+
+    def _release_session_blocks(self, session: '_IngestSession') -> None:
+        try:
+            session.pool.release(session.blocks)
+        except ValueError:
+            # The pool was reset wholesale since these blocks were
+            # allocated (wedge recovery / tick-failure reset) — the
+            # whole old pool is garbage, nothing to roll back.
+            pass
+        session.blocks = []
+        session.staged_idx = [[] for _ in session.staged_idx]
+        session.staged_arr = [[] for _ in session.staged_arr]
+
+    def _rollback_session_locked(self, stream_id: str,
+                                 outcome: str) -> None:
+        """Drop a session and return its blocks to refcount-0 (the
+        pool `check()` invariant the chaos tests pin). Caller holds
+        _ingest_lock."""
+        session = self._ingest_sessions.pop(stream_id, None)
+        if session is None:
+            return
+        self._release_session_blocks(session)
+        key = {'aborted': 'streams_aborted',
+               'expired': 'streams_expired'}.get(outcome,
+                                                 'streams_aborted')
+        self.ingest_stats[key] += 1
+        _HANDOFF_INGEST_STREAMS.labels(outcome=outcome).inc()
+
+    def _expire_ingest_sessions_locked(self, now: float) -> None:
+        stale = [sid for sid, s in self._ingest_sessions.items()
+                 if now - s.touched > self._ingest_ttl]
+        for sid in stale:
+            logger.warning('ingest stream %s expired after %.0fs '
+                           'without a final chunk; rolling back', sid,
+                           self._ingest_ttl)
+            self._rollback_session_locked(sid, 'expired')
+
+    def abort_ingest(self, stream_id: str) -> bool:
+        """Roll a partial handoff stream back to refcount-0 (the LB
+        aborts after a prefill replica died mid-stream; the TTL sweep
+        catches streams nobody aborts). Idempotent; True iff a session
+        existed."""
+        with self._ingest_lock:
+            present = stream_id in self._ingest_sessions
+            self._rollback_session_locked(stream_id, 'aborted')
+        return present
+
+    def ingest_chunk(self, data: bytes) -> Dict[str, Any]:
+        """Apply one framed handoff chunk to this (decode-tier) engine.
+
+        Robustness contract (unit-pinned in tests/test_disagg.py):
+        corrupt chunks raise kv_cache.ChunkError and mutate NOTHING;
+        out-of-order chunks raise kv_cache.ChunkSequenceError carrying
+        the expected seq; a retried already-applied seq (including the
+        final chunk of an already-published stream) is acknowledged
+        idempotently without double-allocating; pool pressure sheds
+        (EngineOverloadedError → the server's 503 + Retry-After) with
+        the partial stream rolled back to refcount-0. The final chunk's
+        batched scatter + index publish run in the engine tick thread.
+        """
+        fault_injection.point('engine.ingest')
+        if not (self.paged_block_size and self.prefix_cache):
+            raise ValueError('KV ingest requires paged_block_size and '
+                             'prefix_cache')
+        if self._draining:
+            with self._ingest_lock:
+                self.ingest_stats['chunks_shed'] += 1
+            _INGEST_SHED.inc()
+            raise exceptions.EngineDrainingError(
+                'engine is draining; not accepting KV ingest')
+        try:
+            header, payload = kv_cache_lib.unpack_kv_chunk(data)
+        except kv_cache_lib.ChunkError:
+            with self._ingest_lock:
+                self.ingest_stats['chunks_rejected'] += 1
+            _INGEST_REJECTED.inc()
+            raise
+        meta = self._expected_leaf_meta()
+        if header['block_size'] != self.paged_block_size or \
+                kv_cache_lib.leaf_sig(header['leaves']) != \
+                kv_cache_lib.leaf_sig(meta):
+            with self._ingest_lock:
+                self.ingest_stats['chunks_rejected'] += 1
+            _INGEST_REJECTED.inc()
+            raise kv_cache_lib.ChunkError(
+                'chunk layout does not match this engine (block_size / '
+                'model config / dtype / kv-quant mismatch)')
+        sid, seq = header['stream_id'], int(header['seq'])
+        final = bool(header.get('final'))
+        now = time_lib.monotonic()
+        key: Optional[tuple] = None
+        with self._ingest_lock:
+            self._expire_ingest_sessions_locked(now)
+            session = self._ingest_sessions.get(sid)
+            if session is not None and session.pool is not self._pool:
+                # A recovery replaced the pool since this stream
+                # started; its blocks died with the old pool. Drop the
+                # session — the sender's retry restarts from seq 0.
+                del self._ingest_sessions[sid]
+                session = None
+            if session is None:
+                if final and tuple(header['key']) in \
+                        self._prefix_entries:
+                    # Retried final chunk of an already-published
+                    # stream: the publish won, ack idempotently.
+                    self.ingest_stats['chunks_duplicate'] += 1
+                    _INGEST_DUP.inc()
+                    return {'ok': True, 'duplicate': True, 'seq': seq}
+                if seq != 0:
+                    self.ingest_stats['chunks_rejected'] += 1
+                    _INGEST_REJECTED.inc()
+                    raise kv_cache_lib.ChunkSequenceError(0, seq)
+                # Decode-side admission gate: a NEW stream must leave
+                # headroom for at least one full-depth request beyond
+                # itself — shed (the server maps this to 503 +
+                # Retry-After) rather than let ingest starve live
+                # decode slots and corrupt under pressure.
+                floor = self._blocks_per_seq
+                if self._pool.free < int(header['num_blocks']) + floor:
+                    self.ingest_stats['chunks_shed'] += 1
+                    _INGEST_SHED.inc()
+                    raise exceptions.EngineOverloadedError(
+                        f'KV pool pressure: {self._pool.free} free '
+                        f'blocks cannot admit a new handoff stream '
+                        f'(need chunk + {floor} headroom)')
+                session = _IngestSession(sid, self._pool, now,
+                                         len(meta))
+                self._ingest_sessions[sid] = session
+            if seq < session.next_seq:
+                session.touched = now
+                self.ingest_stats['chunks_duplicate'] += 1
+                _INGEST_DUP.inc()
+                return {'ok': True, 'duplicate': True, 'seq': seq}
+            if seq > session.next_seq:
+                self.ingest_stats['chunks_rejected'] += 1
+                _INGEST_REJECTED.inc()
+                raise kv_cache_lib.ChunkSequenceError(session.next_seq,
+                                                      seq)
+            if int(header['start_block']) != len(session.blocks):
+                # seq matches but the block offset does not: the stream
+                # is incoherent — abort it wholesale.
+                self._rollback_session_locked(sid, 'aborted')
+                self.ingest_stats['chunks_rejected'] += 1
+                _INGEST_REJECTED.inc()
+                raise kv_cache_lib.ChunkError(
+                    f'chunk start_block {header["start_block"]} does '
+                    f'not match the {len(session.blocks)} blocks '
+                    f'assembled so far')
+            blocks: list = []
+            try:
+                for _ in range(int(header['num_blocks'])):
+                    blocks.append(self._pool.alloc())
+            except kv_cache_lib.PoolExhaustedError as e:
+                self._pool.release(blocks)
+                self._rollback_session_locked(sid, 'aborted')
+                self.ingest_stats['chunks_shed'] += 1
+                _INGEST_SHED.inc()
+                raise exceptions.EngineOverloadedError(
+                    f'KV pool exhausted mid-ingest: {e}') from e
+            idx = np.asarray(blocks, np.int32)
+            off = 0
+            try:
+                for i in range(len(meta)):
+                    dt = np.dtype(meta[i]['dtype'])
+                    count = len(blocks) * self._ingest_elems[i]
+                    arr = np.frombuffer(
+                        payload, dtype=dt, count=count,
+                        offset=off).reshape(
+                            (len(blocks),) + tuple(meta[i]['shape']))
+                    session.staged_idx[i].append(idx)
+                    session.staged_arr[i].append(arr)
+                    off += count * dt.itemsize
+            except ValueError as e:
+                # CRC passed but the payload length disagrees with the
+                # declared num_blocks — incoherent, abort the stream.
+                self._pool.release(blocks)
+                self._rollback_session_locked(sid, 'aborted')
+                self.ingest_stats['chunks_rejected'] += 1
+                _INGEST_REJECTED.inc()
+                raise kv_cache_lib.ChunkError(
+                    f'chunk payload does not match the pool layout: '
+                    f'{e}') from e
+            session.blocks.extend(blocks)
+            session.next_seq = seq + 1
+            session.chunks += 1
+            session.bytes += len(payload)
+            session.touched = now
+            if final:
+                if int(header['total_blocks']) != len(session.blocks):
+                    self._rollback_session_locked(sid, 'aborted')
+                    self.ingest_stats['chunks_rejected'] += 1
+                    _INGEST_REJECTED.inc()
+                    raise kv_cache_lib.ChunkError(
+                        f'stream assembled {len(session.blocks)} '
+                        f'blocks but the final chunk declares '
+                        f'{header["total_blocks"]}')
+                del self._ingest_sessions[sid]
+                key = tuple(int(t) for t in header['key'])
+            self.ingest_stats['chunks_ok'] += 1
+        _INGEST_OK.inc()
+        if not final:
+            return {'ok': True, 'seq': seq}
+
+        # Final chunk: ONE batched scatter per leaf + index publish,
+        # in the engine tick thread (exclusive pool access; the
+        # import_prefixes staging pattern applied per stream).
+        def apply(gen):
+            if self._cache is None:
+                self._cache = self._init_cache_for_mode()
+            leaves, treedef = jax.tree.flatten(self._cache)
+            for i in range(len(leaves)):
+                axis = self._block_axis(leaves[i])
+                bidx = np.concatenate(session.staged_idx[i])
+                arr = np.concatenate(session.staged_arr[i], axis=0)
+                arr = np.moveaxis(arr, 0, axis)
+                sel = (slice(None),) * axis + \
+                    (_upload(bidx, sharding=self._repl),)
+                leaves[i] = leaves[i].at[sel].set(
+                    _upload(np.ascontiguousarray(arr),
+                            sharding=self._repl))
+            cache = jax.tree.unflatten(treedef, leaves)
+
+            def commit():
+                if session.pool is not self._pool:
+                    # The pool was reset between assembly and apply
+                    # (tick-failure path keeps the generation): these
+                    # blocks no longer exist — publishing would poison
+                    # the successor pool.
+                    raise exceptions.EngineWedgedError(
+                        'engine recovered mid-ingest; stream lost')
+                self._cache = cache
+                displaced = self._prefix_entries.put(
+                    key, list(session.blocks))
+                for old_key, old_blocks in displaced:
+                    self._pool.release(old_blocks)
+                    self._prewarmed_keys.discard(old_key)
+                # Hits on an ingested entry count toward the prewarm
+                # metric: same semantics — TTFT served from KV this
+                # replica never computed.
+                self._prewarmed_keys.add(key)
+
+            self._commit_gen(gen, commit)
+            return True
+
+        import concurrent.futures
+        try:
+            self._run_in_tick(apply)
+        except BaseException as e:
+            with self._ingest_lock:
+                if not isinstance(e, (TimeoutError,
+                                      concurrent.futures.TimeoutError)):
+                    # Definitive failure: the apply never committed —
+                    # roll the blocks back to refcount-0. A TIMEOUT is
+                    # different: the apply may still be queued/running
+                    # and could yet publish these blocks, so releasing
+                    # them here would corrupt the pool; the watchdog's
+                    # wholesale pool reset is the recovery path for a
+                    # genuinely stalled tick thread.
+                    self._release_session_blocks(session)
+                self.ingest_stats['streams_aborted'] += 1
+            _HANDOFF_INGEST_STREAMS.labels(outcome='aborted').inc()
+            raise
+        imported = len(session.blocks)
+        with self._ingest_lock:
+            self.ingest_stats['streams_completed'] += 1
+            self.ingest_stats['blocks_ingested'] += imported
+        _HANDOFF_INGEST_STREAMS.labels(outcome='completed').inc()
+        _HANDOFF_INGEST_BLOCKS.inc(imported)
+        return {'ok': True, 'seq': seq, 'final': True,
+                'imported_blocks': imported,
+                'key_tokens': len(key)}
+
     def _admit(self, slot: int, req: '_Request', gen: int = -1) -> None:
         if self.paged_block_size:
             self._admit_paged(slot, req, gen)
@@ -2255,6 +2784,20 @@ class ContinuousBatchingEngine:
         # requests from the successor's queue.
         slots = self._slots
         queue = self._queue
+        # Engine-thread work (handoff gathers, ingest finalizes) runs
+        # FIRST: these items need the pool tree while no dispatch is in
+        # flight, and a decode-tier replica must finalize an ingest
+        # promptly even when it has no active slots.
+        if self._engine_work:
+            self._drain_engine_work(gen)
+        # Orphaned ingest streams (sender died mid-handoff AND the LB's
+        # best-effort /kv/abort never arrived) are reclaimed HERE, every
+        # tick — not only when the next chunk happens to arrive. A
+        # quiet decode replica must not hold a dead stream's blocks
+        # until new ingest traffic shows up.
+        if self._ingest_sessions:
+            with self._ingest_lock:
+                self._expire_ingest_sessions_locked(time_lib.monotonic())
         now = time_lib.time()        # wall: deadlines are absolute epoch
         mono_now = time_lib.monotonic()  # durations in error messages
         # Per-request deadlines: an expired (or caller-cancelled)

@@ -252,6 +252,11 @@ class PrefixIndex:
         if key in self._lru:
             self._lru.move_to_end(key)
 
+    def get(self, ids):
+        """Payload stored under exactly `ids`, or None. No recency
+        effect (an export must not perturb LRU order)."""
+        return self._lru.get(tuple(ids))
+
     def put(self, ids, payload) -> List[Tuple[tuple, Any]]:
         """Insert/refresh an entry; returns [(key, payload), ...] that
         were DISPLACED (an older payload under the same key, plus LRU
@@ -415,6 +420,166 @@ PREFIX_ARTIFACT_VERSION = 1
 class ArtifactError(Exception):
     """The artifact as a WHOLE is unusable (bad magic/version/header,
     or it was written by a pool with an incompatible layout)."""
+
+
+# ---------------------------------------------------------------------
+# KV chunk stream: block-granular prefill→decode handoff framing
+# ---------------------------------------------------------------------
+#
+# The whole-index artifact above is the preemption-RESCUE path: built in
+# memory, published atomically, consumed by a fresh replica. The hot
+# path of disaggregated serving (docs/serving.md "Disaggregated
+# serving") instead streams ONE prompt's blocks incrementally, engine →
+# engine, as a sequence of self-verifying chunks:
+#
+#     KV_CHUNK_MAGIC
+#     u32 big-endian header length
+#     header JSON:
+#       {"version": 1, "stream_id": s, "seq": n, "block_size": B,
+#        "leaves": [{"shape": [...], "dtype": "..."}, ...],
+#        "start_block": i, "num_blocks": k, "crc": c,
+#        # final chunk only:
+#        "final": true, "key": [...], "total_blocks": t}
+#     payload: the k blocks' data, per pool leaf, block-axis-first raw
+#              bytes — byte-identical to the artifact's per-prefix blob
+#              restricted to those blocks
+#
+# Robustness contract (unit-pinned in tests/test_disagg.py):
+# - every chunk carries a CRC over (payload, stream_id, seq,
+#   start_block, block_size, leaf signature): a corrupt or truncated
+#   chunk is rejected by unpack, never half-applied;
+# - `seq` makes ingest resumable/idempotent: a retried chunk (same
+#   stream, same seq) is acknowledged without double-allocating, an
+#   out-of-order chunk is refused with the expected seq so the sender
+#   resumes, never silently reordered;
+# - the final chunk carries the full token key so the receiver can
+#   verify total_blocks == ceil(len(key)/block_size) before publishing
+#   anything (the import_prefixes num_blocks check, applied per
+#   stream).
+
+KV_CHUNK_MAGIC = b'SKYTPU-KVCHUNK\n'
+KV_CHUNK_VERSION = 1
+
+
+class ChunkError(Exception):
+    """A KV stream chunk that cannot be trusted (bad magic/version/
+    header, CRC mismatch, truncated payload). The receiver must reject
+    the chunk wholesale — a retry of the same seq is always safe."""
+
+
+class ChunkSequenceError(Exception):
+    """A chunk arrived out of order. Carries the seq the receiver
+    expects so the sender can resume exactly there; a retried
+    ALREADY-APPLIED seq is instead acknowledged idempotently (never
+    double-allocated), so this only fires on genuine gaps."""
+
+    def __init__(self, expected: int, got: int) -> None:
+        super().__init__(f'out-of-order chunk: expected seq {expected}, '
+                         f'got {got}')
+        self.expected = expected
+        self.got = got
+
+
+def leaf_sig(leaves_meta: List[Dict[str, Any]]) -> str:
+    """Canonical signature of a pool's per-leaf {shape, dtype} list —
+    the compatibility check both the artifact and chunk-stream paths
+    share (public alias of the internal helper)."""
+    return _leaf_sig(leaves_meta)
+
+
+def _chunk_crc(payload, stream_id: str, seq: int, start_block: int,
+               num_blocks: int, block_size: int, sig: str,
+               key: Optional[Sequence[int]] = None) -> int:
+    """CRC over EVERY load-bearing field: payload bytes, stream
+    identity, ordering (seq/start_block), the chunk's block count, the
+    pool-compatibility inputs (block_size, leaf signature), and — on
+    the final chunk — the full token key. total_blocks needs no direct
+    coverage: the receiver cross-checks it against ceil(len(key)/
+    block_size), both operands of which ARE covered."""
+    crc = zlib.crc32(payload)
+    crc = zlib.crc32(stream_id.encode(), crc)
+    crc = zlib.crc32(
+        f'{seq}|{start_block}|{num_blocks}|{block_size}|{sig}'.encode(),
+        crc)
+    if key is not None:
+        crc = zlib.crc32(repr(tuple(int(t) for t in key)).encode(), crc)
+    return crc & 0xffffffff
+
+
+def pack_kv_chunk(stream_id: str, seq: int, start_block: int,
+                  block_size: int, leaves_meta: List[Dict[str, Any]],
+                  payload: bytes, num_blocks: int,
+                  final: bool = False,
+                  key: Optional[Sequence[int]] = None,
+                  total_blocks: Optional[int] = None) -> bytes:
+    """Frame one handoff chunk. `payload` is the gathered block bytes
+    (leaf-major, block-axis-first — the artifact blob layout). The
+    final chunk must carry the stream's full token `key` and
+    `total_blocks` so the receiver can validate the assembled stream
+    before publishing it."""
+    if final and (key is None or total_blocks is None):
+        raise ValueError('final chunk requires key and total_blocks')
+    sig = _leaf_sig(leaves_meta)
+    header: Dict[str, Any] = {
+        'version': KV_CHUNK_VERSION,
+        'stream_id': stream_id,
+        'seq': int(seq),
+        'block_size': int(block_size),
+        'leaves': leaves_meta,
+        'start_block': int(start_block),
+        'num_blocks': int(num_blocks),
+        'crc': _chunk_crc(payload, stream_id, seq, start_block,
+                          num_blocks, block_size, sig,
+                          key=key if final else None),
+    }
+    if final:
+        header['final'] = True
+        header['key'] = [int(t) for t in key]
+        header['total_blocks'] = int(total_blocks)
+    hdr = json.dumps(header).encode()
+    return b''.join([KV_CHUNK_MAGIC, struct.pack('>I', len(hdr)), hdr,
+                     payload])
+
+
+def unpack_kv_chunk(data: bytes) -> Tuple[Dict[str, Any], bytes]:
+    """(header, payload) of a framed chunk, CRC-verified. Raises
+    ChunkError on anything untrustworthy — the caller retries or
+    refuses, it never applies a suspect chunk."""
+    magic_len = len(KV_CHUNK_MAGIC)
+    if data[:magic_len] != KV_CHUNK_MAGIC:
+        raise ChunkError('not a KV stream chunk (bad magic)')
+    try:
+        (hlen,) = struct.unpack('>I', data[magic_len:magic_len + 4])
+        header = json.loads(data[magic_len + 4:magic_len + 4 + hlen])
+    except (struct.error, ValueError) as e:
+        raise ChunkError(f'unreadable chunk header: {e}') from e
+    if header.get('version') != KV_CHUNK_VERSION:
+        raise ChunkError(
+            f'chunk version {header.get("version")!r} != '
+            f'{KV_CHUNK_VERSION}')
+    payload = data[magic_len + 4 + hlen:]
+    try:
+        sig = _leaf_sig(header['leaves'])
+        expect = _chunk_crc(
+            payload, header['stream_id'], header['seq'],
+            header['start_block'], header['num_blocks'],
+            header['block_size'], sig,
+            key=header['key'] if header.get('final') else None)
+        if expect != header['crc']:
+            raise ChunkError('chunk CRC mismatch (corrupt or truncated '
+                             'on the wire)')
+        if header.get('final'):
+            need = -(-len(header['key']) // header['block_size'])
+            if header['total_blocks'] != need:
+                # key and block_size are CRC-covered; total_blocks is
+                # cross-checked against them so a corrupted count can
+                # never smuggle a short block table into the receiver.
+                raise ChunkError(
+                    f'final chunk total_blocks {header["total_blocks"]}'
+                    f' != ceil(len(key)/block_size) {need}')
+    except KeyError as e:
+        raise ChunkError(f'chunk header missing field {e}') from e
+    return header, payload
 
 
 def _leaf_sig(leaves_meta: List[Dict[str, Any]]) -> str:

@@ -193,9 +193,19 @@ class RequestRateAutoscaler(Autoscaler):
         }
 
         def key(info):
-            # Old versions first; within a version, least-useful first
-            # (PENDING before READY — ascending FSM order).
-            return (info.version, order.get(info.status, -1))
+            # PREFILL-tier replicas last: the autoscaler only ever
+            # grows/shrinks the decode tier (the prefill tier is
+            # fixed-size by spec), and the stable sort would otherwise
+            # pick the earliest-launched rows — exactly the prefill
+            # replicas service.py seeds first — silently collapsing a
+            # disaggregated fleet to decode-only on the first
+            # downscale. Then: old versions first; within a version,
+            # least-useful first (PENDING before READY — ascending FSM
+            # order).
+            is_prefill = getattr(info, 'tier', 'monolithic') == \
+                'prefill'
+            return (is_prefill, info.version,
+                    order.get(info.status, -1))
 
         ranked = sorted(infos, key=key)
         return [info.replica_id for info in ranked[:count]]

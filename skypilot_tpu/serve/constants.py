@@ -118,6 +118,45 @@ def lb_phase_prefill_fraction() -> float:
     return _env_float('SKYTPU_SERVE_LB_PHASE_PREFILL_FRACTION', 0.25)
 
 
+# ---- disaggregated prefill/decode (docs/serving.md) ----
+
+
+def lb_disagg_prompt_threshold() -> int:
+    """Prompt length (tokens) at and above which a tiered fleet runs
+    the two-stage handoff (prefill tier computes KV, streams it to a
+    decode replica, the request lands there warm). Defaults to the
+    phase-aware threshold so the admission bar is uniform across both
+    routing modes."""
+    explicit = _env_float('SKYTPU_SERVE_LB_DISAGG_THRESHOLD', -1.0)
+    if explicit >= 0:
+        return int(explicit)
+    return lb_phase_prompt_threshold()
+
+
+def handoff_chunk_blocks() -> int:
+    """KV blocks per handoff stream chunk (the engine→engine POST
+    /kv/ingest unit). Smaller chunks bound the loss from a prefill
+    replica preempted mid-stream; larger ones amortize per-request
+    framing + HTTP overhead."""
+    return max(1, int(_env_float('SKYTPU_SERVE_HANDOFF_CHUNK_BLOCKS',
+                                 4)))
+
+
+def handoff_timeout_seconds() -> float:
+    """LB-side deadline for one prefill→decode handoff attempt (the
+    /kv/prefill call, which includes the prefill compute AND the chunk
+    pushes). Past it the LB re-dispatches to another prefill replica
+    or falls back to monolithic serving on the decode replica."""
+    return _env_float('SKYTPU_SERVE_HANDOFF_TIMEOUT', 120.0)
+
+
+def ingest_session_ttl_seconds() -> float:
+    """How long a decode replica holds a partially-ingested handoff
+    stream before rolling it back to refcount-0 (the prefill replica
+    died mid-stream and nobody will ever finish or abort it)."""
+    return _env_float('SKYTPU_SERVE_INGEST_TTL', 60.0)
+
+
 # ---- metrics-driven autoscaling (serve/autoscalers.py) ----
 
 

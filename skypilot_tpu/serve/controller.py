@@ -76,7 +76,13 @@ class SkyServeController:
                     for decision in decisions:
                         if decision.operator == \
                                 autoscalers.AutoscalerDecisionOperator.SCALE_UP:
-                            self.replica_manager.scale_up(decision.target)
+                            # tier=None auto-assigns: tiered fleets
+                            # refill a lost prefill replica first,
+                            # then grow the decode tier (the prefill
+                            # tier is fixed-size by spec; decode
+                            # capacity is what load consumes).
+                            self.replica_manager.scale_up(
+                                decision.target)
                         else:
                             self.replica_manager.scale_down(
                                 decision.target)
@@ -243,6 +249,11 @@ class SkyServeController:
             # rotation the moment it syncs — no breaker round-trips.
             'draining_replica_urls':
                 self.replica_manager.get_draining_replica_urls(),
+            # Disaggregated fleets: url → prefill/decode/monolithic so
+            # the LB's two-stage scheduler knows the tiers before the
+            # first in-band X-SkyTPU-Tier header arrives.
+            'replica_tiers':
+                self.replica_manager.get_replica_tiers(),
         })
 
     async def _handle_replica_info(self,

@@ -22,6 +22,7 @@ from skypilot_tpu.observability import exposition
 from skypilot_tpu.observability import metrics as obs
 from skypilot_tpu.serve import constants
 from skypilot_tpu.serve import load_balancing_policies as policies
+from skypilot_tpu.utils import fault_injection
 
 logger = logging.getLogger(__name__)
 
@@ -57,6 +58,27 @@ _REPLICA_PHASE = obs.gauge(
     'skytpu_lb_replica_phase',
     '1 while the replica is designated prefill-leaning by the '
     'phase-aware partition, else 0', ('replica',))
+_HANDOFF_TOTAL = obs.counter(
+    'skytpu_lb_handoff_total',
+    'Two-stage prefill→decode handoffs by outcome: ok (KV streamed, '
+    'request landed warm on the decode tier), retry (one prefill '
+    'replica failed mid-handoff, re-dispatched to another), '
+    'fallback_monolithic (no prefill replica could finish — the '
+    'decode replica prefills itself; the request is NEVER lost)',
+    ('outcome',))
+_HANDOFF_CHUNKS = obs.counter(
+    'skytpu_lb_handoff_chunks_total',
+    'KV chunks streamed by completed handoffs (as reported by the '
+    'prefill replica)')
+_HANDOFF_BYTES = obs.counter(
+    'skytpu_lb_handoff_bytes_total',
+    'KV payload bytes streamed by completed handoffs')
+_HANDOFF_SECONDS = obs.histogram(
+    'skytpu_lb_handoff_seconds',
+    'Wall time of one completed handoff (prefill compute + chunk '
+    'pushes), LB-observed',
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0))
 
 _HOP_HEADERS = {
     'connection', 'keep-alive', 'proxy-authenticate',
@@ -259,6 +281,11 @@ class SkyServeLoadBalancer:
                 data = await resp.json()
                 urls = data.get('ready_replica_urls', [])
                 self.policy.set_ready_replicas(urls)
+                # Tiered (disaggregated) fleets: the controller knows
+                # each replica's tier at launch; in-band X-SkyTPU-Tier
+                # headers refine it between syncs.
+                self.policy.set_replica_tiers(
+                    data.get('replica_tiers', {}))
                 # Controller truth anchors the learned set, but a
                 # drain learned in-band (an X-SkyTPU-Draining answer
                 # from a replica the controller still reports READY —
@@ -327,11 +354,19 @@ class SkyServeLoadBalancer:
             if not isinstance(data, dict):
                 return None
             ids: Optional[List[int]] = None
+            # ids_exact: the ids ARE the tokens the replica will see
+            # (client-supplied token arrays). Byte-encoded text/chat
+            # hints are a GUESS that only matches byte-tokenizer
+            # fleets — fine for the advisory digest path, but the
+            # handoff path streams real KV and must not prefill under
+            # ids an HF-tokenized replica never produces.
+            ids_exact = False
             prompt_ids = data.get('prompt_ids')
             prompt = data.get('prompt')
             if isinstance(prompt_ids, (list, tuple)) and prompt_ids and \
                     isinstance(prompt_ids[0], (list, tuple)):
                 ids = [int(t) for t in prompt_ids[0]]
+                ids_exact = True
             elif isinstance(prompt, str):
                 ids = list(prompt.encode('utf-8'))
             elif isinstance(prompt, (list, tuple)) and prompt:
@@ -339,18 +374,27 @@ class SkyServeLoadBalancer:
                     ids = list(prompt[0].encode('utf-8'))
                 elif isinstance(prompt[0], int):
                     ids = [int(t) for t in prompt]
+                    ids_exact = True
             prompt_len: Optional[int] = len(ids) if ids else None
-            if prompt_len is None and \
-                    isinstance(data.get('messages'), list):
-                # Chat: the template is server-side, so there is
-                # nothing to hash — but the content length still
-                # phase-routes the request.
-                prompt_len = sum(
-                    len(str(m.get('content', '')))
-                    for m in data['messages'] if isinstance(m, dict))
+            if ids is None and isinstance(data.get('messages'), list):
+                # Chat: reproduce the server's generic role-tagged
+                # template under the byte tokenizer, so chat prompts
+                # carry real TOKEN counts (the phase/handoff admission
+                # threshold applies uniformly across routes) and can
+                # even digest-match byte-tokenized fleets. HF-tokenized
+                # fleets simply never match and fall back — the
+                # required fail-open behavior, same as text prompts.
+                parts = [
+                    f'{m.get("role", "user")}: {m.get("content", "")}'
+                    for m in data['messages'] if isinstance(m, dict)
+                ]
+                ids = list(('\n'.join(parts) +
+                            '\nassistant:').encode('utf-8'))
+                prompt_len = len(ids)
             if ids is None and prompt_len is None:
                 return None
-            return {'token_ids': ids, 'prompt_len': prompt_len}
+            return {'token_ids': ids, 'prompt_len': prompt_len,
+                    'ids_exact': ids_exact}
         except Exception:  # pylint: disable=broad-except
             return None
 
@@ -382,6 +426,19 @@ class SkyServeLoadBalancer:
                 _ROUTE_TOTAL.labels(result=result).inc()
             if route_info.get('phase'):
                 _PHASE_TOTAL.labels(phase=route_info['phase']).inc()
+            if result == 'handoff' and hint and hint.get('token_ids'):
+                # Two-stage scheduling (docs/serving.md "Disaggregated
+                # serving"): stream the prompt's KV prefill-tier →
+                # `replica_url` (the decode target) BEFORE forwarding
+                # the request there. _run_handoff never raises and
+                # never loses the request: on failure the decode
+                # replica simply prefills the prompt itself
+                # (monolithic fallback) — strictly slower, never
+                # wrong.
+                await self._run_handoff(route_info['prefill_url'],
+                                        replica_url,
+                                        hint['token_ids'],
+                                        blocked)
             _LB_REQUESTS.labels(replica=replica_url).inc()
             if tried:
                 # Second (or later) attempt: this IS the
@@ -453,6 +510,143 @@ class SkyServeLoadBalancer:
             status=503,
             text='No ready replicas. The service may be starting or '
                  'scaled to zero; retry shortly.')
+
+    # ---------------- disaggregated handoff orchestration ------------
+
+    async def _abort_ingest(self, decode_url: str,
+                            stream_id: str) -> None:
+        """Best-effort rollback of a partial ingest (the decode side's
+        TTL sweep reclaims streams this abort never reaches)."""
+        try:
+            async with self._session().post(
+                    decode_url + '/kv/abort',
+                    json={'stream_id': stream_id},
+                    timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                await resp.read()
+        except Exception:  # pylint: disable=broad-except
+            logger.debug('kv/abort to %s failed (TTL sweep will '
+                         'reclaim)', decode_url, exc_info=True)
+
+    def _next_prefill_replica(self, tried: Set[str],
+                              exclude: Set[str]) -> Optional[str]:
+        tiers = self.policy.replica_tiers() if hasattr(
+            self.policy, 'replica_tiers') else {}
+        pool = [u for u in self.policy.ready_replica_urls
+                if tiers.get(u) == 'prefill' and u not in tried and
+                u not in exclude and u not in self._draining_urls]
+        pool = [u for u in pool if u not in self.breaker.blocked(pool)]
+        if not pool:
+            return None
+        if hasattr(self.policy, 'replica_load'):
+            # Least-loaded, same as the policy's own prefill pick —
+            # concurrent long-prompt prefills spread across the tier
+            # instead of serializing on the smallest url.
+            return min(pool,
+                       key=lambda u: (self.policy.replica_load(u), u))
+        return min(pool)
+
+    async def _run_handoff(self, prefill_url: str, decode_url: str,
+                           token_ids, exclude: Set[str]) -> bool:
+        """Drive one prefill→decode KV handoff: POST /kv/prefill on the
+        prefill replica, which streams chunks straight to the decode
+        replica's /kv/ingest. A prefill replica that dies or errors
+        mid-handoff (preemption, kv.stream fault, shed) gets its
+        partial ingest ABORTED (rolled back to refcount-0 on the
+        decode side) and the handoff re-dispatches to another prefill
+        replica; when none can finish, returns False — the caller
+        proxies the request to the decode replica anyway, which serves
+        it monolithically. No path loses the request."""
+        t0 = time.monotonic()
+        tried: Set[str] = set()
+        current: Optional[str] = prefill_url
+        attempts = max(1, constants.lb_retry_attempts())
+        ids = [int(t) for t in token_ids]
+        for attempt in range(attempts):
+            if current is None:
+                break
+            stream_id = f'lb-{id(self):x}-{time.monotonic_ns():x}'
+            decode_shed = False
+            # Prefill-tier load accounting: /kv/prefill requests never
+            # ride the proxy path, so without this the policy reads
+            # every prefill replica as idle and serializes concurrent
+            # long prompts on one of them. Paired with note_done in
+            # the finally below.
+            self.policy.note_routed(current)
+            try:
+                # Chaos seam: an armed 'lb.handoff' fault is the
+                # two-stage dispatch itself failing (prefill replica
+                # unreachable at send time).
+                fault_injection.point('lb.handoff')
+                async with self._session().post(
+                        current + '/kv/prefill',
+                        json={'prompt_ids': ids,
+                              'target': decode_url,
+                              'stream_id': stream_id},
+                        timeout=aiohttp.ClientTimeout(
+                            total=constants.handoff_timeout_seconds())
+                ) as resp:
+                    # In-band intel (queue depth / tier / tokenizer)
+                    # rides /kv/prefill responses through the same
+                    # fleet-headers middleware as serving traffic.
+                    self.policy.observe_response(current, resp.headers)
+                    if resp.headers.get('X-SkyTPU-Draining') == '1':
+                        self._draining_urls.add(current)
+                    if resp.status == 200:
+                        data = await resp.json()
+                        _HANDOFF_TOTAL.labels(outcome='ok').inc()
+                        _HANDOFF_CHUNKS.inc(int(data.get('chunks', 0)))
+                        _HANDOFF_BYTES.inc(int(data.get('bytes', 0)))
+                        _HANDOFF_SECONDS.observe(
+                            time.monotonic() - t0)
+                        if attempt:
+                            logger.info(
+                                'handoff re-dispatch succeeded on %s '
+                                'after %d failed prefill replica(s)',
+                                current, attempt)
+                        return True
+                    text = await resp.text()
+                    try:
+                        push_status = json.loads(text).get('push_status')
+                    except (ValueError, AttributeError):
+                        push_status = None
+                    # The DECODE side shed the ingest (pool pressure):
+                    # re-dispatching to another prefill replica would
+                    # recompute the whole prefill into the same wall —
+                    # fall back monolithic on the decode replica now.
+                    decode_shed = (resp.status == 502 and
+                                   push_status == 503)
+                    logger.warning(
+                        'handoff via %s answered %d (%s); aborting '
+                        'partial ingest and %s', current,
+                        resp.status, text[:200],
+                        'falling back monolithic (decode-side ingest '
+                        'shed)' if decode_shed else 're-dispatching')
+            except fault_injection.InjectedFault as e:
+                logger.warning('handoff dispatch fault for %s: %s',
+                               current, e)
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    OSError) as e:
+                # The prefill replica never answered — preempted or
+                # dead mid-stream: charge its breaker so tier routing
+                # stops picking it, roll the partial ingest back.
+                self.breaker.record_failure(current)
+                logger.warning('handoff via %s failed (%s); aborting '
+                               'partial ingest and re-dispatching',
+                               current, e)
+            finally:
+                self.policy.note_done(current)
+            await self._abort_ingest(decode_url, stream_id)
+            if decode_shed:
+                break
+            tried.add(current)
+            current = self._next_prefill_replica(tried, exclude)
+            if current is not None:
+                _HANDOFF_TOTAL.labels(outcome='retry').inc()
+        _HANDOFF_TOTAL.labels(outcome='fallback_monolithic').inc()
+        logger.warning('handoff failed on every prefill replica; '
+                       'decode replica %s serves monolithically',
+                       decode_url)
+        return False
 
     async def _proxy_once(self, request: web.Request, replica_url: str,
                           headers, body,

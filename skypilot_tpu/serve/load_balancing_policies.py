@@ -79,6 +79,12 @@ class LoadBalancingPolicy:
         policy does not specialize)."""
         return set()
 
+    def set_replica_tiers(self, tiers: Dict[str, str]) -> None:
+        """Controller-fed tier map (url → prefill/decode/monolithic)
+        for disaggregated fleets; policies that ignore tiers ignore
+        it."""
+        del tiers
+
 
 class RoundRobinPolicy(LoadBalancingPolicy):
     """(reference: RoundRobinPolicy, load_balancing_policies.py:47)"""
@@ -127,9 +133,24 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
         # url -> requests routed here since the last depth observation.
         self._outstanding: Dict[str, int] = {}
         self._prefill: Set[str] = set()
+        # Disaggregated tiers (url → 'prefill'/'decode'/'monolithic'):
+        # fed by the controller sync and learned in-band from
+        # X-SkyTPU-Tier response headers. With BOTH specialized tiers
+        # present, long prompts take the two-stage handoff path and
+        # short prompts stay on the decode tier; an empty/uniform map
+        # leaves the historical phase-aware behavior untouched.
+        self._tiers: Dict[str, str] = {}
+        # url → 'byte'/'hf', learned in-band (X-SkyTPU-Tokenizer):
+        # gates the handoff for byte-encoded text/chat hints — an
+        # HF-tokenized fleet would never match the streamed prefix,
+        # turning every handoff into wasted prefill + LRU pollution.
+        # Unknown defaults to byte (the in-tree default; an HF fleet
+        # advertises itself on its first response).
+        self._tokenizers: Dict[str, str] = {}
         self.stats = {'hit': 0, 'miss': 0, 'stale': 0, 'fallback': 0,
                       'digest_rejected': 0, 'phase_prefill': 0,
-                      'phase_decode': 0}
+                      'phase_decode': 0, 'handoff': 0,
+                      'tier_decode': 0, 'handoff_skipped_tokenizer': 0}
 
     # ---------------- membership / phase partition ----------------
 
@@ -138,7 +159,8 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
             self.ready_replica_urls = list(urls)
             known = set(urls)
             for table in (self._digests, self._depths,
-                          self._outstanding):
+                          self._outstanding, self._tiers,
+                          self._tokenizers):
                 for url in list(table):
                     if url not in known:
                         del table[url]
@@ -159,13 +181,29 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
         with self._lock:
             return set(self._prefill)
 
+    def set_replica_tiers(self, tiers: Dict[str, str]) -> None:
+        with self._lock:
+            for url, tier in (tiers or {}).items():
+                if tier in ('prefill', 'decode', 'monolithic'):
+                    self._tiers[url] = tier
+
+    def replica_tiers(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._tiers)
+
     # ---------------- in-band intel ----------------
 
     def observe_response(self, url: str, headers) -> Optional[str]:
         now = self._clock()
         depth = headers.get('X-SkyTPU-Queue-Depth')
         digest = headers.get('X-SkyTPU-Prefix-Digest')
+        tier = headers.get('X-SkyTPU-Tier')
+        tokenizer = headers.get('X-SkyTPU-Tokenizer')
         with self._lock:
+            if tier in ('prefill', 'decode', 'monolithic'):
+                self._tiers[url] = tier
+            if tokenizer in ('byte', 'hf'):
+                self._tokenizers[url] = tokenizer
             if depth is not None:
                 try:
                     self._depths[url] = (max(0, int(depth)), now)
@@ -218,6 +256,13 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
             depth = 0
         return depth + self._outstanding.get(url, 0)
 
+    def replica_load(self, url: str) -> int:
+        """Public load read for the LB's own tie-breaks (handoff
+        re-dispatch picks the least-loaded surviving prefill
+        replica)."""
+        with self._lock:
+            return self._load(url, self._clock())
+
     # ---------------- selection ----------------
 
     def _prompt_hashes(self, token_ids, chunk: int) -> List[str]:
@@ -243,15 +288,32 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
             if not candidates:
                 return None, {'result': 'no_replica'}
 
+            # Disaggregated tiers (docs/serving.md "Disaggregated
+            # serving"): prefill-tier replicas are reserved for the
+            # two-stage handoff, so they leave the serving pool
+            # whenever anything else can serve — but an all-prefill
+            # candidate set still serves (never fail closed).
+            prefill_tier = [u for u in candidates
+                            if self._tiers.get(u) == 'prefill']
+            serve_pool = [u for u in candidates
+                          if self._tiers.get(u) != 'prefill']
+            if not serve_pool:
+                serve_pool = candidates
+                prefill_tier = []
+            tiered = bool(prefill_tier) and any(
+                self._tiers.get(u) == 'decode' for u in serve_pool)
+
             # 1. Cache-aware: deepest digest match wins; ties break by
-            # (load, url) so the choice is deterministic.
+            # (load, url) so the choice is deterministic. Restricted
+            # to the serving pool — a warm prefix on a prefill-tier
+            # replica must not pull decode traffic onto it.
             token_ids = hint.get('token_ids')
             saw_stale = saw_fresh = False
             if token_ids and len(token_ids) > 1:
                 staleness = constants.lb_digest_staleness_seconds()
                 hash_cache: Dict[int, List[str]] = {}
                 best: Optional[Tuple[int, int, str]] = None
-                for url in candidates:
+                for url in serve_pool:
                     digest = self._digests.get(url)
                     if digest is None:
                         continue
@@ -281,23 +343,61 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
                     return url, {'result': 'hit',
                                  'matched_tokens': -best[0]}
 
-            # 2. Phase-aware preference (uniform when the fleet is too
-            # small to specialize, or the preferred phase is fully
-            # excluded — never fail closed).
-            pool = candidates
+            prompt_len = hint.get('prompt_len') or (
+                len(token_ids) if token_ids else 0)
+
+            # 2a. Two-stage handoff (tiered fleets): a long prompt with
+            # no warm decode replica goes prefill tier → decode tier.
+            # The decode TARGET is chosen here (least-loaded among
+            # decode-tier replicas, falling back to any serveable one)
+            # so the blocks land where the request will run; the LB
+            # orchestrates the actual /kv/prefill push.
+            if tiered and token_ids and prompt_len >= \
+                    constants.lb_disagg_prompt_threshold():
+                # Tokenizer gate: byte-encoded text/chat hints only
+                # hand off when every involved replica tokenizes the
+                # same way the LB guessed — otherwise the streamed
+                # prefix would never match (double prefill + decode-
+                # side LRU pollution, all metrics reading "ok").
+                # Client-supplied token arrays (ids_exact) always
+                # qualify.
+                compatible = hint.get('ids_exact', True) or all(
+                    self._tokenizers.get(u, 'byte') == 'byte'
+                    for u in serve_pool + prefill_tier)
+                if compatible:
+                    decode_pref = [u for u in serve_pool
+                                   if self._tiers.get(u) == 'decode'] \
+                        or serve_pool
+                    decode_url = min(
+                        decode_pref,
+                        key=lambda u: (self._load(u, now), u))
+                    prefill_url = min(
+                        prefill_tier,
+                        key=lambda u: (self._load(u, now), u))
+                    self.stats['handoff'] += 1
+                    return decode_url, {'result': 'handoff',
+                                        'prefill_url': prefill_url,
+                                        'phase': None}
+                self.stats['handoff_skipped_tokenizer'] += 1
+
+            # 2b. Phase-aware preference — the heuristic partition for
+            # NON-tiered fleets (explicit tiers supersede it); uniform
+            # when the fleet is too small to specialize, or the
+            # preferred phase is fully excluded — never fail closed.
+            pool = serve_pool
             phase = None
-            if self._prefill:
-                prompt_len = hint.get('prompt_len') or (
-                    len(token_ids) if token_ids else 0)
+            if self._prefill and not tiered:
                 want_prefill = (prompt_len >=
                                 constants.lb_phase_prompt_threshold())
-                preferred = [u for u in candidates
+                preferred = [u for u in serve_pool
                              if (u in self._prefill) == want_prefill]
                 if preferred:
                     pool = preferred
                     phase = 'prefill' if want_prefill else 'decode'
                     self.stats['phase_prefill' if want_prefill
                                else 'phase_decode'] += 1
+            elif tiered:
+                self.stats['tier_decode'] += 1
 
             # 3. Least-loaded with deterministic tie-break.
             url = min(pool, key=lambda u: (self._load(u, now), u))

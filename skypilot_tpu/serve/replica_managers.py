@@ -58,11 +58,17 @@ class ReplicaInfo:
     ReplicaInfo, replica_managers.py:170)."""
 
     def __init__(self, replica_id: int, cluster_name: str, version: int,
-                 is_spot: bool) -> None:
+                 is_spot: bool, tier: str = 'monolithic') -> None:
         self.replica_id = replica_id
         self.cluster_name = cluster_name
         self.version = version
         self.is_spot = is_spot
+        # Disaggregated serving tier (docs/serving.md): 'prefill'
+        # replicas compute KV and stream it out, 'decode' replicas
+        # serve handed-off requests, 'monolithic' (default) does both.
+        # A replacement replica inherits its predecessor's tier so a
+        # preemption never silently reshapes the fleet.
+        self.tier = tier
         self.status = ReplicaStatus.PENDING
         self.first_ready_time: Optional[float] = None
         self.consecutive_failure_count = 0
@@ -99,6 +105,7 @@ class ReplicaInfo:
             # getattr: rows pickled by older builds lack these fields.
             'preemption_count': getattr(self, 'preemption_count', 0),
             'last_prewarm': getattr(self, 'last_prewarm', None),
+            'tier': getattr(self, 'tier', 'monolithic'),
         }
 
     def __repr__(self) -> str:
@@ -183,7 +190,8 @@ class SkyPilotReplicaManager:
 
     def scale_up(self,
                  resources_override: Optional[Dict[str, Any]] = None,
-                 preemption_lineage: int = 0) -> int:
+                 preemption_lineage: int = 0,
+                 tier: Optional[str] = None) -> int:
         """Async: spawns a launch worker; returns the new replica id
         (reference: scale_up → _launch_replica, replica_managers.py:671).
 
@@ -191,8 +199,19 @@ class SkyPilotReplicaManager:
         of a preempted one: it inherits the preemption count (surfaced
         by `serve status`) and its launch rides the shared retry ladder
         (utils/retry.py) so a preemption storm's replacements back off
-        with jitter instead of thundering-herding the provisioner."""
+        with jitter instead of thundering-herding the provisioner.
+
+        `tier=None` auto-assigns: tiered specs (prefill_replicas > 0)
+        refill the PREFILL tier to its spec'd size before launching
+        decode replicas, so rolling updates, autoscaler growth, and
+        failed-replica replenishment all preserve the disaggregated
+        shape instead of silently collapsing the fleet to decode-only;
+        untiered specs launch monolithic. An explicit tier (initial
+        seeding, a preemption replacement inheriting its
+        predecessor's) always wins."""
         with self.lock:
+            if tier is None:
+                tier = self._tier_for_new_replica_locked()
             replica_id = self._next_replica_id
             self._next_replica_id += 1
             cluster_name = constants.replica_cluster_name(
@@ -205,13 +224,30 @@ class SkyPilotReplicaManager:
             else:
                 is_spot = any(r.use_spot for r in self.task.resources)
             info = ReplicaInfo(replica_id, cluster_name, self.version,
-                               is_spot)
+                               is_spot, tier=tier)
             info.preemption_count = preemption_lineage
             self.replicas[replica_id] = info
             self._persist(info)
         self._spawn(self._launch_replica, replica_id,
                     resources_override or {}, preemption_lineage > 0)
         return replica_id
+
+    def _tier_for_new_replica_locked(self) -> str:
+        """Tier for a replica launched without an explicit one: keep
+        the spec's prefill_replicas invariant by counting live
+        same-version prefill replicas — a lost prefill replica is
+        refilled FIRST (counting the current version only means a
+        blue-green rollout sizes its own prefill tier instead of
+        crediting the outgoing fleet's). Caller holds self.lock."""
+        want = getattr(self.spec, 'prefill_replicas', 0) or 0
+        if want <= 0:
+            return 'monolithic'
+        live = sum(
+            1 for info in self.replicas.values()
+            if info.version == self.version and
+            getattr(info, 'tier', 'monolithic') == 'prefill' and
+            info.status.counts_toward_fleet())
+        return 'prefill' if live < want else 'decode'
 
     def scale_down(self, replica_id: int, purge: bool = False,
                    drain_seconds: float = 0.0) -> None:
@@ -272,10 +308,18 @@ class SkyPilotReplicaManager:
         # replica N's SKYTPU_REPLICA_ID leak into replica M's task).
         task = self.task.copy()
         port = _port_for_replica(self._base_port, replica_id)
+        with self.lock:
+            info = self.replicas.get(replica_id)
+            tier = getattr(info, 'tier', 'monolithic') if info else \
+                'monolithic'
         task.update_envs({
             'SKYTPU_REPLICA_ID': str(replica_id),
             'SKYTPU_REPLICA_PORT': str(port),
             'SKYTPU_SERVICE_NAME': self.service_name,
+            # The in-tree server reads this as its --tier default, so
+            # a tiered fleet's replicas come up in the right role with
+            # no per-replica YAML surgery.
+            'SKYTPU_REPLICA_TIER': tier,
         })
         if resources_override:
             task.set_resources({
@@ -657,11 +701,16 @@ class SkyPilotReplicaManager:
             # on-demand base) relaunching with the task default would
             # silently swap e.g. the guaranteed base for another spot.
             override = {'use_spot': info.is_spot}
+            tier = getattr(info, 'tier', 'monolithic')
         self.total_preemptions += 1
         _REPLICA_PREEMPTIONS.labels(service=self.service_name).inc()
         self.scale_down(replica_id, purge=True)
+        # The replacement keeps the preempted replica's TIER as well as
+        # its capacity type: losing a prefill replica must grow back a
+        # prefill replica, or a storm silently collapses the
+        # disaggregated fleet to decode-only.
         self.scale_up(resources_override=override,
-                      preemption_lineage=lineage)
+                      preemption_lineage=lineage, tier=tier)
 
     # ---------------- views / persistence ----------------
 
@@ -679,6 +728,15 @@ class SkyPilotReplicaManager:
                 i.url for i in self.replicas.values()
                 if i.status == ReplicaStatus.READY and i.url is not None
             ]
+
+    def get_replica_tiers(self) -> Dict[str, str]:
+        """url → tier for every replica with a url — the LB's
+        two-stage scheduler seed (refined in-band by X-SkyTPU-Tier)."""
+        with self.lock:
+            return {
+                i.url: getattr(i, 'tier', 'monolithic')
+                for i in self.replicas.values() if i.url is not None
+            }
 
     def get_draining_replica_urls(self) -> List[str]:
         """Replicas mid-preemption-drain: the LB excludes these the

@@ -113,6 +113,18 @@ def byte_decode(ids: List[int]) -> str:
         'utf-8', errors='replace')
 
 
+class _HandoffPushError(Exception):
+    """A chunk push to the decode replica failed past its retry budget.
+    `pushed` counts chunks the receiver acknowledged before the failure
+    (the partial stream the LB must abort)."""
+
+    def __init__(self, message: str, pushed: int,
+                 status: Optional[int] = None) -> None:
+        super().__init__(message)
+        self.pushed = pushed
+        self.status = status
+
+
 class InferenceServer:
 
     # Class-level defaults so a bare instance (tests wrap an existing
@@ -127,6 +139,11 @@ class InferenceServer:
     prefix_store: Optional[str] = None
     preempt_drain_timeout = 10.0
     last_prewarm: Optional[dict] = None
+    # Disaggregated serving (docs/serving.md): which tier this replica
+    # serves — 'prefill' computes KV and streams it out (/kv/prefill),
+    # 'decode' assembles incoming streams (/kv/ingest), 'monolithic'
+    # (default) runs both phases locally.
+    tier = 'monolithic'
 
     def __init__(self, model: str, max_seq_len: Optional[int] = None,
                  tokenizer: str = 'byte',
@@ -149,7 +166,8 @@ class InferenceServer:
                  async_depth: int = 0,
                  prefix_store: Optional[str] = None,
                  preempt_drain_timeout: float = 10.0,
-                 tp: int = 1) -> None:
+                 tp: int = 1,
+                 tier: str = 'monolithic') -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
@@ -204,7 +222,11 @@ class InferenceServer:
                                                paged_num_blocks=paged_num_blocks,
                                                prefill_chunk=prefill_chunk,
                                                async_depth=async_depth,
-                                               mesh=mesh)
+                                               mesh=mesh,
+                                               tier=tier,
+                                               ingest_ttl=serve_constants
+                                               .ingest_session_ttl_seconds())
+        self.tier = tier
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
         if tokenizer.startswith('hf:'):
@@ -254,7 +276,7 @@ class InferenceServer:
                          'X-SkyTPU-Draining': '1'})
         if not self.ready:
             return web.json_response({'status': 'warming'}, status=503)
-        payload = {'status': 'ok'}
+        payload = {'status': 'ok', 'tier': self.tier}
         if self.last_prewarm is not None:
             # Surfaced to the replica manager's readiness probe, which
             # records it on the ReplicaInfo (serve status shows it).
@@ -753,6 +775,212 @@ class InferenceServer:
                                      'key': key, 'error': str(e)}
         return self.last_prewarm
 
+    # -- disaggregated prefill/decode handoff (docs/serving.md) --
+    #
+    # The prefill tier computes a prompt's KV and pushes it engine →
+    # engine, block-granularly, to the decode replica the LB picked:
+    #   POST /kv/prefill  (prefill tier; body {prompt_ids, target,
+    #                      stream_id}) — prefill + chunked push
+    #   POST /kv/ingest   (decode tier; body = one framed chunk) —
+    #                      CRC+sequence-validated assembly
+    #   POST /kv/abort    (decode tier; body {stream_id}) — roll a
+    #                      partial stream back to refcount-0
+    # Failure semantics: a shed ingest answers 503 + Retry-After (the
+    # decode pool must never corrupt under pressure), an out-of-order
+    # chunk answers 409 with the expected seq (the pusher resumes
+    # there), a corrupt chunk answers 400 (the pusher may retry the
+    # same seq — ingest is idempotent per (stream, seq)).
+
+    def _push_stream(self, target: str, chunks, stream_id: str) -> dict:
+        """Push framed chunks to `target`'s /kv/ingest sequentially.
+        One transport retry per CHUNK (receiver dedups by seq — a
+        stream of many chunks survives one transient hiccup per chunk,
+        not two total) plus up to two 409-guided resumes per stream;
+        anything else raises _HandoffPushError."""
+        import requests as requests_lib
+        pushed = 0
+        bytes_total = 0
+        retries = 0        # total across the stream (reported)
+        chunk_retries = 0  # transport retries for the CURRENT seq
+        resumes = 0        # 409-guided resumes (whole stream)
+        i = 0
+        while i < len(chunks):
+            # Chaos seam: an armed 'kv.stream' fault is the prefill
+            # replica dying mid-stream (or the wire tearing) — the LB
+            # must re-dispatch or fall back, the decode side must roll
+            # the partial stream back to refcount-0.
+            fault_injection.point('kv.stream')
+            try:
+                resp = requests_lib.post(
+                    target + '/kv/ingest', data=chunks[i],
+                    headers={'Content-Type':
+                             'application/octet-stream'},
+                    timeout=30.0)
+            except requests_lib.RequestException as e:
+                if chunk_retries >= 1:
+                    raise _HandoffPushError(
+                        f'push to {target} failed: {e}', pushed) from e
+                chunk_retries += 1
+                retries += 1
+                continue           # retry the same seq — idempotent
+            if resp.status_code == 200:
+                pushed += 1
+                bytes_total += len(chunks[i])
+                i += 1
+                chunk_retries = 0
+                continue
+            if resp.status_code == 409 and resumes < 2:
+                # Out-of-order verdict carries the seq the receiver
+                # expects: resume exactly there.
+                try:
+                    expected = int(resp.json().get('expected', -1))
+                except (ValueError, AttributeError):
+                    expected = -1
+                if 0 <= expected < len(chunks):
+                    resumes += 1
+                    retries += 1
+                    i = expected
+                    chunk_retries = 0
+                    continue
+            raise _HandoffPushError(
+                f'push to {target} answered {resp.status_code}: '
+                f'{resp.text[:200]}', pushed,
+                status=resp.status_code)
+        return {'chunks': pushed, 'bytes': bytes_total,
+                'retries': retries}
+
+    def _prefill_and_push(self, ids, target: str, stream_id: str,
+                          chunk_blocks: int) -> dict:
+        t0 = time.monotonic()
+        pstats = self.engine.prefill_prefix(ids)
+        chunks = self.engine.export_prefix_chunks(
+            ids, stream_id, chunk_blocks=chunk_blocks)
+        push = self._push_stream(target, chunks, stream_id)
+        return {'ok': True, 'stream_id': stream_id,
+                'chunks': push['chunks'], 'bytes': push['bytes'],
+                'push_retries': push['retries'],
+                'blocks': -(-len(ids) //
+                            self.engine.paged_block_size),
+                'prefill_ttft_s': pstats['ttft_s'],
+                'handoff_s': time.monotonic() - t0}
+
+    async def handle_kv_prefill(self,
+                                request: web.Request) -> web.Response:
+        """POST /kv/prefill — the prefill-tier half of a handoff: chunk-
+        prefill the prompt into pool blocks, then stream them to the
+        decode replica named in `target`."""
+        if self.draining:
+            return self._unavailable('server is draining for shutdown',
+                                     retry_after=5, reason='draining')
+        if self.tier == 'decode':
+            return web.json_response(
+                {'error': 'this replica is decode-tier; /kv/prefill is '
+                          'a prefill-tier route'}, status=400)
+        try:
+            data = await request.json()
+        except Exception:  # pylint: disable=broad-except
+            return web.json_response({'error': 'body must be JSON'},
+                                     status=400)
+        prompt_ids = data.get('prompt_ids')
+        target = data.get('target')
+        if not isinstance(prompt_ids, (list, tuple)) or not prompt_ids \
+                or not all(isinstance(t, int) for t in prompt_ids):
+            return web.json_response(
+                {'error': 'prompt_ids must be a non-empty token list'},
+                status=400)
+        if not isinstance(target, str) or not target.startswith('http'):
+            return web.json_response(
+                {'error': 'target must be the decode replica URL'},
+                status=400)
+        stream_id = str(data.get('stream_id') or
+                        f'h-{time.time_ns():x}')
+        try:
+            chunk_blocks = int(data.get('chunk_blocks') or
+                               serve_constants.handoff_chunk_blocks())
+        except (TypeError, ValueError):
+            return web.json_response(
+                {'error': 'chunk_blocks must be an int'}, status=400)
+        loop = asyncio.get_event_loop()
+        try:
+            result = await loop.run_in_executor(
+                None, self._prefill_and_push,
+                [int(t) for t in prompt_ids], target.rstrip('/'),
+                stream_id, chunk_blocks)
+        except exceptions.EngineOverloadedError as e:
+            return self._unavailable(str(e))
+        except _HandoffPushError as e:
+            # Mid-stream push failure: the LB aborts the partial
+            # ingest and re-dispatches / falls back. 502 = upstream
+            # (decode-side or wire) trouble, retryable by contract.
+            # push_status relays the DECODE side's verdict so the LB
+            # can tell a shed ingest (503: re-dispatching to another
+            # prefill replica just recomputes the prefill into the
+            # same wall) from a dead wire (retryable elsewhere).
+            return web.json_response(
+                {'error': str(e), 'stream_id': stream_id,
+                 'pushed_chunks': e.pushed,
+                 'push_status': e.status}, status=502)
+        except fault_injection.InjectedFault as e:
+            return web.json_response(
+                {'error': f'handoff stream fault: {e}',
+                 'stream_id': stream_id}, status=500)
+        except ValueError as e:
+            # Prefix evicted between prefill and export (storm
+            # pressure), or an unservable prompt: retryable conflict —
+            # the LB re-dispatches or falls back monolithic.
+            return web.json_response(
+                {'error': str(e), 'stream_id': stream_id}, status=409)
+        return web.json_response(result)
+
+    async def handle_kv_ingest(self,
+                               request: web.Request) -> web.Response:
+        """POST /kv/ingest — apply one framed handoff chunk to this
+        decode replica's pool (see engine.ingest_chunk for the
+        idempotency/rollback contract)."""
+        from skypilot_tpu.models import kv_cache as kv_cache_lib
+        if self.tier == 'prefill':
+            return web.json_response(
+                {'error': 'this replica is prefill-tier; /kv/ingest is '
+                          'a decode-tier route'}, status=400)
+        data = await request.read()
+        if not data:
+            return web.json_response({'error': 'empty chunk'},
+                                     status=400)
+        loop = asyncio.get_event_loop()
+        try:
+            result = await loop.run_in_executor(
+                None, self.engine.ingest_chunk, data)
+        except kv_cache_lib.ChunkSequenceError as e:
+            return web.json_response(
+                {'error': str(e), 'expected': e.expected}, status=409)
+        except kv_cache_lib.ChunkError as e:
+            return web.json_response({'error': str(e)}, status=400)
+        except exceptions.EngineDrainingError as e:
+            return self._unavailable(str(e), retry_after=5,
+                                     reason='draining')
+        except exceptions.EngineOverloadedError as e:
+            # The decode-side admission gate: shed, never corrupt.
+            return self._unavailable(str(e), retry_after=1,
+                                     reason='ingest-pressure')
+        except fault_injection.InjectedFault as e:
+            return web.json_response(
+                {'error': f'ingest fault: {e}'}, status=500)
+        return web.json_response(result)
+
+    async def handle_kv_abort(self,
+                              request: web.Request) -> web.Response:
+        """POST /kv/abort — roll a partial handoff stream back to
+        refcount-0 (idempotent)."""
+        try:
+            data = await request.json()
+            stream_id = str(data['stream_id'])
+        except Exception:  # pylint: disable=broad-except
+            return web.json_response(
+                {'error': 'body must be JSON with stream_id'},
+                status=400)
+        aborted = self.engine.abort_ingest(stream_id)
+        return web.json_response({'ok': True, 'aborted': aborted})
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
         """Prometheus text exposition of the process-wide registry:
         engine TTFT/TPOT histograms, queue depth, shed counters, and
@@ -1095,6 +1323,14 @@ class InferenceServer:
             return headers
         try:
             headers['X-SkyTPU-Queue-Depth'] = str(engine.queue_load())
+            headers['X-SkyTPU-Tier'] = getattr(self, 'tier',
+                                               'monolithic')
+            # The LB's handoff gate needs to know whether its
+            # byte-encoded text/chat hints match this replica's own
+            # tokenization (docs/serving.md "Disaggregated serving").
+            headers['X-SkyTPU-Tokenizer'] = (
+                'hf' if getattr(self, '_hf_tokenizer', None) is not None
+                else 'byte')
             digest = engine.prefix_digest()
             if digest:
                 headers['X-SkyTPU-Prefix-Digest'] = digest
@@ -1123,6 +1359,9 @@ class InferenceServer:
         app.router.add_get('/health', self.handle_health)
         app.router.add_get('/metrics', self.handle_metrics)
         app.router.add_post('/preempt', self.handle_preempt)
+        app.router.add_post('/kv/prefill', self.handle_kv_prefill)
+        app.router.add_post('/kv/ingest', self.handle_kv_ingest)
+        app.router.add_post('/kv/abort', self.handle_kv_abort)
         app.router.add_post('/generate', self.handle_generate)
         app.router.add_post('/v1/completions', self.handle_v1_completions)
         app.router.add_post('/v1/chat/completions', self.handle_v1_chat)
@@ -1259,6 +1498,20 @@ def main(argv=None) -> int:
                              'ready. Requires --paged-block-size and '
                              '--prefix-cache. Default: '
                              '$SKYTPU_PREFIX_STORE')
+    parser.add_argument('--tier',
+                        default=os.environ.get('SKYTPU_REPLICA_TIER',
+                                               'monolithic'),
+                        choices=['monolithic', 'prefill', 'decode'],
+                        help='disaggregated serving tier '
+                             '(docs/serving.md): prefill replicas '
+                             'compute KV and stream it block-'
+                             'granularly to decode replicas '
+                             '(/kv/prefill → /kv/ingest); decode '
+                             'replicas serve handed-off requests from '
+                             'the ingested prefix. Requires '
+                             '--paged-block-size and --prefix-cache '
+                             'for the specialized tiers. Default: '
+                             '$SKYTPU_REPLICA_TIER or monolithic')
     parser.add_argument('--preempt-drain-timeout', type=float,
                         default=serve_constants
                         .preempt_notice_budget_seconds(),
@@ -1293,7 +1546,8 @@ def main(argv=None) -> int:
                              async_depth=args.async_depth,
                              prefix_store=args.prefix_store,
                              preempt_drain_timeout=args.preempt_drain_timeout,
-                             tp=args.tp)
+                             tp=args.tp,
+                             tier=args.tier)
     logger.info('sampling filters: top_k=%s top_p=%s (0 = off)',
                 args.top_k, args.top_p)
     # Preemption pre-warm BEFORE ready: a replacement replica restores

@@ -51,9 +51,17 @@ def run_service(service_name: str, task_yaml: str, controller_port: int,
     controller = controller_lib.SkyServeController(
         service_name, spec, task, controller_port,
         task_yaml_path=task_yaml, version=version)
-    # Seed the fleet at min_replicas; the autoscaler takes over from here.
-    for _ in range(spec.min_replicas):
-        controller.replica_manager.scale_up()
+    # Seed the fleet at min_replicas; the autoscaler takes over from
+    # here. Disaggregated fleets (spec.prefill_replicas > 0) launch
+    # the first N replicas as the dedicated prefill tier and the rest
+    # as decode — docs/serving.md "Disaggregated serving".
+    prefill_n = getattr(spec, 'prefill_replicas', 0)
+    for i in range(spec.min_replicas):
+        if prefill_n:
+            tier = 'prefill' if i < prefill_n else 'decode'
+        else:
+            tier = 'monolithic'
+        controller.replica_manager.scale_up(tier=tier)
     controller.start_in_thread()
     if not controller.wait_port_ready():
         logger.error('Controller REST did not come up.')

@@ -35,6 +35,7 @@ class SkyServiceSpec:
         target_queue_depth_per_replica: Optional[float] = None,
         target_ttft_seconds: Optional[float] = None,
         target_tpot_seconds: Optional[float] = None,
+        prefill_replicas: int = 0,
     ) -> None:
         if not readiness_path.startswith('/'):
             raise ValueError(
@@ -102,6 +103,20 @@ class SkyServiceSpec:
         self.target_queue_depth_per_replica = target_queue_depth_per_replica
         self.target_ttft_seconds = target_ttft_seconds
         self.target_tpot_seconds = target_tpot_seconds
+        # Disaggregated serving (docs/serving.md): the first N of the
+        # fleet's replicas launch as the dedicated prefill tier, the
+        # rest as the decode tier; 0 = a classic monolithic fleet. The
+        # prefill tier is part of min_replicas, not in addition to it,
+        # and at least one decode replica must remain to serve.
+        prefill_replicas = int(prefill_replicas or 0)
+        if prefill_replicas < 0:
+            raise ValueError('prefill_replicas must be >= 0')
+        if prefill_replicas and prefill_replicas >= min_replicas:
+            raise ValueError(
+                f'prefill_replicas ({prefill_replicas}) must leave at '
+                f'least one decode replica below min_replicas '
+                f'({min_replicas})')
+        self.prefill_replicas = prefill_replicas
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -159,9 +174,12 @@ class SkyServiceSpec:
                         'dynamic_ondemand_fallback',
                         'use_ondemand_fallback',
                         'target_queue_depth_per_replica',
-                        'target_ttft_seconds', 'target_tpot_seconds'):
+                        'target_ttft_seconds', 'target_tpot_seconds',
+                        'prefill_replicas'):
                 if key in policy:
                     kwargs[key] = policy[key]
+        if 'prefill_replicas' in config:
+            kwargs['prefill_replicas'] = config['prefill_replicas']
         return cls(**kwargs)
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -175,6 +193,8 @@ class SkyServiceSpec:
         if self.readiness_headers:
             probe['headers'] = self.readiness_headers
         config: Dict[str, Any] = {'readiness_probe': probe}
+        if getattr(self, 'prefill_replicas', 0):
+            config['prefill_replicas'] = self.prefill_replicas
         if not self.autoscaling_enabled and \
                 self.max_replicas == self.min_replicas:
             config['replicas'] = self.min_replicas

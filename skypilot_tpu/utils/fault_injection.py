@@ -30,6 +30,22 @@ on the framework's failure-critical paths:
                     response header; a failure simulates a corrupt
                     digest on the wire (routing must fall back to
                     least-loaded, never error)
+    lb.handoff      serve/load_balancer — before the LB dispatches a
+                    prefill→decode KV handoff (/kv/prefill); a failure
+                    simulates the prefill replica unreachable at send
+                    time (re-dispatch to another prefill replica, or
+                    monolithic fallback on the decode replica — the
+                    request is never lost)
+    kv.stream       serve/server — before each KV handoff chunk push
+                    (prefill replica → decode /kv/ingest); a failure
+                    simulates the prefill replica preempted/dying
+                    mid-stream (the partial ingest must roll back to
+                    refcount-0 on the decode side)
+    engine.ingest   models/inference.ContinuousBatchingEngine
+                    .ingest_chunk — as a decode replica receives a
+                    handoff chunk; a failure simulates the ingest path
+                    dying mid-stream (the sender re-dispatches; the
+                    TTL sweep reclaims the partial session)
     train.step      train/elastic.ElasticTrainLoop — before each train
                     step dispatch; a failure simulates the slice dying
                     mid-step (the in-flight step is lost, nothing else)
@@ -84,6 +100,9 @@ KNOWN_POINTS = (
     'storage.export',
     'storage.import',
     'lb.digest',
+    'lb.handoff',
+    'kv.stream',
+    'engine.ingest',
     'train.step',
     'train.save',
     'train.notice',

@@ -198,6 +198,7 @@ class TestServeStatusPreemption:
                 {'replica_id': 2, 'status': 'READY',
                  'url': 'http://127.0.0.1:3', 'is_spot': True,
                  'version': 1, 'preemption_count': 2,
+                 'tier': 'prefill',
                  'last_prewarm': {'status': 'ok', 'imported': 3,
                                   'partial': False}},
                 # A row from an older build (no lifecycle keys) still
@@ -217,6 +218,13 @@ class TestServeStatusPreemption:
         line2 = [l for l in result.output.splitlines()
                  if l.strip().startswith('2')][0]
         assert ' 2 ' in line2  # the preemption lineage column
+        # TIER column (disaggregated fleets): explicit tier rendered,
+        # rows without the field (older builds) default to monolithic.
+        assert 'TIER' in result.output
+        assert 'prefill' in line2
+        line3 = [l for l in result.output.splitlines()
+                 if l.strip().startswith('3')][0]
+        assert 'monolithic' in line3
 
 
 @pytest.mark.slow

@@ -556,6 +556,8 @@ class TestFleetIntelHeaders:
             prefix_digest=lambda: 'v1:8:2:abcd1234')
         headers = server._fleet_intel_headers()  # pylint: disable=protected-access
         assert headers == {'X-SkyTPU-Queue-Depth': '3',
+                           'X-SkyTPU-Tier': 'monolithic',
+                           'X-SkyTPU-Tokenizer': 'byte',
                            'X-SkyTPU-Prefix-Digest': 'v1:8:2:abcd1234'}
 
     def test_headers_degrade_without_digest_or_engine(self):
@@ -563,7 +565,9 @@ class TestFleetIntelHeaders:
         server.engine = types.SimpleNamespace(
             queue_load=lambda: 0, prefix_digest=lambda: None)
         assert server._fleet_intel_headers() == {  # pylint: disable=protected-access
-            'X-SkyTPU-Queue-Depth': '0'}
+            'X-SkyTPU-Queue-Depth': '0',
+            'X-SkyTPU-Tier': 'monolithic',
+            'X-SkyTPU-Tokenizer': 'byte'}
         server.engine = None
         assert server._fleet_intel_headers() == {}  # pylint: disable=protected-access
 
