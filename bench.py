@@ -138,6 +138,18 @@ def _parse_args(argv=None):
                              'pinned against the expected block math, '
                              'and greedy bit-identity vs a monolithic '
                              'oracle for every request')
+    parser.add_argument('--dryrun-trace', action='store_true',
+                        help='emit the TRACE proxy row on CPU (no chip '
+                             'needed): a real 2-hop disaggregated '
+                             'handoff (1 prefill + 1 decode server '
+                             'behind the real LB, live HTTP) with '
+                             'tracing ON — pins ONE trace with '
+                             'LB→prefill→ingest→decode parentage '
+                             'intact (≥4 hops) and the '
+                             'queue-wait/prefill/decode span shape, '
+                             'and reports the measured enabled-vs-'
+                             'disabled decode-tick overhead ratio '
+                             '(docs/observability.md "Tracing")')
     parser.add_argument('--dryrun-lint', action='store_true',
                         help='emit the SKYLINT proxy row (no chip, no '
                              'jax): run the AST correctness analyzer '
@@ -949,6 +961,220 @@ def _dryrun_serve_disagg(args) -> int:
     return 0 if ok else 1
 
 
+def _dryrun_trace(args) -> int:
+    """TRACE: the end-to-end tracing proxy row on CPU (runs with the
+    chip unreachable — the DISAGG_serve pattern applied to the span
+    layer; docs/observability.md "Tracing").
+
+    A real 2-hop disaggregated handoff over LIVE HTTP — 1 prefill + 1
+    decode server behind the real LB, tracing ON — must produce ONE
+    trace whose span tree keeps the full parentage:
+
+        lb.request → lb.handoff → lb.handoff_attempt →
+        server.request[/kv/prefill] → server.kv_push →
+        engine.ingest_publish (decode side)
+
+    (≥4 hops LB→prefill→ingest→decode) with queue-wait / prefill /
+    decode spans present for the served request. Separately, a steady
+    decode run measures the ENABLED-vs-DISABLED per-tick overhead
+    ratio — the disabled path is pinned elsewhere at one enabled-check
+    (tests/test_tracing.py); here the enabled cost is REPORTED so the
+    row catches a regression that makes tracing unaffordable."""
+    del args
+    import asyncio
+    import dataclasses
+    import socket
+    import threading
+    import time as time_lib
+
+    import requests as requests_lib
+
+    os.environ['SKYTPU_SERVE_LB_DISAGG_THRESHOLD'] = '16'
+    os.environ['SKYTPU_SERVE_HANDOFF_CHUNK_BLOCKS'] = '1'
+    from skypilot_tpu.models import get_config
+    from skypilot_tpu.models.inference import ContinuousBatchingEngine
+    from skypilot_tpu.observability import tracing
+    from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+    from skypilot_tpu.serve.load_balancing_policies import \
+        PrefixAwarePolicy
+    from skypilot_tpu.serve.server import InferenceServer
+
+    cfg = dataclasses.replace(
+        get_config('test-tiny'), dtype='float32', param_dtype='float32',
+        max_seq_len=64, remat=False)
+
+    def free_port():
+        with socket.socket() as sock:
+            sock.bind(('', 0))
+            return sock.getsockname()[1]
+
+    def serve_app(app):
+        from aiohttp import web
+        port = free_port()
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            loop.run_until_complete(
+                web.TCPSite(runner, '127.0.0.1', port).start())
+            loop.run_forever()
+
+        threading.Thread(target=run, daemon=True).start()
+        deadline = time_lib.time() + 30
+        while time_lib.time() < deadline:
+            with socket.socket() as sock:
+                sock.settimeout(0.5)
+                try:
+                    sock.connect(('127.0.0.1', port))
+                    return port
+                except OSError:
+                    time_lib.sleep(0.1)
+        raise RuntimeError('server thread never bound its port')
+
+    def wrap(engine, tier):
+        server = InferenceServer.__new__(InferenceServer)
+        server.engine = engine
+        server.tokenizer_kind = 'byte'
+        server._hf_tokenizer = None  # pylint: disable=protected-access
+        server.ready = True
+        server.request_timeout = 0.0
+        server.draining = False
+        server.tier = tier
+        return server
+
+    try:
+        engines = {
+            tier: ContinuousBatchingEngine(
+                cfg, num_slots=2, paged_block_size=8, prefix_cache=6,
+                tier=tier)
+            for tier in ('prefill', 'decode')
+        }
+    except ValueError as e:
+        _emit_skip(f'unsupported trace-dryrun engine combination: {e}',
+                   combo={'paged_block_size': 8, 'prefix_cache': 6})
+        return 3
+    urls, tiers = [], {}
+    for tier, engine in engines.items():
+        engine.generate([1, 2, 3], max_new_tokens=2,
+                        timeout=600)  # compile
+        port = serve_app(wrap(engine, tier).make_app())
+        url = f'http://127.0.0.1:{port}'
+        urls.append(url)
+        tiers[url] = tier
+    policy = PrefixAwarePolicy()
+    lb_port = free_port()
+    lb = SkyServeLoadBalancer('http://127.0.0.1:1', lb_port,
+                              policy_name='prefix_aware')
+    lb.policy = policy
+    policy.set_ready_replicas(list(urls))
+    policy.set_replica_tiers(tiers)
+    lb.start_in_thread()
+    lb_url = f'http://127.0.0.1:{lb_port}'
+    deadline = time_lib.time() + 30
+    while time_lib.time() < deadline:
+        try:
+            requests_lib.get(lb_url + '/metrics', timeout=2)
+            break
+        except requests_lib.RequestException:
+            time_lib.sleep(0.1)
+
+    tracing.enable()
+    tracing.reset()
+    ids = list(range(1, 25))  # 24 tokens ≥ threshold ⇒ handoff
+    resp = requests_lib.post(
+        lb_url + '/generate',
+        json={'prompt_ids': [ids], 'max_new_tokens': 4}, timeout=600)
+    handoff_ok = resp.status_code == 200
+    spans = tracing.snapshot()
+    names = sorted(s['name'] for s in spans)
+    traces = {s['trace_id'] for s in spans}
+    by_id = {s['span_id']: s for s in spans}
+
+    def chain_of(span):
+        out = [span['name']]
+        while span.get('parent_id') in by_id:
+            span = by_id[span['parent_id']]
+            out.append(span['name'])
+        return list(reversed(out))
+
+    publishes = [s for s in spans if s['name'] == 'engine.ingest_publish']
+    publish_chain = chain_of(publishes[0]) if publishes else []
+    decodes = [s for s in spans if s['name'] == 'engine.decode']
+    decode_chain = max((chain_of(s) for s in decodes),
+                       key=len, default=[])
+    required = {'lb.request', 'lb.route', 'lb.handoff',
+                'server.request', 'server.kv_push',
+                'engine.queue_wait', 'engine.prefill', 'engine.decode',
+                'engine.ingest_chunk', 'engine.ingest_publish'}
+    shape_ok = (handoff_ok and len(traces) == 1 and
+                required <= set(names) and
+                len(publish_chain) >= 5 and
+                publish_chain[0] == 'lb.request' and
+                len(decode_chain) >= 3)
+
+    # ---- enabled-vs-disabled decode-tick overhead ----
+    # One single-slot steady decode per mode on a fresh monolithic
+    # engine (same compile cache within this process): per-token wall
+    # with tracing disabled vs enabled. The engine records NO per-tick
+    # spans (coalescing is per request), so the ratio should sit near
+    # 1.0; it is REPORTED, and only a gross regression (>2x) fails the
+    # row — CI wall clocks are noisy.
+    bench_engine = ContinuousBatchingEngine(cfg, num_slots=1)
+    bench_engine.generate([5, 6, 7], max_new_tokens=8,
+                          timeout=600)  # warm the jit caches
+    steps = 48
+
+    def per_token_s() -> float:
+        best = float('inf')
+        for rep in range(3):
+            # The enabled runs must exercise REAL span recording
+            # (queue-wait/prefill/decode per request): an ambient
+            # context makes submit() capture a trace exactly like a
+            # traced serving request — otherwise req.trace stays None
+            # and the "enabled" measurement differs from disabled by
+            # one boolean, making the regression guard vacuous.
+            # activate(None)/NULL_SPAN keep the disabled runs no-ops.
+            root = tracing.start_span('lb.request')
+            t0 = time_lib.monotonic()
+            with tracing.activate(root.ctx):
+                bench_engine.generate([5, 6, 7 + rep],
+                                      max_new_tokens=steps, timeout=600)
+            best = min(best, (time_lib.monotonic() - t0) / steps)
+            root.end()
+        return best
+
+    tracing.disable()
+    disabled_s = per_token_s()
+    tracing.enable()
+    enabled_s = per_token_s()
+    tracing.disable()
+    overhead_ratio = enabled_s / max(1e-9, disabled_s)
+
+    for engine in list(engines.values()) + [bench_engine]:
+        engine.stop()
+    ok = bool(shape_ok and overhead_ratio < 2.0)
+    row = {
+        'metric': 'TRACE dryrun 2-hop handoff span tree',
+        'value': len(publish_chain),
+        'unit': 'hops',
+        'ok': ok,
+        'skipped': False,
+        'traces': len(traces),
+        'spans': len(spans),
+        'span_names': sorted(set(names)),
+        'publish_chain': publish_chain,
+        'decode_chain': decode_chain,
+        'handoff_http_200': handoff_ok,
+        'tick_overhead_ratio': round(overhead_ratio, 3),
+        'tick_disabled_us': round(disabled_s * 1e6, 1),
+        'tick_enabled_us': round(enabled_s * 1e6, 1),
+    }
+    print(json.dumps(row))
+    return 0 if ok else 1
+
+
 def _dryrun_train_zero1(args) -> int:
     """MULTICHIP_train_zero1: the ZeRO-1 weight-update-sharding proxy
     row on 8 fake CPU devices (runs with the chip unreachable — the
@@ -1374,6 +1600,8 @@ def _worker(args) -> int:
         return _dryrun_serve_fleet(args)
     if args.dryrun_serve_disagg:
         return _dryrun_serve_disagg(args)
+    if args.dryrun_trace:
+        return _dryrun_trace(args)
     if args.dryrun_train_zero1:
         # CPU-only by design; forces its own fake-device backend
         # BEFORE any jax.devices() call.
@@ -1552,8 +1780,8 @@ def main() -> int:
         # and deterministic — run it right here.
         return _dryrun_lint(args)
     if (args.dryrun_serve_sharded or args.dryrun_serve_fleet or
-            args.dryrun_serve_disagg or args.dryrun_train_zero1 or
-            args.dryrun_train_elastic):
+            args.dryrun_serve_disagg or args.dryrun_trace or
+            args.dryrun_train_zero1 or args.dryrun_train_elastic):
         return _supervise_dryrun(argv)
     return _supervise(argv)
 

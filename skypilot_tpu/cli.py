@@ -6,7 +6,9 @@ logs, cancel, stop, start, down, autostop, cost-report, check, show-tpus,
 storage ls/delete, jobs launch/queue/cancel/logs, serve up/status/down/
 logs`. Entry: `python -m skypilot_tpu.cli` (or the `skytpu` script).
 TPU-native additions include `metrics` (scrape/print a Prometheus
-/metrics endpoint — docs/observability.md).
+/metrics endpoint), `trace` (render request traces / flight-record
+postmortems), and `lint` (docs/observability.md,
+docs/static-analysis.md).
 
 YAML-or-inline entrypoint parsing and resource override flags mirror
 cli.py:690,463; interactive confirm mirrors :532.
@@ -470,6 +472,84 @@ def metrics(url, raw, pattern):
             f' matching {pattern!r}' if pattern else '') + '.')
         return
     _print_table(rows, ['METRIC', 'LABELS', 'TYPE', 'VALUE'])
+
+
+@cli.command()
+@click.option('--url', default=None,
+              help='Fetch /traces from a serve replica or load '
+                   'balancer, e.g. http://127.0.0.1:8080. Default: '
+                   'this process\'s own span ring.')
+@click.option('--dump', 'dump_path', default=None,
+              help='Render a flight-record JSON file (the postmortem '
+                   'a wedge recovery / tick failure / preemption '
+                   'notice leaves under $SKYTPU_FLIGHT_DIR).')
+@click.option('--grep', 'pattern', default=None,
+              help='Only show traces containing a span whose name or '
+                   'attrs match this substring.')
+def trace(url, dump_path, pattern):
+    """Render request traces or a flight-record postmortem.
+
+    Traces show where ONE request's milliseconds went across the
+    disaggregated fleet (LB routing → prefill → KV stream → decode
+    ingest → decode ticks); flight records show what the engine was
+    doing in the seconds before a wedge recovery or preemption.
+    Span catalog + propagation format: docs/observability.md
+    "Tracing".
+    """
+    import json as json_lib
+
+    from skypilot_tpu.observability import tracing as tracing_lib
+    if dump_path is not None:
+        try:
+            with open(os.path.expanduser(dump_path),
+                      encoding='utf-8') as f:
+                record = json_lib.load(f)
+        except (OSError, ValueError) as e:
+            _fail(f'cannot read flight record {dump_path}: {e}')
+        if record.get('schema') != tracing_lib.FLIGHT_SCHEMA:
+            _fail(f'{dump_path} is not a flight record (schema '
+                  f'{record.get("schema")!r}, expected '
+                  f'{tracing_lib.FLIGHT_SCHEMA!r})')
+        for line in tracing_lib.render_flight_record(record):
+            click.echo(line)
+        return
+    exemplars = {}
+    if url is not None:
+        if '://' not in url:
+            url = 'http://' + url
+        if not url.rstrip('/').endswith('/traces'):
+            url = url.rstrip('/') + '/traces'
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                data = json_lib.loads(
+                    resp.read().decode('utf-8', errors='replace'))
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            _fail(f'fetch of {url} failed: {e}')
+        spans = data.get('spans', [])
+        exemplars = data.get('exemplars', {})
+        if not data.get('enabled', False) and not spans:
+            click.echo('tracing is disabled on that process '
+                       '(set SKYTPU_TRACING=1 or call '
+                       'tracing.enable()).')
+            return
+    else:
+        spans = tracing_lib.snapshot()
+    lines = tracing_lib.render_trace_tree(spans, grep=pattern)
+    if not lines:
+        click.echo('no traces recorded' + (
+            f' matching {pattern!r}' if pattern else '') + '.')
+        return
+    for line in lines:
+        click.echo(line)
+    if exemplars:
+        click.echo('\nexemplars (worst sample per window → trace):')
+        for name in sorted(exemplars):
+            ex = exemplars[name]
+            click.echo(f'  {name}: {ex["value"]:g} '
+                       f'(trace {ex["trace_id"]}, '
+                       f'{ex["age_s"]:.0f}s ago)')
 
 
 @cli.command()

@@ -32,6 +32,7 @@ from skypilot_tpu.models import kv_cache as kv_cache_lib
 from skypilot_tpu.models.configs import ModelConfig, get_config
 from skypilot_tpu.models.transformer import Transformer
 from skypilot_tpu.observability import metrics as obs
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.parallel import sharding as sharding_lib
 from skypilot_tpu.utils import fault_injection
 
@@ -786,7 +787,8 @@ class _Request:
     __slots__ = ('ids', 'max_new_tokens', 'temperature', 'eos_id',
                  'future', 'submit_time', 'first_token_time', 'tokens',
                  'next_pos', 'on_token', 'deadline', 'blocks',
-                 'prefilling', 'prefill_pos', 'seq')
+                 'prefilling', 'prefill_pos', 'seq', 'trace',
+                 'admit_time')
 
     def __init__(self, ids, max_new_tokens, temperature, eos_id, future,
                  on_token=None, deadline=None):
@@ -817,6 +819,14 @@ class _Request:
         self.blocks: list = []
         self.prefilling = False
         self.prefill_pos = 0
+        # Tracing (docs/observability.md "Tracing"): the submitting
+        # request's span context, captured by submit() when tracing is
+        # enabled. None otherwise — every engine-side tracing hook
+        # guards on this identity check, so the decode tick pays NO
+        # tracing cost (no spans, no clocks) while tracing is off
+        # (pinned by tests/test_tracing.py).
+        self.trace = None
+        self.admit_time: Optional[float] = None
 
 
 class ContinuousBatchingEngine:
@@ -1545,6 +1555,11 @@ class ContinuousBatchingEngine:
 
     def _recover_from_wedge(self, why: str) -> None:
         import queue as queue_lib
+        # Flight-recorder trigger (docs/observability.md "Tracing"):
+        # the spans/step_log of the seconds BEFORE the wedge are the
+        # postmortem — capture the recovery start before swapping
+        # state. active() is enabled-or-flight-dir; off the tick path.
+        t_rec = tracing.now() if tracing.active() else 0.0
         with self._thread_lock:
             self._generation += 1
             old_slots = self._slots
@@ -1588,6 +1603,17 @@ class ContinuousBatchingEngine:
                      'and resetting engine state (generation %d)', why,
                      self._generation)
         _WEDGE_RECOVERIES.inc()
+        if tracing.active():
+            tracing.record_span(
+                'engine.wedge_recovery', t_rec, tracing.now(),
+                attrs={'why': why, 'generation': self._generation})
+            extra = self._flight_extra(why)
+            # The postmortem wants the WEDGED world, not the freshly
+            # swapped empty one.
+            extra['active_slots'] = [i for i, r in enumerate(old_slots)
+                                     if r is not None]
+            extra['queue_depth'] = old_queue.qsize()
+            tracing.flight_record('wedge_recovery', extra=extra)
         err = exceptions.EngineWedgedError(
             f'{why}; request aborted by the engine watchdog')
         for _fn, future in old_work:
@@ -1608,6 +1634,61 @@ class ContinuousBatchingEngine:
         if not req.future.done():
             req.future.set_exception(exc)
         self._notify(req, None)
+
+    # ---------------- tracing hooks (docs/observability.md "Tracing") -
+    #
+    # Every hook guards on `req.trace is None` (an identity check) so
+    # an untraced request — and the whole engine while tracing is
+    # disabled — pays no span allocation and no clock reads on the
+    # tick path (pinned by tests/test_tracing.py). Spans are recorded
+    # AFTER the fact from monotonic stamps the request already
+    # carries, coalesced per request: queue-wait (submit→admit),
+    # prefill (admit→first token, chunked or bucketed), decode (first
+    # token→finish, slot-labeled) — never one span per tick per slot.
+
+    def _trace_admitted(self, req: '_Request') -> None:
+        if req.trace is None:
+            return
+        req.admit_time = tracing.now()
+        tracing.record_span('engine.queue_wait', req.submit_time,
+                            req.admit_time, parent=req.trace,
+                            attrs={'prompt_tokens': len(req.ids)})
+
+    def _trace_first_token(self, req: '_Request', slot: int) -> None:
+        if req.trace is None:
+            return
+        tracing.record_span(
+            'engine.prefill', req.admit_time or req.submit_time,
+            req.first_token_time, parent=req.trace,
+            attrs={'slot': slot, 'prompt_tokens': len(req.ids),
+                   'ttft_s': round(
+                       req.first_token_time - req.submit_time, 6)})
+
+    def _trace_finished(self, req: '_Request', slot: int,
+                        now: float) -> None:
+        if req.trace is None or req.first_token_time is None:
+            return
+        tracing.record_span('engine.decode', req.first_token_time, now,
+                            parent=req.trace,
+                            attrs={'slot': slot,
+                                   'new_tokens': len(req.tokens)})
+
+    def _flight_extra(self, why: str) -> dict:
+        """Engine state for a flight record: the step_log tail + tick
+        stats that show what the engine was doing in the seconds
+        before the trigger (frozensets rendered JSON-safe)."""
+        return {
+            'why': why,
+            'tier': self.tier,
+            'generation': self._generation,
+            'decode_steps': self._decode_steps,
+            'tick_stats': dict(self.tick_stats),
+            'active_slots': [i for i, r in enumerate(self._slots)
+                             if r is not None],
+            'queue_depth': self._queue.qsize(),
+            'step_log': [[step, sorted(slots)]
+                         for step, slots in list(self.step_log)[-200:]],
+        }
 
     def _check_gen(self, gen: int) -> None:
         if self._generation != gen:
@@ -1885,7 +1966,10 @@ class ContinuousBatchingEngine:
                 first = self._sample(logits, req.temperature)
                 req.first_token_time = time_lib.monotonic()
                 _TTFT_HIST.observe(req.first_token_time -
-                                   req.submit_time)
+                                   req.submit_time,
+                                   exemplar=req.trace.trace_id
+                                   if req.trace is not None else None)
+                self._trace_first_token(req, slot)
                 req.tokens.append(first)
                 _TOKENS_TOTAL.inc()
                 self._notify(req, first)
@@ -2076,9 +2160,14 @@ class ContinuousBatchingEngine:
         deadline = clock() + budget_s if budget_s else None
         should_stop = ((lambda: clock() > deadline)
                        if deadline is not None else None)
-        stats = kv_cache_lib.export_prefixes(
-            self._prefix_entries, self._pool, gather, path,
-            should_stop=should_stop)
+        with tracing.span('engine.preempt_export',
+                          attrs={'budget_s': budget_s}) as sp:
+            stats = kv_cache_lib.export_prefixes(
+                self._prefix_entries, self._pool, gather, path,
+                should_stop=should_stop)
+            sp.set_attr('exported', stats['exported'])
+            sp.set_attr('blocks', stats['blocks'])
+            sp.set_attr('truncated', stats['truncated'])
         _PREFIX_EXPORT_BLOCKS.inc(stats['blocks'])
         logger.info('exported %d prefixes (%d blocks%s) to %s',
                     stats['exported'], stats['blocks'],
@@ -2278,14 +2367,20 @@ class ContinuousBatchingEngine:
                 'cached': tuple(ids) in self._prefix_entries}
 
     def export_prefix_chunks(self, ids, stream_id: str,
-                             chunk_blocks: int = 4) -> List[bytes]:
+                             chunk_blocks: int = 4,
+                             trace_header: Optional[str] = None
+                             ) -> List[bytes]:
         """Serialize the cached prefix for exactly `ids` into framed
         handoff chunks (list of packed bytes, seq order). The device
         gather runs in the engine tick thread and reads ONLY the
         prefix's own blocks (a few KB–MB), never the whole pool — this
         is the hot path, not the preemption export. Raises ValueError
         when the prefix is not cached (evicted / never prefilled):
-        retryable — the caller re-prefills or falls back monolithic."""
+        retryable — the caller re-prefills or falls back monolithic.
+
+        `trace_header` (an X-SkyTPU-Trace value) rides every chunk's
+        header so the decode replica's ingest spans join the sender's
+        trace (docs/observability.md "Tracing")."""
         if not (self.paged_block_size and self.prefix_cache):
             raise ValueError('export_prefix_chunks requires '
                              'paged_block_size and prefix_cache')
@@ -2326,7 +2421,8 @@ class ContinuousBatchingEngine:
                 stream_id, seq, start, self.paged_block_size, meta,
                 payload, nblk, final=final,
                 key=list(key) if final else None,
-                total_blocks=total if final else None))
+                total_blocks=total if final else None,
+                trace=trace_header))
             start += nblk
             _HANDOFF_EXPORT_CHUNKS.inc()
             _HANDOFF_EXPORT_BYTES.inc(len(payload))
@@ -2420,6 +2516,12 @@ class ContinuousBatchingEngine:
                 'model config / dtype / kv-quant mismatch)')
         sid, seq = header['stream_id'], int(header['seq'])
         final = bool(header.get('final'))
+        # The chunk header carries the SENDER's trace context, so this
+        # replica's ingest spans join the same trace as the prefill
+        # that produced the blocks (docs/observability.md "Tracing").
+        trace_ctx = (tracing.parse_header(header.get('trace'))
+                     if tracing.enabled() else None)
+        t_chunk = tracing.now() if trace_ctx is not None else 0.0
         now = time_lib.monotonic()
         key: Optional[tuple] = None
         with self._ingest_lock:
@@ -2531,6 +2633,12 @@ class ContinuousBatchingEngine:
                 key = tuple(int(t) for t in header['key'])
             self.ingest_stats['chunks_ok'] += 1
         _INGEST_OK.inc()
+        if trace_ctx is not None:
+            tracing.record_span(
+                'engine.ingest_chunk', t_chunk, tracing.now(),
+                parent=trace_ctx,
+                attrs={'stream': sid, 'seq': seq,
+                       'blocks': int(header['num_blocks'])})
         if not final:
             return {'ok': True, 'seq': seq}
 
@@ -2576,6 +2684,7 @@ class ContinuousBatchingEngine:
             return True
 
         import concurrent.futures
+        t_pub = tracing.now() if trace_ctx is not None else 0.0
         try:
             self._run_in_tick(apply)
         except BaseException as e:
@@ -2599,11 +2708,18 @@ class ContinuousBatchingEngine:
             self.ingest_stats['blocks_ingested'] += imported
         _HANDOFF_INGEST_STREAMS.labels(outcome='completed').inc()
         _HANDOFF_INGEST_BLOCKS.inc(imported)
+        if trace_ctx is not None:
+            tracing.record_span(
+                'engine.ingest_publish', t_pub, tracing.now(),
+                parent=trace_ctx,
+                attrs={'stream': sid, 'blocks': imported,
+                       'key_tokens': len(key)})
         return {'ok': True, 'seq': seq, 'final': True,
                 'imported_blocks': imported,
                 'key_tokens': len(key)}
 
     def _admit(self, slot: int, req: '_Request', gen: int = -1) -> None:
+        self._trace_admitted(req)
         if self.paged_block_size:
             self._admit_paged(slot, req, gen)
             return
@@ -2645,7 +2761,10 @@ class ContinuousBatchingEngine:
             self._store_prefix(req.ids, cache1)
         first = self._sample(logits, req.temperature)
         req.first_token_time = time_lib.monotonic()
-        _TTFT_HIST.observe(req.first_token_time - req.submit_time)
+        _TTFT_HIST.observe(req.first_token_time - req.submit_time,
+                           exemplar=req.trace.trace_id
+                           if req.trace is not None else None)
+        self._trace_first_token(req, slot)
         req.tokens.append(first)
         _TOKENS_TOTAL.inc()  # the first token lands here, not in _emit
         self._notify(req, first)
@@ -2687,6 +2806,10 @@ class ContinuousBatchingEngine:
             'new_tokens': len(req.tokens),
             'prompt_tokens': len(req.ids),
         }
+        # Decode span BEFORE the future resolves: a caller that
+        # snapshots the ring the moment generate() returns must see
+        # the request's complete span set.
+        self._trace_finished(req, slot, now)
         if not req.future.done():
             # done() here means the caller cancelled (shed a partially
             # submitted batch) — the result has no reader, so it must
@@ -2699,7 +2822,9 @@ class ContinuousBatchingEngine:
                 # deltas within a tick would read as ~0 and distort
                 # the histogram).
                 _TPOT_HIST.observe((now - req.first_token_time) /
-                                   (len(req.tokens) - 1))
+                                   (len(req.tokens) - 1),
+                                   exemplar=req.trace.trace_id
+                                   if req.trace is not None else None)
             req.future.set_result((list(req.tokens), stats))
         self._notify(req, None)  # stream end (after the future resolves)
 
@@ -2727,6 +2852,18 @@ class ContinuousBatchingEngine:
                     # recovery can never be interleaved — a stale
                     # thread must not drain its SUCCESSOR's requests.
                     logger.exception('decode tick failed: %s', e)
+                    if tracing.active():
+                        # Flight-recorder trigger: dump BEFORE the
+                        # state reset below wipes the evidence (the
+                        # step_log survives, but slots/queue do not).
+                        t_fail = tracing.now()
+                        tracing.record_span(
+                            'engine.tick_failure', t_fail, t_fail,
+                            attrs={'error': f'{type(e).__name__}: {e}'})
+                        tracing.flight_record(
+                            'tick_failure',
+                            extra=self._flight_extra(
+                                f'{type(e).__name__}: {e}'))
                     failed = []
                     with self._thread_lock:
                         if self._generation != gen:
@@ -3334,6 +3471,11 @@ class ContinuousBatchingEngine:
         future: 'concurrent.futures.Future' = concurrent.futures.Future()
         req = _Request(ids, max_new_tokens, temperature, eos_id, future,
                        on_token=on_token, deadline=deadline)
+        if tracing.enabled():
+            # One enabled-check; the ambient context (the server's
+            # request span, or an activate()d handoff context) becomes
+            # this request's trace — every engine span parents to it.
+            req.trace = tracing.current()
         # Enqueue under _thread_lock: watchdog recovery swaps the queue
         # object under the same lock, so this put lands either in the
         # old queue BEFORE the swap (and is failed by the recovery

@@ -511,12 +511,21 @@ def pack_kv_chunk(stream_id: str, seq: int, start_block: int,
                   payload: bytes, num_blocks: int,
                   final: bool = False,
                   key: Optional[Sequence[int]] = None,
-                  total_blocks: Optional[int] = None) -> bytes:
+                  total_blocks: Optional[int] = None,
+                  trace: Optional[str] = None) -> bytes:
     """Frame one handoff chunk. `payload` is the gathered block bytes
     (leaf-major, block-axis-first — the artifact blob layout). The
     final chunk must carry the stream's full token `key` and
     `total_blocks` so the receiver can validate the assembled stream
-    before publishing it."""
+    before publishing it.
+
+    `trace` (optional): the sender's X-SkyTPU-Trace context, carried
+    verbatim in the header so the receiver's ingest spans join the
+    SAME trace as the prefill that produced the blocks
+    (docs/observability.md "Tracing"). Observability metadata only —
+    deliberately outside the CRC (a corrupt trace id must not refuse a
+    valid chunk, and the receiver's parse_header treats garbage as
+    no-context)."""
     if final and (key is None or total_blocks is None):
         raise ValueError('final chunk requires key and total_blocks')
     sig = _leaf_sig(leaves_meta)
@@ -532,6 +541,8 @@ def pack_kv_chunk(stream_id: str, seq: int, start_block: int,
                           num_blocks, block_size, sig,
                           key=key if final else None),
     }
+    if trace:
+        header['trace'] = str(trace)
     if final:
         header['final'] = True
         header['key'] = [int(t) for t in key]

@@ -15,6 +15,12 @@ framework carries its own metrics substrate:
 - A timeline bridge (``timeline_snapshot``) that lands registry
   snapshots in the Chrome-trace timeline as 'C' counter events, so
   spans and counters share one Perfetto view.
+- ``tracing``: the per-request span layer + flight recorder — trace
+  context minted at the LB, propagated end to end through the server,
+  engine, and KV handoff stream (X-SkyTPU-Trace), rendered by
+  ``skytpu trace`` and merged into the same Perfetto view. Disabled by
+  default behind one module-level boolean, same cost contract as the
+  metrics registry.
 
 Recording turns on when an exporter attaches (``/metrics`` route
 setup on the serve server / load balancer / dashboard calls
@@ -24,7 +30,9 @@ pinned by tests/test_observability.py.
 
 Metric catalog and label conventions: docs/observability.md.
 """
-from skypilot_tpu.observability.exposition import (generate_latest,
+from skypilot_tpu.observability import tracing
+from skypilot_tpu.observability.exposition import (collect_exemplars,
+                                                   generate_latest,
                                                    parse_prometheus_text,
                                                    timeline_snapshot)
 from skypilot_tpu.observability.metrics import (REGISTRY, Counter, Gauge,
@@ -44,7 +52,9 @@ __all__ = [
     'enabled',
     'gauge',
     'histogram',
+    'collect_exemplars',
     'generate_latest',
     'parse_prometheus_text',
     'timeline_snapshot',
+    'tracing',
 ]

@@ -211,6 +211,40 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
     return families
 
 
+# ---------------- exemplars ----------------
+
+
+def collect_exemplars(registry: '_metrics.Registry' = None
+                      ) -> Dict[str, dict]:
+    """Histogram exemplars as {family[|label=value...]: {'value',
+    'trace_id', 'age_s'}} — the metrics→traces link the /traces
+    endpoint and `skytpu trace` surface (docs/observability.md
+    "Tracing"). Prometheus text exposition is deliberately left
+    exemplar-free: the strict parser (and round-trip test) pin the
+    0.0.4 grammar, which has no exemplar syntax."""
+    import time as _time
+    if registry is None:
+        registry = _metrics.REGISTRY
+    now = _time.monotonic()
+    out: Dict[str, dict] = {}
+    for metric in registry.collect():
+        if metric.kind != 'histogram':
+            continue
+        for labelvalues, child in metric.samples():
+            ex = child.exemplar
+            if ex is None:
+                continue
+            value, trace_id, stamp = ex
+            suffix = ''.join(f'|{n}={v}' for n, v in
+                             zip(metric.labelnames, labelvalues))
+            out[f'{metric.name}{suffix}'] = {
+                'value': value,
+                'trace_id': trace_id,
+                'age_s': round(max(0.0, now - stamp), 3),
+            }
+    return out
+
+
 # ---------------- timeline bridge ----------------
 
 

@@ -20,6 +20,7 @@ from aiohttp import web
 
 from skypilot_tpu.observability import exposition
 from skypilot_tpu.observability import metrics as obs
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import constants
 from skypilot_tpu.serve import load_balancing_policies as policies
 from skypilot_tpu.utils import fault_injection
@@ -398,6 +399,23 @@ class SkyServeLoadBalancer:
         except Exception:  # pylint: disable=broad-except
             return None
 
+    def _skip_reasons(self, breaker_blocked: Set[str],
+                      tried: Set[str]) -> Dict[str, str]:
+        """Why each currently-unroutable replica was skipped — the
+        per-request record `skytpu trace` renders so 'why did routing
+        avoid replica X' is answerable after the fact (span attrs on
+        lb.route; stale-digest/tokenizer reasons come from the policy's
+        route_info)."""
+        reasons: Dict[str, str] = {}
+        for url in self.policy.ready_replica_urls:
+            if url in tried:
+                reasons[url] = 'tried'
+            elif url in self._draining_urls:
+                reasons[url] = 'draining'
+            elif url in breaker_blocked:
+                reasons[url] = 'breaker'
+        return reasons
+
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
         with self._ts_lock:
             self.request_timestamps.append(time.time())
@@ -409,19 +427,60 @@ class SkyServeLoadBalancer:
         # retry on a different replica replays the identical request.
         body = await request.read()
         hint = self._routing_hint(request, body)
+        # Tracing (docs/observability.md "Tracing"): the LB MINTS one
+        # trace per proxied POST (continuing an inbound client
+        # context, if any) and forwards X-SkyTPU-Trace on every
+        # upstream call, so a request's whole multi-hop journey —
+        # route decision, handoff orchestration, retries, upstream
+        # serving — renders as one span tree. GETs (health probes,
+        # scrapes) stay untraced unless the client sent a context.
+        # Explicit SpanContext threading, never ambient: concurrent
+        # requests interleave on this event loop.
+        inbound = tracing.parse_header(
+            request.headers.get(tracing.TRACE_HEADER))
+        root = (tracing.start_span(
+            'lb.request', parent=inbound,
+            attrs={'method': request.method, 'path': request.path})
+            if request.method.upper() == 'POST' or inbound is not None
+            else tracing.NULL_SPAN)
+        try:
+            return await self._proxy_routed(request, headers, body,
+                                            hint, root)
+        finally:
+            root.end()
+
+    async def _proxy_routed(self, request: web.Request, headers, body,
+                            hint, root) -> web.StreamResponse:
         idempotent = request.method.upper() in _IDEMPOTENT_METHODS
         attempts = constants.lb_retry_attempts() if idempotent else 1
         tried: Set[str] = set()
         last_err: Optional[Exception] = None
         for _ in range(attempts):
-            blocked = self.breaker.blocked(
-                self.policy.ready_replica_urls) | tried | \
-                self._draining_urls
+            breaker_blocked = self.breaker.blocked(
+                self.policy.ready_replica_urls)
+            blocked = breaker_blocked | tried | self._draining_urls
+            t_route = tracing.now() if root.ctx is not None else 0.0
             replica_url, route_info = self.policy.select(exclude=blocked,
                                                          hint=hint)
+            result = route_info.get('result')
+            if root.ctx is not None:
+                attrs = {'result': result}
+                if replica_url is not None:
+                    attrs['replica'] = replica_url
+                if route_info.get('phase'):
+                    attrs['phase'] = route_info['phase']
+                if route_info.get('stale_replicas'):
+                    attrs['stale_digest'] = route_info['stale_replicas']
+                if route_info.get('handoff_skipped'):
+                    attrs['handoff_skipped'] = \
+                        route_info['handoff_skipped']
+                skipped = self._skip_reasons(breaker_blocked, tried)
+                if skipped:
+                    attrs['skipped'] = skipped
+                tracing.record_span('lb.route', t_route, tracing.now(),
+                                    parent=root.ctx, attrs=attrs)
             if replica_url is None:
                 break
-            result = route_info.get('result')
             if result in ('hit', 'miss', 'stale', 'fallback'):
                 _ROUTE_TOTAL.labels(result=result).inc()
             if route_info.get('phase'):
@@ -438,7 +497,8 @@ class SkyServeLoadBalancer:
                 await self._run_handoff(route_info['prefill_url'],
                                         replica_url,
                                         hint['token_ids'],
-                                        blocked)
+                                        blocked,
+                                        trace=root.ctx)
             _LB_REQUESTS.labels(replica=replica_url).inc()
             if tried:
                 # Second (or later) attempt: this IS the
@@ -448,16 +508,22 @@ class SkyServeLoadBalancer:
             # concurrent traffic keeps avoiding it until we report.
             self.breaker.claim_probe(replica_url)
             self.policy.note_routed(replica_url)
+            attempt_span = (tracing.start_span(
+                'lb.proxy', parent=root.ctx,
+                attrs={'replica': replica_url, 'attempt': len(tried)})
+                if root.ctx is not None else tracing.NULL_SPAN)
             try:
                 return await self._proxy_once(request, replica_url,
                                               headers, body,
-                                              detect_draining=idempotent)
+                                              detect_draining=idempotent,
+                                              trace_span=attempt_span)
             except _ReplicaDrainingError:
                 # Preemption drain learned in-band (ahead of the next
                 # controller sync): exclude the replica and replay this
                 # idempotent request elsewhere. The replica answered —
                 # it is healthy — so its breaker is NOT charged; any
                 # half-open probe claim is released undetermined.
+                attempt_span.set_attr('outcome', 'draining')
                 self.breaker.clear_probe(replica_url)
                 self._draining_urls.add(replica_url)
                 tried.add(replica_url)
@@ -474,6 +540,7 @@ class SkyServeLoadBalancer:
                 # Transport-level failure: the replica never answered.
                 # Feed the breaker; an idempotent request retries on a
                 # DIFFERENT replica (tried-set), others fail fast.
+                attempt_span.set_attr('outcome', 'transport_error')
                 self.breaker.record_failure(replica_url)
                 tried.add(replica_url)
                 last_err = e
@@ -490,6 +557,7 @@ class SkyServeLoadBalancer:
             finally:
                 # In-flight accounting for the least-loaded fallback:
                 # every routed request is released on every exit path.
+                attempt_span.end()
                 self.policy.note_done(replica_url)
         if last_err is not None:
             # A replica existed and answered the wire with a transport
@@ -546,7 +614,9 @@ class SkyServeLoadBalancer:
         return min(pool)
 
     async def _run_handoff(self, prefill_url: str, decode_url: str,
-                           token_ids, exclude: Set[str]) -> bool:
+                           token_ids, exclude: Set[str],
+                           trace: Optional['tracing.SpanContext'] = None
+                           ) -> bool:
         """Drive one prefill→decode KV handoff: POST /kv/prefill on the
         prefill replica, which streams chunks straight to the decode
         replica's /kv/ingest. A prefill replica that dies or errors
@@ -555,7 +625,19 @@ class SkyServeLoadBalancer:
         decode side) and the handoff re-dispatches to another prefill
         replica; when none can finish, returns False — the caller
         proxies the request to the decode replica anyway, which serves
-        it monolithically. No path loses the request."""
+        it monolithically. No path loses the request.
+
+        `trace`: the lb.request span context — the whole orchestration
+        (per-attempt outcomes, retries, the reason each skipped
+        prefill replica was skipped) records as an lb.handoff span
+        tree, and each /kv/prefill call forwards its attempt span as
+        X-SkyTPU-Trace so the upstream prefill/push/ingest spans join
+        the same trace."""
+        hsp = (tracing.start_span('lb.handoff', parent=trace,
+                                  attrs={'decode_url': decode_url,
+                                         'prompt_tokens':
+                                             len(token_ids)})
+               if trace is not None else tracing.NULL_SPAN)
         t0 = time.monotonic()
         tried: Set[str] = set()
         current: Optional[str] = prefill_url
@@ -566,6 +648,15 @@ class SkyServeLoadBalancer:
                 break
             stream_id = f'lb-{id(self):x}-{time.monotonic_ns():x}'
             decode_shed = False
+            asp = (tracing.start_span(
+                'lb.handoff_attempt', parent=hsp.ctx,
+                attrs={'replica': current, 'attempt': attempt,
+                       'stream': stream_id})
+                if hsp.ctx is not None else tracing.NULL_SPAN)
+            upstream_headers = {}
+            if asp.ctx is not None:
+                upstream_headers[tracing.TRACE_HEADER] = \
+                    tracing.header_value(asp.ctx)
             # Prefill-tier load accounting: /kv/prefill requests never
             # ride the proxy path, so without this the policy reads
             # every prefill replica as idle and serializes concurrent
@@ -582,6 +673,7 @@ class SkyServeLoadBalancer:
                         json={'prompt_ids': ids,
                               'target': decode_url,
                               'stream_id': stream_id},
+                        headers=upstream_headers or None,
                         timeout=aiohttp.ClientTimeout(
                             total=constants.handoff_timeout_seconds())
                 ) as resp:
@@ -597,12 +689,18 @@ class SkyServeLoadBalancer:
                         _HANDOFF_CHUNKS.inc(int(data.get('chunks', 0)))
                         _HANDOFF_BYTES.inc(int(data.get('bytes', 0)))
                         _HANDOFF_SECONDS.observe(
-                            time.monotonic() - t0)
+                            time.monotonic() - t0,
+                            exemplar=hsp.ctx.trace_id
+                            if hsp.ctx is not None else None)
                         if attempt:
                             logger.info(
                                 'handoff re-dispatch succeeded on %s '
                                 'after %d failed prefill replica(s)',
                                 current, attempt)
+                        asp.end(outcome='ok')
+                        hsp.end(outcome='ok',
+                                chunks=int(data.get('chunks', 0)),
+                                bytes=int(data.get('bytes', 0)))
                         return True
                     text = await resp.text()
                     try:
@@ -615,6 +713,9 @@ class SkyServeLoadBalancer:
                     # fall back monolithic on the decode replica now.
                     decode_shed = (resp.status == 502 and
                                    push_status == 503)
+                    asp.set_attr('outcome',
+                                 'decode_shed' if decode_shed
+                                 else f'status_{resp.status}')
                     logger.warning(
                         'handoff via %s answered %d (%s); aborting '
                         'partial ingest and %s', current,
@@ -622,6 +723,7 @@ class SkyServeLoadBalancer:
                         'falling back monolithic (decode-side ingest '
                         'shed)' if decode_shed else 're-dispatching')
             except fault_injection.InjectedFault as e:
+                asp.set_attr('outcome', 'dispatch_fault')
                 logger.warning('handoff dispatch fault for %s: %s',
                                current, e)
             except (aiohttp.ClientError, asyncio.TimeoutError,
@@ -629,11 +731,13 @@ class SkyServeLoadBalancer:
                 # The prefill replica never answered — preempted or
                 # dead mid-stream: charge its breaker so tier routing
                 # stops picking it, roll the partial ingest back.
+                asp.set_attr('outcome', 'transport_error')
                 self.breaker.record_failure(current)
                 logger.warning('handoff via %s failed (%s); aborting '
                                'partial ingest and re-dispatching',
                                current, e)
             finally:
+                asp.end()
                 self.policy.note_done(current)
             await self._abort_ingest(decode_url, stream_id)
             if decode_shed:
@@ -643,6 +747,12 @@ class SkyServeLoadBalancer:
             if current is not None:
                 _HANDOFF_TOTAL.labels(outcome='retry').inc()
         _HANDOFF_TOTAL.labels(outcome='fallback_monolithic').inc()
+        if hsp.ctx is not None:
+            hsp.set_attr('skipped', self._skip_reasons(
+                self.breaker.blocked(self.policy.ready_replica_urls),
+                tried))
+            hsp.end(outcome='decode_shed' if decode_shed
+                    else 'fallback_monolithic')
         logger.warning('handoff failed on every prefill replica; '
                        'decode replica %s serves monolithically',
                        decode_url)
@@ -650,9 +760,16 @@ class SkyServeLoadBalancer:
 
     async def _proxy_once(self, request: web.Request, replica_url: str,
                           headers, body,
-                          detect_draining: bool = False
+                          detect_draining: bool = False,
+                          trace_span=tracing.NULL_SPAN
                           ) -> web.StreamResponse:
         target = replica_url + str(request.rel_url)
+        if trace_span.ctx is not None:
+            # Forward the attempt's span context upstream (per-attempt
+            # copy: retries must not share one mutated header dict).
+            headers = dict(headers)
+            headers[tracing.TRACE_HEADER] = tracing.header_value(
+                trace_span.ctx)
         async with self._session().request(
                 request.method, target, headers=headers,
                 data=body if body else None,
@@ -662,6 +779,7 @@ class SkyServeLoadBalancer:
             # (queue depth + prefix digest — the X-SkyTPU-Draining
             # pattern): a corrupt digest is dropped and counted, never
             # surfaced to the client.
+            trace_span.set_attr('status', upstream.status)
             if self.policy.observe_response(
                     replica_url, upstream.headers) == 'rejected':
                 _ROUTE_TOTAL.labels(result='rejected').inc()
@@ -724,11 +842,33 @@ class SkyServeLoadBalancer:
         return web.Response(text=exposition.generate_latest(),
                             content_type='text/plain', charset='utf-8')
 
+    async def _traces(self, request: web.Request) -> web.Response:
+        """The LB's OWN span ring (lb.request/route/proxy/handoff
+        trees) + exemplars, as JSON for `skytpu trace --url` —
+        registered before the catch-all proxy route, like /metrics.
+        `?window_s=N` restricts to recent spans (same contract as the
+        replica endpoint)."""
+        window: Optional[float] = None
+        raw = request.query.get('window_s')
+        if raw:
+            try:
+                window = float(raw)
+            except ValueError:
+                return web.json_response(
+                    {'error': 'window_s must be a number'}, status=400)
+        return web.json_response({
+            'schema': 'skytpu-traces/1',
+            'enabled': tracing.enabled(),
+            'spans': tracing.snapshot(window_s=window),
+            'exemplars': exposition.collect_exemplars(),
+        })
+
     def _make_app(self) -> web.Application:
         # Exposing /metrics attaches an exporter: recording on.
         obs.enable()
         app = web.Application()
         app.router.add_get('/metrics', self._metrics)
+        app.router.add_get('/traces', self._traces)
         app.router.add_route('*', '/{path:.*}', self._proxy)
         return app
 

@@ -309,6 +309,11 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
             # replica must not pull decode traffic onto it.
             token_ids = hint.get('token_ids')
             saw_stale = saw_fresh = False
+            # Per-replica skip evidence, surfaced through route_info so
+            # the LB's lb.route span can explain WHY a replica was not
+            # picked (docs/observability.md "Tracing").
+            stale_replicas: List[str] = []
+            handoff_skipped: Optional[str] = None
             if token_ids and len(token_ids) > 1:
                 staleness = constants.lb_digest_staleness_seconds()
                 hash_cache: Dict[int, List[str]] = {}
@@ -319,6 +324,7 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
                         continue
                     if now - digest['at'] > staleness:
                         saw_stale = True
+                        stale_replicas.append(url)
                         continue
                     saw_fresh = True
                     chunk = digest['chunk']
@@ -379,6 +385,7 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
                                         'prefill_url': prefill_url,
                                         'phase': None}
                 self.stats['handoff_skipped_tokenizer'] += 1
+                handoff_skipped = 'tokenizer'
 
             # 2b. Phase-aware preference — the heuristic partition for
             # NON-tiered fleets (explicit tiers supersede it); uniform
@@ -411,7 +418,12 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
             else:
                 result = 'fallback'
             self.stats[result] += 1
-            return url, {'result': result, 'phase': phase}
+            info: Dict[str, Any] = {'result': result, 'phase': phase}
+            if stale_replicas:
+                info['stale_replicas'] = stale_replicas
+            if handoff_skipped:
+                info['handoff_skipped'] = handoff_skipped
+            return url, info
 
     def select_replica(self,
                        exclude: Optional[Set[str]] = None

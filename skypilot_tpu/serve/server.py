@@ -48,6 +48,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu.serve import constants as serve_constants
 from skypilot_tpu.observability import exposition
 from skypilot_tpu.observability import metrics as obs
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.utils import fault_injection
 
 logger = logging.getLogger(__name__)
@@ -102,6 +103,31 @@ async def _metrics_middleware(request: web.Request, handler):
         _REQ_LATENCY.labels(route=route).observe(
             time.monotonic() - start)
         _REQ_TOTAL.labels(route=route, status=str(status)).inc()
+
+
+@web.middleware
+async def _tracing_middleware(request: web.Request, handler):
+    """Continues (or mints) the request's trace (docs/observability.md
+    "Tracing"): an inbound X-SkyTPU-Trace header — the LB's, or the
+    prefill tier's on /kv/ingest — parents a 'server.request' span;
+    header-less POSTs mint a fresh trace so direct-to-replica traffic
+    is traceable too. GETs without a header (health probes, scrapes)
+    stay untraced — probe noise must not churn the span ring. The span
+    is the ambient context for the whole handler task (contextvars:
+    concurrent requests on one event loop cannot cross-contaminate),
+    so engine.submit() captures it. Disabled tracing costs one boolean
+    check per request."""
+    if not tracing.enabled():
+        return await handler(request)
+    ctx = tracing.parse_header(request.headers.get(tracing.TRACE_HEADER))
+    if ctx is None and request.method != 'POST':
+        return await handler(request)
+    with tracing.span('server.request', parent=ctx,
+                      attrs={'route': request.path,
+                             'method': request.method}) as sp:
+        response = await handler(request)
+        sp.set_attr('status', response.status)
+        return response
 
 
 def byte_encode(text: str) -> List[int]:
@@ -665,36 +691,51 @@ class InferenceServer:
 
     def _drain_and_export_impl(self, budget_s: float) -> dict:
         _PREEMPT_NOTICES.inc()
+        # Flight-recorder trigger (docs/observability.md "Tracing"):
+        # dump BEFORE the drain so the record shows what the engine
+        # was doing when the notice landed, not an already-quiesced
+        # engine.
+        tracing.flight_record(
+            'preempt_notice',
+            extra={'budget_s': budget_s, 'tier': self.tier,
+                   'queue_load': getattr(self.engine, 'queue_load',
+                                         lambda: 0)()})
         t0 = time.monotonic()
         deadline = t0 + budget_s
         # Reserve a slice of the budget for the export itself.
         export_reserve = min(2.0, budget_s * 0.3) \
             if self._can_export_prefixes() else 0.0
-        drained = self.engine.drain(
-            timeout=max(0.1, budget_s - export_reserve))
-        _PREEMPT_DRAIN_HIST.observe(time.monotonic() - t0)
-        result: dict = {'drained': drained, 'export': None}
-        if not self._can_export_prefixes():
+        # The notice span covers the whole drain + export window (the
+        # engine.preempt_export child lands inside export_prefixes).
+        with tracing.span('server.preempt_notice',
+                          attrs={'budget_s': budget_s}) as sp:
+            drained = self.engine.drain(
+                timeout=max(0.1, budget_s - export_reserve))
+            sp.set_attr('drained', drained)
+            _PREEMPT_DRAIN_HIST.observe(time.monotonic() - t0)
+            result: dict = {'drained': drained, 'export': None}
+            if not self._can_export_prefixes():
+                return result
+            if not drained:
+                # A timed-out drain can leave the engine thread
+                # mid-tick; export_prefixes requires a quiesced
+                # engine, and a snapshot raced by a live tick could
+                # publish a CRC-valid artifact holding stale KV.
+                # Losing the artifact is fine — the replacement just
+                # comes up cold; poisoning it is not.
+                result['error'] = 'drain timed out; export skipped'
+                return result
+            try:
+                # Chaos seam: the kill landing between drain and export.
+                fault_injection.point('replica.preempt_kill')
+                result['export'] = self._export_to_store(
+                    budget_s=max(0.1, deadline - time.monotonic()))
+            except fault_injection.InjectedFault as e:
+                result['error'] = f'killed mid-export: {e}'
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning('prefix export failed: %s', e)
+                result['error'] = str(e)
             return result
-        if not drained:
-            # A timed-out drain can leave the engine thread mid-tick;
-            # export_prefixes requires a quiesced engine, and a
-            # snapshot raced by a live tick could publish a CRC-valid
-            # artifact holding stale KV. Losing the artifact is fine —
-            # the replacement just comes up cold; poisoning it is not.
-            result['error'] = 'drain timed out; export skipped'
-            return result
-        try:
-            # Chaos seam: the kill landing between drain and export.
-            fault_injection.point('replica.preempt_kill')
-            result['export'] = self._export_to_store(
-                budget_s=max(0.1, deadline - time.monotonic()))
-        except fault_injection.InjectedFault as e:
-            result['error'] = f'killed mid-export: {e}'
-        except Exception as e:  # pylint: disable=broad-except
-            logger.warning('prefix export failed: %s', e)
-            result['error'] = str(e)
-        return result
 
     async def handle_preempt(self, request: web.Request) -> web.Response:
         """POST /preempt — the preemption-notice hook: stop admission
@@ -791,13 +832,23 @@ class InferenceServer:
     # there), a corrupt chunk answers 400 (the pusher may retry the
     # same seq — ingest is idempotent per (stream, seq)).
 
-    def _push_stream(self, target: str, chunks, stream_id: str) -> dict:
+    def _push_stream(self, target: str, chunks, stream_id: str,
+                     trace: Optional['tracing.SpanContext'] = None
+                     ) -> dict:
         """Push framed chunks to `target`'s /kv/ingest sequentially.
         One transport retry per CHUNK (receiver dedups by seq — a
         stream of many chunks survives one transient hiccup per chunk,
         not two total) plus up to two 409-guided resumes per stream;
-        anything else raises _HandoffPushError."""
+        anything else raises _HandoffPushError. `trace` (the kv_push
+        span's context) rides each POST as X-SkyTPU-Trace so the
+        decode replica's server.request span joins the handoff
+        trace (the chunk headers carry it too, for the engine-level
+        ingest spans)."""
         import requests as requests_lib
+        headers = {'Content-Type': 'application/octet-stream'}
+        trace_header = tracing.header_value(trace)
+        if trace_header:
+            headers[tracing.TRACE_HEADER] = trace_header
         pushed = 0
         bytes_total = 0
         retries = 0        # total across the stream (reported)
@@ -813,8 +864,7 @@ class InferenceServer:
             try:
                 resp = requests_lib.post(
                     target + '/kv/ingest', data=chunks[i],
-                    headers={'Content-Type':
-                             'application/octet-stream'},
+                    headers=headers,
                     timeout=30.0)
             except requests_lib.RequestException as e:
                 if chunk_retries >= 1:
@@ -850,12 +900,26 @@ class InferenceServer:
                 'retries': retries}
 
     def _prefill_and_push(self, ids, target: str, stream_id: str,
-                          chunk_blocks: int) -> dict:
+                          chunk_blocks: int,
+                          trace: Optional['tracing.SpanContext'] = None
+                          ) -> dict:
         t0 = time.monotonic()
-        pstats = self.engine.prefill_prefix(ids)
-        chunks = self.engine.export_prefix_chunks(
-            ids, stream_id, chunk_blocks=chunk_blocks)
-        push = self._push_stream(target, chunks, stream_id)
+        # Runs on an executor thread: the handler's request span is
+        # adopted explicitly (activate) so the prefill engine's
+        # queue-wait/prefill spans — and the push below — join the
+        # handoff trace.
+        with tracing.activate(trace):
+            pstats = self.engine.prefill_prefix(ids)
+            with tracing.span('server.kv_push',
+                              attrs={'target': target,
+                                     'stream': stream_id}) as sp:
+                chunks = self.engine.export_prefix_chunks(
+                    ids, stream_id, chunk_blocks=chunk_blocks,
+                    trace_header=tracing.header_value(sp.ctx))
+                push = self._push_stream(target, chunks, stream_id,
+                                         trace=sp.ctx)
+                sp.set_attr('chunks', push['chunks'])
+                sp.set_attr('bytes', push['bytes'])
         return {'ok': True, 'stream_id': stream_id,
                 'chunks': push['chunks'], 'bytes': push['bytes'],
                 'push_retries': push['retries'],
@@ -905,7 +969,7 @@ class InferenceServer:
             result = await loop.run_in_executor(
                 None, self._prefill_and_push,
                 [int(t) for t in prompt_ids], target.rstrip('/'),
-                stream_id, chunk_blocks)
+                stream_id, chunk_blocks, tracing.current())
         except exceptions.EngineOverloadedError as e:
             return self._unavailable(str(e))
         except _HandoffPushError as e:
@@ -980,6 +1044,26 @@ class InferenceServer:
                 status=400)
         aborted = self.engine.abort_ingest(stream_id)
         return web.json_response({'ok': True, 'aborted': aborted})
+
+    async def handle_traces(self, request: web.Request) -> web.Response:
+        """GET /traces — this process's span ring as JSON (the
+        `skytpu trace --url` feed), plus the histogram exemplars that
+        link metrics to trace ids (docs/observability.md "Tracing").
+        `?window_s=N` restricts to recent spans."""
+        window: Optional[float] = None
+        raw = request.query.get('window_s')
+        if raw:
+            try:
+                window = float(raw)
+            except ValueError:
+                return web.json_response(
+                    {'error': 'window_s must be a number'}, status=400)
+        return web.json_response({
+            'schema': 'skytpu-traces/1',
+            'enabled': tracing.enabled(),
+            'spans': tracing.snapshot(window_s=window),
+            'exemplars': exposition.collect_exemplars(),
+        })
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         """Prometheus text exposition of the process-wide registry:
@@ -1355,9 +1439,11 @@ class InferenceServer:
             return response
 
         app = web.Application(middlewares=[_metrics_middleware,
+                                           _tracing_middleware,
                                            fleet_headers_middleware])
         app.router.add_get('/health', self.handle_health)
         app.router.add_get('/metrics', self.handle_metrics)
+        app.router.add_get('/traces', self.handle_traces)
         app.router.add_post('/preempt', self.handle_preempt)
         app.router.add_post('/kv/prefill', self.handle_kv_prefill)
         app.router.add_post('/kv/ingest', self.handle_kv_ingest)

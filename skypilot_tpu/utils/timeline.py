@@ -19,6 +19,19 @@ _events: List[dict] = []
 _lock = threading.Lock()
 _enabled: Optional[bool] = None
 
+# Streamed-append sink: long-lived processes (a serve replica tracing
+# for hours) flush pending events to the output file every
+# _FLUSH_EVERY records instead of holding — and then re-serializing —
+# the WHOLE event list at save time (the old save was O(total events)
+# in both memory and write cost). The file grows as
+# `{"traceEvents": [e, e, ...` and `save_timeline()` finalizes it once
+# with the counter snapshot, the tracer's span tracks, and the closing
+# `], ...}` tail; an un-finalized (crashed) file is still loadable by
+# Perfetto, which tolerates a truncated trailing array.
+_FLUSH_EVERY = 512
+_sink = {'path': None, 'wrote_any': False, 'finalized': False}
+_tids_seen: set = set()
+
 
 def _is_enabled() -> bool:
     global _enabled
@@ -27,6 +40,37 @@ def _is_enabled() -> bool:
         if _enabled:
             atexit.register(save_timeline)
     return _enabled
+
+
+def _sink_path() -> str:
+    if _sink['path'] is None:
+        _sink['path'] = os.environ.get(
+            'SKYTPU_TIMELINE_FILE',
+            os.path.expanduser(
+                f'~/.skytpu/timelines/timeline-{os.getpid()}.json'))
+    return _sink['path']
+
+
+def _flush_locked(extra_events: Optional[List[dict]] = None) -> None:
+    """Append pending (+ extra) events to the sink file. Caller holds
+    _lock. O(batch), not O(everything recorded so far)."""
+    batch = _events + (extra_events or [])
+    if not batch:
+        return
+    _events.clear()
+    path = _sink_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    parts = []
+    if not _sink['wrote_any']:
+        parts.append('{"traceEvents": [\n')
+    for i, event in enumerate(batch):
+        if _sink['wrote_any'] or i:
+            parts.append(',\n')
+        parts.append(json.dumps(event))
+    mode = 'a' if _sink['wrote_any'] else 'w'
+    with open(path, mode, encoding='utf-8') as f:
+        f.write(''.join(parts))
+    _sink['wrote_any'] = True
 
 
 def _record(name: str, phase: str, args: Optional[dict] = None) -> None:
@@ -45,7 +89,16 @@ def _record(name: str, phase: str, args: Optional[dict] = None) -> None:
     if args is not None:
         event['args'] = args
     with _lock:
+        if _sink['finalized']:
+            # The file's closing tail is already written; appending
+            # past it would corrupt the JSON. Late events are dropped
+            # (finalize runs at exit — anything after it has no
+            # durable destination anyway).
+            return
+        _tids_seen.add(event['tid'])
         _events.append(event)
+        if len(_events) >= _FLUSH_EVERY:
+            _flush_locked()
 
 
 def counter_event(name: str, values: dict) -> bool:
@@ -124,6 +177,12 @@ class FileLockEvent:
 
 
 def save_timeline() -> None:
+    """Finalize the streamed timeline file ONCE: flush pending events,
+    merge a registry counter snapshot and the tracer's span tracks
+    under their own Perfetto track names (timeline B/E tracks keep the
+    real thread ids, named 'timeline:<tid>'; spans render on synthetic
+    'spans:<subsystem>' tracks; 'C' counters get per-name counter
+    tracks), then write the closing tail."""
     # Final metrics snapshot first, so counters and spans land in one
     # Perfetto view (lazy + guarded: tracing must not die on an
     # observability import problem, and utils stays import-light).
@@ -132,18 +191,29 @@ def save_timeline() -> None:
         exposition.timeline_snapshot()
     except Exception:  # pylint: disable=broad-except
         pass
-    if not _events:
-        return
-    path = os.environ.get(
-        'SKYTPU_TIMELINE_FILE',
-        os.path.expanduser(f'~/.skytpu/timelines/timeline-{os.getpid()}.json'))
-    os.makedirs(os.path.dirname(path), exist_ok=True)
+    span_events: List[dict] = []
+    try:
+        from skypilot_tpu.observability import tracing
+        span_events = tracing.perfetto_events()
+    except Exception:  # pylint: disable=broad-except
+        pass
     with _lock:
-        payload = {
-            'traceEvents': list(_events),
-            'displayTimeUnit': 'ms',
-            'otherData': {'argv': ' '.join(os.sys.argv)},
-        }
-        _events.clear()
-    with open(path, 'w', encoding='utf-8') as f:
-        json.dump(payload, f)
+        if _sink['finalized']:
+            return
+        pid = os.getpid()
+        track_meta = [
+            {'name': 'thread_name', 'ph': 'M', 'pid': pid, 'tid': tid,
+             'args': {'name': f'timeline:{tid}'}}
+            for tid in sorted(_tids_seen)
+        ]
+        if not (_events or span_events or track_meta or
+                _sink['wrote_any']):
+            return
+        _flush_locked(track_meta + span_events)
+        tail = (
+            '\n], "displayTimeUnit": "ms", "otherData": '
+            + json.dumps({'argv': ' '.join(os.sys.argv)}) + '}'
+        )
+        with open(_sink_path(), 'a', encoding='utf-8') as f:
+            f.write(tail)
+        _sink['finalized'] = True

@@ -222,6 +222,48 @@ class TestFleetDryrunDispatch:
         assert row['combo'] == {'paged_block_size': 8,
                                 'prefix_cache': 8}
 
+    def test_dryrun_trace_skips_tpu_preflight(self, monkeypatch):
+        """--dryrun-trace is the end-to-end tracing proxy (CPU-only by
+        design): the no-preflight dryrun supervisor, never the TPU
+        probe ladder."""
+        bench = _load_bench()
+        calls = {}
+
+        def fake_dryrun(argv):
+            calls['dry'] = argv
+            return 0
+
+        monkeypatch.setattr(bench, '_supervise_dryrun', fake_dryrun)
+        monkeypatch.setattr(
+            bench, '_supervise',
+            lambda argv: (_ for _ in ()).throw(
+                AssertionError('TPU preflight path taken')))
+        monkeypatch.setattr(sys, 'argv', ['bench.py', '--dryrun-trace'])
+        assert bench.main() == 0
+        assert calls['dry'] == ['--dryrun-trace']
+
+    def test_dryrun_trace_skip_on_unconstructable_engine(
+            self, monkeypatch, capsys):
+        """An engine combination the constructor rejects emits the
+        structured {"skipped": true} line with the combo and rc=3."""
+        bench = _load_bench()
+        from skypilot_tpu.models import inference as inference_lib
+
+        def boom(*_a, **_kw):
+            raise ValueError('paged_block_size does not divide')
+
+        monkeypatch.setattr(inference_lib, 'ContinuousBatchingEngine',
+                            boom)
+        rc = bench._dryrun_trace(
+            bench._parse_args(['--dryrun-trace', '--worker']))
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        row = json.loads(out)
+        assert rc == 3
+        assert row['skipped'] is True
+        assert 'trace-dryrun' in row['reason']
+        assert row['combo'] == {'paged_block_size': 8,
+                                'prefix_cache': 6}
+
     def test_dryrun_train_zero1_skips_tpu_preflight(self, monkeypatch):
         """--dryrun-train-zero1 is the MULTICHIP training proxy (the
         chip unreachable is its whole reason to exist): the no-preflight

@@ -1184,6 +1184,73 @@ class TestDisaggHandoff:
         assert results.get('long') == expect_long
         self._check_pools(fleet)
 
+    def test_trace_context_round_trips_the_two_hop_handoff(
+            self, tiered_fleet):
+        """ISSUE 14 acceptance: a real 2-hop disaggregated request
+        (LB → prefill → chunk stream → decode, live HTTP) produces
+        ONE trace whose span tree keeps the full parentage — the
+        lb.request root reaches the decode-side engine.ingest_publish
+        through the prefill replica's server.request/server.kv_push
+        (trace context via X-SkyTPU-Trace AND the chunk headers), and
+        the served request's queue-wait/prefill/decode spans carry
+        their timings."""
+        from skypilot_tpu.observability import tracing
+        fleet = tiered_fleet
+        ids = list(range(190, 214))  # fresh range ⇒ a real handoff
+        expect = fleet['ref'].generate(ids, max_new_tokens=4,
+                                       timeout=300)[0]
+        tracing.enable()
+        tracing.reset()
+        try:
+            out = self._post(fleet['lb_url'], ids)
+            spans = tracing.snapshot()
+        finally:
+            tracing.disable()
+            tracing.reset()
+        assert out == expect
+        names = {s['name'] for s in spans}
+        assert {'lb.request', 'lb.route', 'lb.handoff',
+                'lb.handoff_attempt', 'lb.proxy', 'server.request',
+                'server.kv_push', 'engine.queue_wait',
+                'engine.prefill', 'engine.decode',
+                'engine.ingest_chunk',
+                'engine.ingest_publish'} <= names, sorted(names)
+        # ONE trace end to end.
+        assert len({s['trace_id'] for s in spans}) == 1
+        by_id = {s['span_id']: s for s in spans}
+
+        def chain(span):
+            out_chain = [span['name']]
+            while span.get('parent_id') in by_id:
+                span = by_id[span['parent_id']]
+                out_chain.append(span['name'])
+            return list(reversed(out_chain))
+
+        # The KV stream's publish on the DECODE replica chains back to
+        # the LB root through the prefill replica: ≥ 4 hops.
+        publish = next(s for s in spans
+                       if s['name'] == 'engine.ingest_publish')
+        publish_chain = chain(publish)
+        assert publish_chain[0] == 'lb.request'
+        assert 'server.kv_push' in publish_chain
+        assert len(publish_chain) >= 5, publish_chain
+        # The served (decode-tier) request's spans sit under lb.proxy
+        # → server.request, with timings attached.
+        decode = max((s for s in spans if s['name'] == 'engine.decode'),
+                     key=lambda s: s['ts_us'])
+        decode_chain = chain(decode)
+        assert decode_chain[0] == 'lb.request'
+        assert 'server.request' in decode_chain
+        prefills = [s for s in spans if s['name'] == 'engine.prefill']
+        assert all(s['attrs']['ttft_s'] >= 0 for s in prefills)
+        # The routing decision recorded WHY it chose what it chose.
+        route = next(s for s in spans if s['name'] == 'lb.route')
+        assert route['attrs']['result'] == 'handoff'
+        handoff = next(s for s in spans if s['name'] == 'lb.handoff')
+        assert handoff['attrs']['outcome'] == 'ok'
+        assert handoff['attrs']['chunks'] == 3
+        self._check_pools(fleet)
+
 
 # ---------------------------------------------------------------------
 # controller-RPC escalation: serve mirror + cross-process jobs CLI

@@ -776,7 +776,8 @@ class TestPushStream:
         from skypilot_tpu.serve.server import _HandoffPushError
         server = _bare_prefill_server()
 
-        def shed(_ids, _target, _stream_id, _chunk_blocks):
+        def shed(_ids, _target, _stream_id, _chunk_blocks,
+                 _trace=None):
             raise _HandoffPushError('decode shed the ingest', 3,
                                     status=503)
         server._prefill_and_push = shed  # pylint: disable=protected-access
