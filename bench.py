@@ -138,6 +138,19 @@ def _parse_args(argv=None):
                              'pinned against the expected block math, '
                              'and greedy bit-identity vs a monolithic '
                              'oracle for every request')
+    parser.add_argument('--dryrun-serve-multitenant', action='store_true',
+                        help='emit the MULTITENANT_serve proxy row on '
+                             'CPU (no chip needed): ONE real engine '
+                             'holds 3 resident LoRA adapters and '
+                             'serves a 3-adapter × 3-tier mix — pins '
+                             'per-adapter greedy BIT-IDENTITY vs '
+                             'three dedicated single-adapter engines, '
+                             'one-decode-dispatch batching (compile '
+                             'count == 1 + shared step_log rows), and '
+                             'interactive p50 TTFT under a batch-tier '
+                             'flood vs the same flood untiered '
+                             '(docs/serving.md "Multi-tenant '
+                             'serving")')
     parser.add_argument('--dryrun-trace', action='store_true',
                         help='emit the TRACE proxy row on CPU (no chip '
                              'needed): a real 2-hop disaggregated '
@@ -961,6 +974,191 @@ def _dryrun_serve_disagg(args) -> int:
     return 0 if ok else 1
 
 
+def _dryrun_serve_multitenant(args) -> int:
+    """MULTITENANT_serve: the multi-LoRA + SLO-tier proxy row on CPU
+    (docs/serving.md "Multi-tenant serving"; the DISAGG_serve pattern
+    applied to tenancy).
+
+    One REAL multi-adapter engine (3 resident adapters, paged pool)
+    serves a 3-adapter × 3-tier request mix; three dedicated
+    single-adapter engines (unmerged LoRADenseGeneral) plus a plain
+    base engine are the bit-identity oracles. Then the SLO leg: a
+    batch-tier flood with interactive arrivals, tiered vs the SAME
+    flood with every request untiered ('standard').
+
+    Pins: per-request greedy bit-identity (mixed batch vs dedicated
+    engines, every tier cell); ONE compiled decode program + ≥1
+    all-four-slots step_log row (the one-dispatch batching proof);
+    interactive p50 TTFT under the flood strictly below the untiered
+    baseline; zero non-retryable losses with ≥1 slot preemption.
+    Emits ONE JSON row; unconstructable combos emit the structured
+    {"skipped": true} line with rc=3."""
+    del args
+    import dataclasses
+    import time as time_lib
+
+    from flax import linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import get_config
+    from skypilot_tpu.models import inference as inference_lib
+    from skypilot_tpu.models.transformer import Transformer
+    from skypilot_tpu.serve import tenancy
+
+    cfg = dataclasses.replace(
+        get_config('test-tiny'), dtype='float32', param_dtype='float32',
+        max_seq_len=64, remat=False)
+    lora_kw = dict(adapter_rank=4, adapter_alpha=8.0,
+                   adapter_targets='q,v')
+    lora_cfg = dataclasses.replace(cfg, lora_rank=4, lora_alpha=8.0,
+                                   lora_targets='q,v')
+    prompt = list(range(1, 11))
+    n_adapters, new_tokens = 3, 8
+
+    try:
+        engine = inference_lib.ContinuousBatchingEngine(
+            cfg, num_slots=4, max_adapters=n_adapters,
+            paged_block_size=8, prefix_cache=4, **lora_kw)
+    except (ValueError, NotImplementedError) as e:
+        # An unconstructable combination is a deterministic verdict —
+        # the structured skip, never the retry ladder.
+        _emit_skip(f'unsupported multitenant combination: {e}',
+                   combo={'max_adapters': n_adapters,
+                          'paged_block_size': 8, **lora_kw})
+        return 3
+    base_params = engine.params
+
+    # ---- adapter weights + dedicated oracles ----
+    template_model = Transformer(dataclasses.replace(lora_cfg,
+                                                     decode=True))
+    template_vars = nn.unbox(template_model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32),
+        jnp.zeros((1, 8), jnp.int32)))
+    template = tenancy.adapter_tree_from_lora_params(
+        template_vars['params'])
+    leaves, treedef = jax.tree.flatten(template)
+
+    def rand_tree(seed):
+        keys = jax.random.split(jax.random.PRNGKey(seed), len(leaves))
+        return jax.tree.unflatten(treedef, [
+            jax.random.normal(k, leaf.shape, jnp.float32) * 0.05
+            for k, leaf in zip(keys, leaves)])
+
+    def overlay(params, sub):
+        out = dict(params)
+        for key, value in sub.items():
+            out[key] = (overlay(params[key], value)
+                        if isinstance(value, dict) else value)
+        return out
+
+    trees = {f'tenant-{i}': rand_tree(100 + i)
+             for i in range(n_adapters)}
+    refs = {}
+    plain = inference_lib.ContinuousBatchingEngine(
+        cfg, params=base_params, num_slots=4)
+    refs['base'] = plain.generate(prompt,
+                                  max_new_tokens=new_tokens)[0]
+    plain.stop()
+    for name, tree in trees.items():
+        dedicated = inference_lib.ContinuousBatchingEngine(
+            lora_cfg, params=overlay(base_params, tree), num_slots=4)
+        refs[name] = dedicated.generate(
+            prompt, max_new_tokens=new_tokens)[0]
+        dedicated.stop()
+
+    # ---- leg (a): mixed 3-adapter × 3-tier batch on ONE engine ----
+    for name, tree in trees.items():
+        engine.load_adapter(name, tree)
+    tiers = ['interactive', 'standard', 'batch']
+    futures = [('base', engine.submit(prompt,
+                                      max_new_tokens=new_tokens))]
+    for i, name in enumerate(trees):
+        futures.append((name, engine.submit(
+            prompt, max_new_tokens=new_tokens, adapter=name,
+            priority=tiers[i % len(tiers)])))
+    mismatches = 0
+    for name, future in futures:
+        out, _stats = future.result(timeout=600)
+        if out != refs[name]:
+            mismatches += 1
+    decode_compiles = engine._decode._cache_size()  # pylint: disable=protected-access
+    shared_steps = sum(1 for entry in engine.step_log
+                       if entry[0] != 'prefill' and len(entry[1]) == 4)
+    adapter_stats = dict(engine._adapter_pool.stats)  # pylint: disable=protected-access
+    engine.stop()
+
+    # ---- leg (b): interactive p50 TTFT under a batch flood ----
+    def p50(values):
+        ordered = sorted(values)
+        return ordered[len(ordered) // 2]
+
+    def run_flood(tiered: bool):
+        """8 batch-tier floods + 3 interactive arrivals on a WARM
+        2-slot engine; returns (interactive ttfts, batch failures,
+        preempts). The warmup request compiles prefill+decode first so
+        the TTFT comparison measures SCHEDULING, not JIT noise."""
+        flood_engine = inference_lib.ContinuousBatchingEngine(
+            cfg, params=base_params, num_slots=2,
+            max_adapters=n_adapters, paged_block_size=8,
+            prefix_cache=4, **lora_kw)
+        flood_engine.generate([1, 2, 3], max_new_tokens=2,
+                              timeout=600)
+        flood_priority = 'batch' if tiered else 'standard'
+        int_priority = 'interactive' if tiered else 'standard'
+        flood = [flood_engine.submit(list(range(1, 9)),
+                                     max_new_tokens=48,
+                                     priority=flood_priority)
+                 for _ in range(8)]
+        time_lib.sleep(0.15)
+        arrivals = [flood_engine.submit([40 + i, 41, 42],
+                                        max_new_tokens=4,
+                                        priority=int_priority)
+                    for i in range(3)]
+        ttfts = [f.result(timeout=600)[1]['ttft_s'] for f in arrivals]
+        failures = sum(1 for f in flood
+                       if f.exception(timeout=600) is not None)
+        preempts = flood_engine.tenancy_stats['slot_preempts']
+        flood_engine.stop()
+        return ttfts, failures, preempts
+
+    tiered_ttfts, tiered_failures, preempts = run_flood(tiered=True)
+    untiered_ttfts, untiered_failures, _ = run_flood(tiered=False)
+    tiered_p50 = p50(tiered_ttfts)
+    untiered_p50 = p50(untiered_ttfts)
+
+    ok = bool(
+        mismatches == 0
+        and decode_compiles == 1
+        and shared_steps >= 1
+        and tiered_failures == 0 and untiered_failures == 0
+        and preempts >= 1
+        and tiered_p50 < untiered_p50)
+    row = {
+        'metric': 'MULTITENANT_serve dryrun interactive TTFT under '
+                  'batch flood',
+        'value': round(tiered_p50 * 1e3, 2),
+        'unit': 'ms',
+        'vs_baseline': round(untiered_p50 / max(1e-9, tiered_p50), 2),
+        'ok': ok,
+        'skipped': False,
+        'adapters': n_adapters,
+        'tiers': tiers,
+        'output_mismatches': mismatches,
+        'decode_compiles': decode_compiles,
+        'shared_4slot_steps': shared_steps,
+        'adapter_loads': adapter_stats.get('loads', 0),
+        'slot_preempts': preempts,
+        'batch_failures_tiered': tiered_failures,
+        'batch_failures_untiered': untiered_failures,
+        'interactive_ttft_p50_ms_tiered': round(tiered_p50 * 1e3, 2),
+        'interactive_ttft_p50_ms_untiered': round(
+            untiered_p50 * 1e3, 2),
+    }
+    print(json.dumps(row))
+    return 0 if ok else 1
+
+
 def _dryrun_trace(args) -> int:
     """TRACE: the end-to-end tracing proxy row on CPU (runs with the
     chip unreachable — the DISAGG_serve pattern applied to the span
@@ -1600,6 +1798,8 @@ def _worker(args) -> int:
         return _dryrun_serve_fleet(args)
     if args.dryrun_serve_disagg:
         return _dryrun_serve_disagg(args)
+    if args.dryrun_serve_multitenant:
+        return _dryrun_serve_multitenant(args)
     if args.dryrun_trace:
         return _dryrun_trace(args)
     if args.dryrun_train_zero1:
@@ -1780,7 +1980,8 @@ def main() -> int:
         # and deterministic — run it right here.
         return _dryrun_lint(args)
     if (args.dryrun_serve_sharded or args.dryrun_serve_fleet or
-            args.dryrun_serve_disagg or args.dryrun_trace or
+            args.dryrun_serve_disagg or args.dryrun_serve_multitenant or
+            args.dryrun_trace or
             args.dryrun_train_zero1 or args.dryrun_train_elastic):
         return _supervise_dryrun(argv)
     return _supervise(argv)

@@ -893,6 +893,25 @@ def serve_status(service_name, endpoint_only):
             return f"ok({pw.get('imported', 0)} pfx{partial})"
         return pw.get('status', '-')
 
+    def _adapters_cell(info):
+        # Multi-tenant serving (docs/serving.md): resident/capacity of
+        # the replica's device-side adapter pool; old rows (and
+        # adapter-less replicas) show '-'.
+        ad = info.get('adapters')
+        if not ad:
+            return '-'
+        return f"{ad.get('resident', 0)}/{ad.get('capacity', 0)}"
+
+    def _tier_mix_cell(info):
+        # Per-SLO-tier load snapshot (i=interactive, s=standard,
+        # b=batch); old rows tolerate (the PR-13 TIER-column pattern).
+        tl = info.get('tier_load')
+        if not tl:
+            return '-'
+        return (f"i{tl.get('interactive', 0)}"
+                f"/s{tl.get('standard', 0)}"
+                f"/b{tl.get('batch', 0)}")
+
     for r in records:
         click.secho(f"{r['name']}  [{r['status'].value}]  "
                     f"endpoint: {r['endpoint'] or '-'}", bold=True)
@@ -908,11 +927,12 @@ def serve_status(service_name, endpoint_only):
                  i.get('tier') or 'monolithic',
                  'spot' if i['is_spot'] else 'on-demand', i['version'],
                  i.get('preemption_count', 0) or '-',
-                 _prewarm_cell(i)]
+                 _prewarm_cell(i), _adapters_cell(i), _tier_mix_cell(i)]
                 for i in r['replica_info']]
         _print_table(rows,
                      ['REPLICA', 'STATUS', 'URL', 'TIER', 'CAPACITY',
-                      'VERSION', 'PREEMPTS', 'PREWARM'])
+                      'VERSION', 'PREEMPTS', 'PREWARM', 'ADAPTERS',
+                      'TIER-MIX'])
 
 
 @serve.command('update')

@@ -94,6 +94,18 @@ class ModelConfig:
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: str = 'q,v'
+    # Multi-tenant serving (docs/serving.md "Multi-tenant serving"):
+    # >0 ⇒ each LoRA-targeted projection becomes
+    # transformer.MultiLoRADenseGeneral — base kernel params unchanged
+    # (plain checkpoints line up), plus a device-resident STACK of
+    # serve_adapters loadable adapters in the separate 'adapters'
+    # variable collection ((serve_adapters+1, ...) leaves; slot 0 is
+    # the all-zero identity so base-model requests ride the same
+    # kernel). A per-row adapter-index vector drives a segmented
+    # gather inside the projection, so one decode dispatch serves
+    # many tenants' adapters at once. Uses lora_rank/lora_alpha/
+    # lora_targets for the adapter geometry (uniform across residents).
+    serve_adapters: int = 0
     # When vocab_size is padded for MXU tiling (e.g. GPT-2 50257→50304),
     # the REAL vocabulary size: logits beyond it are masked to -inf so
     # temperature sampling can never emit an invalid token id (padded

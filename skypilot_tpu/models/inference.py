@@ -34,6 +34,7 @@ from skypilot_tpu.models.transformer import Transformer
 from skypilot_tpu.observability import metrics as obs
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.parallel import sharding as sharding_lib
+from skypilot_tpu.serve import tenancy
 from skypilot_tpu.utils import fault_injection
 
 logger = logging.getLogger(__name__)
@@ -187,6 +188,44 @@ _POOL_BYTES_PER_DEV = obs.gauge(
     'HBM bytes of the paged KV pool resident on each mesh device '
     '(every device holds its kv-head shard of every block: '
     'pool bytes / tp)', ('device',))
+# Multi-tenant serving (docs/serving.md "Multi-tenant serving").
+_ADAPTER_SLOTS = obs.gauge(
+    'skytpu_engine_adapter_slots',
+    'Device-side adapter pool capacity (loadable slots; slot 0 = the '
+    'base-model identity is extra)')
+_ADAPTER_RESIDENT = obs.gauge(
+    'skytpu_engine_adapter_resident',
+    'Adapters currently resident in the device-side pool')
+_ADAPTER_LOADS = obs.counter(
+    'skytpu_engine_adapter_loads_total',
+    'Adapter loads into a device slot (first load + re-load after '
+    'eviction)')
+_ADAPTER_EVICTIONS = obs.counter(
+    'skytpu_engine_adapter_evictions_total',
+    'LRU evictions of refcount-0 resident adapters under slot '
+    'pressure')
+_ADAPTER_SHED = obs.counter(
+    'skytpu_engine_adapter_shed_total',
+    'Requests/loads shed because every adapter slot was pinned '
+    '(AdapterPoolExhaustedError; retryable)')
+_TIER_QUEUE_DEPTH = obs.gauge(
+    'skytpu_engine_tier_queue_depth',
+    'Admission-queue depth by SLO tier', ('tier',))
+_TIER_TTFT_HIST = obs.histogram(
+    'skytpu_engine_tier_ttft_seconds',
+    'Submit → first token by SLO tier (the per-tier autoscaler '
+    'signal: target_ttft_seconds_per_tier)', ('tier',))
+_TIER_REQUESTS = obs.counter(
+    'skytpu_engine_tier_requests_total',
+    'Requests submitted by SLO tier', ('tier',))
+_TIER_DEADLINE_SHED = obs.counter(
+    'skytpu_engine_tier_deadline_shed_total',
+    'Requests shed at submit because their deadline was unmeetable '
+    'at the current queue depth (429 + Retry-After)', ('tier',))
+_SLOT_PREEMPTS = obs.counter(
+    'skytpu_engine_slot_preempts_total',
+    'batch-tier requests preempted out of a decode slot by an '
+    'interactive arrival and re-queued retryably')
 
 # step_log cap: enough history for any interleaving assertion while
 # bounding a serve replica that decodes for weeks (the old unbounded
@@ -515,6 +554,16 @@ def _resolve_cfg_and_params(cfg: 'ModelConfig | str',
         logger.info('Initializing random weights for %s', cfg.name)
         init_cfg = dataclasses.replace(cfg, decode=False,
                                        weight_quant='none')
+        if cfg.serve_adapters > 0:
+            # Plain-params init for a multi-LoRA engine: the multi-LoRA
+            # module's base params are name/shape-identical to
+            # nn.DenseGeneral's but the two flavors DRAW differently
+            # (DenseGeneral's kernel init flattens fan dims) —
+            # random-init weights must equal a plain engine's so
+            # per-adapter bit-identity holds against it. The adapter
+            # stacks are built separately (zeros) by the engine.
+            init_cfg = dataclasses.replace(init_cfg, serve_adapters=0,
+                                           lora_rank=0)
         # jit the whole init: unjitted flax init dispatches hundreds of
         # small ops one by one — on a remote/tunneled device each pays a
         # round trip and a 1B-model bring-up stretches to many minutes.
@@ -788,10 +837,13 @@ class _Request:
                  'future', 'submit_time', 'first_token_time', 'tokens',
                  'next_pos', 'on_token', 'deadline', 'blocks',
                  'prefilling', 'prefill_pos', 'seq', 'trace',
-                 'admit_time')
+                 'admit_time', 'tier', 'adapter', 'adapter_slot',
+                 'adapter_pool', 'context', 'preemptions',
+                 'admit_mono')
 
     def __init__(self, ids, max_new_tokens, temperature, eos_id, future,
-                 on_token=None, deadline=None):
+                 on_token=None, deadline=None, tier='standard',
+                 adapter=None, adapter_slot=0, adapter_pool=None):
         self.seq = next(_REQ_SEQ)
         self.ids = list(ids)
         self.max_new_tokens = max_new_tokens
@@ -827,6 +879,29 @@ class _Request:
         # (pinned by tests/test_tracing.py).
         self.trace = None
         self.admit_time: Optional[float] = None
+        # -------- multi-tenant serving (serve/tenancy) --------
+        # SLO tier ('interactive'/'standard'/'batch'): drives admission
+        # order, deadline-aware shed, and batch-slot preemption.
+        self.tier = tier
+        # Adapter identity: registered name, the device slot index its
+        # weights occupy (0 = base-model identity), and the POOL OBJECT
+        # the pin was taken against — release always goes to that
+        # object, so a wedge recovery's pool swap can never corrupt the
+        # successor's refcounts (the slots/queue-swap isolation
+        # pattern). adapter_pool is set to None once released.
+        self.adapter = adapter
+        self.adapter_slot = adapter_slot
+        self.adapter_pool = adapter_pool
+        # Prefill context: == ids until a slot preemption folds the
+        # already-generated tokens in (ids + tokens) so the re-admitted
+        # request CONTINUES instead of restarting — greedy continuation
+        # is bit-identical to the uninterrupted stream.
+        self.context = self.ids
+        self.preemptions = 0
+        # Admission stamp (monotonic, unconditional — unlike the
+        # tracing-only admit_time): feeds the admission→first-token
+        # service EWMA behind deadline-aware admission.
+        self.admit_mono: Optional[float] = None
 
 
 class ContinuousBatchingEngine:
@@ -865,9 +940,42 @@ class ContinuousBatchingEngine:
                  prefill_chunk: int = 0,
                  async_depth: int = 0,
                  tier: str = 'monolithic',
-                 ingest_ttl: float = 60.0) -> None:
-        import queue as queue_lib
+                 ingest_ttl: float = 60.0,
+                 max_adapters: int = 0,
+                 adapter_rank: int = 0,
+                 adapter_alpha: float = 16.0,
+                 adapter_targets: str = '') -> None:
+        import queue as queue_lib  # noqa: F401 (historical import)
         import threading
+        # -------- multi-LoRA serving (docs/serving.md) --------
+        # max_adapters=N ⇒ the engine holds up to N adapters RESIDENT
+        # in a fixed device-side stack and batches requests for
+        # different adapters (and the base model) into ONE decode
+        # dispatch — a per-slot adapter-index vector drives a gathered
+        # low-rank delta inside the targeted projections
+        # (transformer.MultiLoRADenseGeneral). Residency/LRU/refcounts
+        # live in serve/tenancy.AdapterPool; device writes run in the
+        # tick thread via _run_in_tick, off the steady decode path.
+        self.max_adapters = max(0, max_adapters)
+        if self.max_adapters:
+            if quantize == 'int8':
+                # Fail at construction, not inside the first traced
+                # dispatch: the adapter delta applies to the FLOAT base
+                # projection (transformer.dense_general refuses too).
+                raise NotImplementedError(
+                    'max_adapters does not compose with int8 WEIGHTS '
+                    '(int8 KV is fine); serve unquantized, or merge a '
+                    'single adapter and quantize that')
+            base_cfg = get_config(cfg) if isinstance(cfg, str) else cfg
+            rank = adapter_rank or base_cfg.lora_rank
+            if rank <= 0:
+                raise ValueError(
+                    'max_adapters > 0 requires adapter_rank > 0 (the '
+                    'uniform rank every resident adapter must share)')
+            cfg = dataclasses.replace(
+                base_cfg, serve_adapters=self.max_adapters,
+                lora_rank=rank, lora_alpha=adapter_alpha,
+                lora_targets=adapter_targets or base_cfg.lora_targets)
         self.cfg, self.params = _resolve_cfg_and_params(
             cfg, params, max_seq_len, rng_seed, quantize, kv_quant,
             mesh=mesh)
@@ -1065,6 +1173,47 @@ class ContinuousBatchingEngine:
                      _POOL_BYTES_PER_DEV.labels(device=str(i)))
                     for i in range(self._tp)]
 
+        # -------- adapter pool state (multi-LoRA serving) --------
+        self._adapter_pool: 'Optional[tenancy.AdapterPool]' = None
+        self._adapters = None          # device-side stacked A/B tree
+        self._adapter_axis = None      # per-leaf slot-axis pytree
+        self._aids_sig: Optional[tuple] = None
+        self._aids_cache = None
+        if self.max_adapters:
+            self._adapter_pool = tenancy.AdapterPool(self.max_adapters)
+            boxed = _abstract_init(self.model, self.cfg, 1)['adapters']
+            shapes = nn.unbox(boxed)
+            # Slot axis per leaf, found structurally (scanned layouts
+            # carry a leading num_layers axis): the one axis that grows
+            # when serve_adapters grows by one.
+            probe_cfg = dataclasses.replace(
+                self.cfg, serve_adapters=self.max_adapters + 1)
+            probe = nn.unbox(_abstract_init(
+                Transformer(probe_cfg), probe_cfg, 1)['adapters'])
+            self._adapter_axis = jax.tree.map(
+                lambda a, b: next(i for i in range(a.ndim)
+                                  if a.shape[i] != b.shape[i]),
+                shapes, probe)
+            # Born zeroed (slot 0 stays zero forever = the identity);
+            # replicated under a tp mesh (all-None logical axes) —
+            # adapters are tiny next to the weights. boxed/shapes kept
+            # for wedge-recovery rebuilds and load-time validation.
+            self._adapter_boxed = boxed
+            self._adapter_shapes = shapes
+            self._adapters = _zeros_from_shapes(
+                boxed, self.mesh if self._tp > 1 else None)
+            _ADAPTER_SLOTS.set(self.max_adapters)
+        # Admission→first-token service EWMA: the deadline-aware
+        # admission estimate (None until the first completion — early
+        # requests are never shed on a guess).
+        self.ttft_estimate: Optional[float] = None
+        self.tenancy_stats = {'slot_preempts': 0, 'deadline_sheds': 0,
+                              'adapter_sheds': 0}
+        # True once any non-'standard' request has been submitted —
+        # gates the server's per-response tier-load header (an
+        # O(queue) scan a tier-less deployment should never pay).
+        self._tiers_active = False
+
         self._prefill = jax.jit(self._prefill_impl)
         self._prefill_continue = jax.jit(self._prefill_continue_impl)
         self._insert = jax.jit(self._insert_impl,
@@ -1082,8 +1231,16 @@ class ContinuousBatchingEngine:
                                          donate_argnames=('cache',))
         self._cow_fn = jax.jit(self._cow_copy_impl,
                                donate_argnames=('cache',))
+        # Adapter slot write: donate the old stack (one device-side
+        # dynamic_update_slice per leaf; runs in the tick thread only).
+        self._adapter_write = jax.jit(self._adapter_write_impl,
+                                      donate_argnames=('adapters',))
 
-        self._queue: 'queue_lib.Queue[_Request]' = queue_lib.Queue()
+        # Tier-ordered admission queue (serve/tenancy/scheduling.py):
+        # drop-in queue.Queue — FIFO when every request is 'standard',
+        # interactive-first with a deterministic batch starvation floor
+        # otherwise.
+        self._queue: 'tenancy.TierQueue' = tenancy.TierQueue()
         self._slots: list = [None] * num_slots  # _Request or None
         self._cache = None
         self._stop = threading.Event()
@@ -1164,7 +1321,32 @@ class ContinuousBatchingEngine:
         return kv_cache_lib.PrefixIndex(
             capacity=max(1, self.prefix_cache), chunk=chunk)
 
-    def _prefill_impl(self, params, tokens, true_len):
+    def _variables(self, params, cache, adapters):
+        """Apply-time variable collections: the 'adapters' stack rides
+        along only on multi-LoRA engines (None otherwise, keeping the
+        jit signatures of adapter-less engines unchanged)."""
+        variables = {'params': params, 'cache': cache}
+        if adapters is not None:
+            variables['adapters'] = adapters
+        return variables
+
+    def _adapter_write_impl(self, adapters, one, slot):
+        """Write ONE adapter's weight tree into stack slot `slot`
+        across every 'adapters' leaf (the slot axis varies per leaf —
+        scanned layouts carry a leading num_layers axis — so it is
+        resolved structurally at engine construction)."""
+
+        def write(full, leaf, axis):
+            start = [jnp.zeros((), jnp.int32)] * full.ndim
+            start[axis] = slot
+            return jax.lax.dynamic_update_slice(
+                full, jnp.expand_dims(leaf, axis).astype(full.dtype),
+                tuple(start))
+
+        return jax.tree.map(write, adapters, one, self._adapter_axis)
+
+    def _prefill_impl(self, params, tokens, true_len, adapters=None,
+                      aids=None):
         """tokens: (1, bucket) right-padded; returns (logits at token
         true_len-1, a fresh batch-1 cache holding the prompt KV)."""
         cache1 = jax.tree.map(
@@ -1175,14 +1357,15 @@ class ContinuousBatchingEngine:
             jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :],
             tokens.shape)
         logits, mutated = self.model.apply(
-            {'params': params, 'cache': cache1}, tokens, positions,
-            mutable=['cache'])
+            self._variables(params, cache1, adapters), tokens, positions,
+            adapter_ids=aids, mutable=['cache'])
         last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
                                             keepdims=False)
         return last[0], nn.unbox(mutated['cache'])
 
     def _prefill_continue_impl(self, params, cache1, tokens, start_pos,
-                               suffix_true_len):
+                               suffix_true_len, adapters=None,
+                               aids=None):
         """Prefix-cache continuation: `cache1` already holds KV for
         positions [0, start_pos); process the (1, bucket) right-padded
         suffix at positions [start_pos, start_pos+bucket). Positional
@@ -1192,8 +1375,8 @@ class ContinuousBatchingEngine:
             jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :],
             tokens.shape)
         logits, mutated = self.model.apply(
-            {'params': params, 'cache': cache1}, tokens, positions,
-            mutable=['cache'])
+            self._variables(params, cache1, adapters), tokens, positions,
+            adapter_ids=aids, mutable=['cache'])
         last = jax.lax.dynamic_index_in_dim(logits, suffix_true_len - 1,
                                             axis=1, keepdims=False)
         return last[0], nn.unbox(mutated['cache'])
@@ -1218,15 +1401,18 @@ class ContinuousBatchingEngine:
         return jax.tree.map(ins, cache, cache1)
 
     def _decode_impl(self, params, cache, tokens, positions, temps, rng,
-                     tables=None):
+                     tables=None, adapters=None, aids=None):
         """One all-slots decode tick WITH in-jit sampling (one host sync
         per tick instead of one per slot — the difference between ~ms and
         ~100ms ticks over a remote-chip tunnel). tokens/positions:
         (num_slots, 1); temps: (num_slots,) — <=0 means greedy. `tables`
-        (paged mode only): per-row block tables for the shared pool."""
+        (paged mode only): per-row block tables for the shared pool.
+        `aids` (multi-LoRA only): per-slot adapter-slot indices — THE
+        mixed-adapter batching mechanism (one dispatch, many
+        tenants)."""
         logits, mutated = self.model.apply(
-            {'params': params, 'cache': cache}, tokens, positions,
-            block_tables=tables, mutable=['cache'])
+            self._variables(params, cache, adapters), tokens, positions,
+            block_tables=tables, adapter_ids=aids, mutable=['cache'])
         last = logits[:, -1, :].astype(jnp.float32)
         greedy = jnp.argmax(last, axis=-1)
         scaled = apply_logit_filters(
@@ -1237,7 +1423,7 @@ class ContinuousBatchingEngine:
         return out, nn.unbox(mutated['cache'])
 
     def _decode_multi_impl(self, params, cache, tokens, positions, temps,
-                           rngs, tables=None):
+                           rngs, tables=None, adapters=None, aids=None):
         """K all-slots decode steps in one dispatch (K = rngs' leading
         dim): returns ((num_slots, K) tokens, cache). tokens/positions:
         (num_slots,). Paged mode: the engine pre-allocates blocks to
@@ -1248,7 +1434,7 @@ class ContinuousBatchingEngine:
             cache, toks, pos = carry
             out, cache = self._decode_impl(params, cache, toks[:, None],
                                            pos[:, None], temps, rng,
-                                           tables)
+                                           tables, adapters, aids)
             return (cache, out, pos + 1), out
 
         (cache, _, _), toks = jax.lax.scan(
@@ -1256,7 +1442,7 @@ class ContinuousBatchingEngine:
         return toks.swapaxes(0, 1), cache
 
     def _decode_step_impl(self, params, cache, tokens, positions, temps,
-                          rng, tables=None):
+                          rng, tables=None, adapters=None, aids=None):
         """One all-slots step from 1-D feed arrays; returns
         ((num_slots, 1) emit columns, the NEXT step's (tokens,
         positions) feed, cache). The feed is computed in-graph — the
@@ -1269,18 +1455,19 @@ class ContinuousBatchingEngine:
         read."""
         out, cache = self._decode_impl(params, cache, tokens[:, None],
                                        positions[:, None], temps, rng,
-                                       tables)
+                                       tables, adapters, aids)
         out = self._repl_constrain(out)
         return (out[:, None],
                 (out, self._repl_constrain(positions + 1)), cache)
 
     def _decode_multi_feed_impl(self, params, cache, tokens, positions,
-                                temps, rngs, tables=None):
+                                temps, rngs, tables=None, adapters=None,
+                                aids=None):
         """K-step variant of _decode_step_impl (K = rngs' leading dim):
         ((num_slots, K) columns, next feed, cache)."""
         toks, cache = self._decode_multi_impl(params, cache, tokens,
                                               positions, temps, rngs,
-                                              tables)
+                                              tables, adapters, aids)
         toks = self._repl_constrain(toks)
         return toks, (toks[:, -1],
                       self._repl_constrain(positions + rngs.shape[0])), \
@@ -1298,7 +1485,7 @@ class ContinuousBatchingEngine:
         return jax.lax.with_sharding_constraint(x, self._repl)
 
     def _prefill_chunk_impl(self, params, cache, tokens, tables, start,
-                            true_n):
+                            true_n, adapters=None, aids=None):
         """One chunked-prefill step on the PAGED pool: process the
         (1, prefill_chunk) right-padded chunk at positions
         [start, start+chunk) through the slot's block table. The chunk
@@ -1313,8 +1500,8 @@ class ContinuousBatchingEngine:
             jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :],
             tokens.shape)
         logits, mutated = self.model.apply(
-            {'params': params, 'cache': cache}, tokens, positions,
-            block_tables=tables, mutable=['cache'])
+            self._variables(params, cache, adapters), tokens, positions,
+            block_tables=tables, adapter_ids=aids, mutable=['cache'])
         last = jax.lax.dynamic_index_in_dim(logits, true_n - 1, axis=1,
                                             keepdims=False)
         return last[0], nn.unbox(mutated['cache'])
@@ -1337,7 +1524,7 @@ class ContinuousBatchingEngine:
         return jax.tree.map(cp, cache)
 
     def _verify_impl(self, params, cache, tokens, positions, temps, rng,
-                     tables=None):
+                     tables=None, adapters=None, aids=None):
         """Speculative verification: ONE forward over (num_slots, K+1)
         chunks [last_token, draft_1..draft_K] at per-row positions.
 
@@ -1361,8 +1548,8 @@ class ContinuousBatchingEngine:
         back host-side (_trim_blocks) instead of a contiguous cache
         truncation."""
         logits, mutated = self.model.apply(
-            {'params': params, 'cache': cache}, tokens, positions,
-            block_tables=tables, mutable=['cache'])
+            self._variables(params, cache, adapters), tokens, positions,
+            block_tables=tables, adapter_ids=aids, mutable=['cache'])
         logits = logits.astype(jnp.float32)        # (B, K+1, V)
         greedy = jnp.argmax(logits, axis=-1)       # (B, K+1)
         match = tokens[:, 1:] == greedy[:, :-1]    # (B, K) draft hits
@@ -1474,7 +1661,8 @@ class ContinuousBatchingEngine:
             self.params, self._cache,
             _upload(tokens, jnp.int32, self._repl),
             _upload(positions, jnp.int32, self._repl),
-            _upload(temps, jnp.float32, self._repl), rng, tables)
+            _upload(temps, jnp.float32, self._repl), rng, tables,
+            self._adapters, self._aids_for(slots, set(active)))
         self._commit_gen(gen, lambda: setattr(self, '_cache', cache))
         out_cols = _land(out)
         acc = _land(accepted)
@@ -1570,7 +1758,7 @@ class ContinuousBatchingEngine:
             old_work = list(self._engine_work)
             self._engine_work.clear()
             self._slots = [None] * self.num_slots
-            self._queue = queue_lib.Queue()
+            self._queue = tenancy.TierQueue()
             # The wedged thread may hold (or have donated) the old
             # cache mid-dispatch; the successor re-initializes its own.
             self._cache = None
@@ -1587,7 +1775,21 @@ class ContinuousBatchingEngine:
             self._temps_cache = None
             self._table_sig = None
             self._table_cache = None
+            self._aids_sig = None
+            self._aids_cache = None
             self._last_ready = None
+            if self.max_adapters:
+                # Adapter pool resets WHOLESALE: residency/refcounts die
+                # with the generation (the registry of host weights
+                # survives — requests re-load on demand); the device
+                # stack rebuilds zeroed, because the stale thread may
+                # have donated the old one mid-write. Stale releases go
+                # to the old pool object harmlessly.
+                self._adapter_pool = self._adapter_pool.fresh()
+                self._adapters = _zeros_from_shapes(
+                    self._adapter_boxed,
+                    self.mesh if self._tp > 1 else None)
+                _ADAPTER_RESIDENT.set(0)
             if self.paged_block_size:
                 # Fresh pool/prefix objects (not clears): the abandoned
                 # thread keeps mutating ITS objects harmlessly, same
@@ -1629,8 +1831,21 @@ class ContinuousBatchingEngine:
                 break
             self._fail_request(req, err)
 
+    @staticmethod
+    def _release_adapter(req: '_Request') -> None:
+        """Drop the request's adapter pin, exactly once, into the POOL
+        OBJECT the pin was taken against (a wedge recovery swaps the
+        engine's pool; stale releases land in the old object
+        harmlessly)."""
+        pool = req.adapter_pool
+        if pool is not None:
+            req.adapter_pool = None
+            if req.adapter is not None:
+                pool.release(req.adapter)
+
     def _fail_request(self, req: '_Request', exc: BaseException) -> None:
         _REQ_FAILED.inc()
+        self._release_adapter(req)
         if not req.future.done():
             req.future.set_exception(exc)
         self._notify(req, None)
@@ -1653,6 +1868,29 @@ class ContinuousBatchingEngine:
         tracing.record_span('engine.queue_wait', req.submit_time,
                             req.admit_time, parent=req.trace,
                             attrs={'prompt_tokens': len(req.ids)})
+
+    def _note_first_token(self, req: '_Request', slot: int) -> None:
+        """First-token bookkeeping shared by the bucketed and chunked
+        prefill paths: TTFT histograms (global + per-tier), the
+        admission→first-token service EWMA behind deadline-aware
+        admission, and the prefill trace span. A preemption
+        CONTINUATION (first_token_time already set) records nothing —
+        its TTFT was the original one."""
+        if req.first_token_time is not None:
+            return
+        now = time_lib.monotonic()
+        req.first_token_time = now
+        ttft = now - req.submit_time
+        _TTFT_HIST.observe(ttft,
+                           exemplar=req.trace.trace_id
+                           if req.trace is not None else None)
+        _TIER_TTFT_HIST.labels(tier=req.tier).observe(ttft)
+        if req.admit_mono is not None:
+            service = now - req.admit_mono
+            self.ttft_estimate = (
+                service if self.ttft_estimate is None
+                else 0.2 * service + 0.8 * self.ttft_estimate)
+        self._trace_first_token(req, slot)
 
     def _trace_first_token(self, req: '_Request', slot: int) -> None:
         if req.trace is None:
@@ -1826,6 +2064,30 @@ class ContinuousBatchingEngine:
             self._table_sig = sig
         return self._table_cache
 
+    def _aids_for(self, slots, active_set):
+        """Per-slot adapter-slot index vector for an all-slots dispatch
+        (multi-LoRA engines only; None otherwise so adapter-less jit
+        signatures stay unchanged). Cached under a value signature the
+        way temps are — steady-state ticks re-use the device array.
+        Inert rows read slot 0 (the identity); their outputs are never
+        consumed."""
+        if not self.max_adapters:
+            return None
+        sig = tuple(
+            slots[i].adapter_slot
+            if i in active_set and slots[i] is not None else 0
+            for i in range(self.num_slots))
+        if sig != self._aids_sig:
+            self._aids_cache = _upload(list(sig), jnp.int32, self._repl)
+            self._aids_sig = sig
+        return self._aids_cache
+
+    def _aids_single(self, req: '_Request'):
+        """(1,) adapter-index vector for a batch-1 prefill dispatch."""
+        if not self.max_adapters:
+            return None
+        return _upload([req.adapter_slot], jnp.int32, self._repl)
+
     def _admit_paged(self, slot: int, req: '_Request',
                      gen: int = -1) -> None:
         """Paged admission: CHEAP — attach shared prefix blocks
@@ -1838,8 +2100,11 @@ class ContinuousBatchingEngine:
             # must not incref/alloc against its SUCCESSOR's fresh pool
             # (or donate the successor's cache through _cow_fn).
             self._check_gen(gen)
-        plen, entry = (self._longest_cached_prefix(req.ids)
-                       if self.prefix_cache else (0, None))
+        # Adapter requests bypass the prefix cache (adapter-dependent
+        # KV — see _admit); base-model requests share blocks as before.
+        use_prefix = self.prefix_cache and req.adapter_slot == 0
+        plen, entry = (self._longest_cached_prefix(req.context)
+                       if use_prefix else (0, None))
         if plen < self._MIN_PREFIX:
             plen, entry = 0, None
         bs = self.paged_block_size
@@ -1890,7 +2155,7 @@ class ContinuousBatchingEngine:
             if self._prefix_entries.last_key in self._prewarmed_keys:
                 self.prefix_stats['prewarm_hits'] += 1
                 _PREFIX_PREWARM_HIT.inc()
-        elif self.prefix_cache:
+        elif use_prefix:
             self.prefix_stats['misses'] += 1
             _PREFIX_MISS.inc()
         req.blocks = blocks
@@ -1909,14 +2174,16 @@ class ContinuousBatchingEngine:
     def _store_prefix_paged(self, req: '_Request') -> None:
         """Publish the freshly prefilled prompt's blocks as a shared
         prefix: ceil(L/block_size) ref-counted blocks — NOT a full
-        max_seq_len cache (the HBM waste the paged layout removes)."""
-        if not self.prefix_cache:
+        max_seq_len cache (the HBM waste the paged layout removes).
+        Adapter requests never publish (adapter-dependent KV — see
+        _admit)."""
+        if not self.prefix_cache or req.adapter_slot != 0:
             return
-        num = -(-len(req.ids) // self.paged_block_size)
+        num = -(-len(req.context) // self.paged_block_size)
         blocks = list(req.blocks[:num])
         for block in blocks:
             self._pool.incref(block)
-        displaced = self._prefix_entries.put(req.ids, blocks)
+        displaced = self._prefix_entries.put(req.context, blocks)
         for key, old_blocks in displaced:
             self._pool.release(old_blocks)
             # Same prefix re-inserted later by a local prefill must
@@ -1932,7 +2199,7 @@ class ContinuousBatchingEngine:
                               # from a successor's pool
         for slot in prefilling:
             req = slots[slot]
-            total = len(req.ids)
+            total = len(req.context)
             start = req.prefill_pos
             n = min(self.prefill_chunk, total - start)
             try:
@@ -1946,14 +2213,15 @@ class ContinuousBatchingEngine:
                     'KV block pool exhausted mid-prefill; request shed '
                     '(size paged_num_blocks to the load)'))
                 continue
-            chunk = req.ids[start:start + n] + \
+            chunk = req.context[start:start + n] + \
                 [0] * (self.prefill_chunk - n)
             logits, pool_arr = self._prefill_chunk_fn(
                 self.params, self._cache,
                 _upload([chunk], jnp.int32, self._repl),
                 self._table_array([req]),
                 _upload(start, jnp.int32, self._repl),
-                _upload(n, jnp.int32, self._repl))
+                _upload(n, jnp.int32, self._repl),
+                self._adapters, self._aids_single(req))
             self._commit_gen(gen,
                              lambda: setattr(self, '_cache', pool_arr))
             req.prefill_pos = start + n
@@ -1964,16 +2232,160 @@ class ContinuousBatchingEngine:
                 req.prefilling = False
                 self._store_prefix_paged(req)
                 first = self._sample(logits, req.temperature)
-                req.first_token_time = time_lib.monotonic()
-                _TTFT_HIST.observe(req.first_token_time -
-                                   req.submit_time,
-                                   exemplar=req.trace.trace_id
-                                   if req.trace is not None else None)
-                self._trace_first_token(req, slot)
+                self._note_first_token(req, slot)
                 req.tokens.append(first)
                 _TOKENS_TOTAL.inc()
                 self._notify(req, first)
                 req.next_pos = total
+
+    # ------------- multi-LoRA adapter pool (serve/tenancy) -------------
+
+    def _require_adapter_pool(self) -> 'tenancy.AdapterPool':
+        if self._adapter_pool is None:
+            raise exceptions.UnknownAdapterError(
+                'this engine has no adapter pool (serve with '
+                '--max-adapters N)')
+        return self._adapter_pool
+
+    def _validate_adapter_tree(self, tree):
+        """Shape/structure-check one adapter's weight tree against the
+        model's adapter layout (stack leaves minus the slot axis);
+        returns the tree as numpy leaves."""
+
+        class _ShapeMismatch(ValueError):
+            """Our own shape verdict — already self-explanatory, so it
+            passes through the layout-context wrapper below (which
+            exists for jax's raw structure-mismatch errors)."""
+
+        def check(full, axis, leaf):
+            want = full.shape[:axis] + full.shape[axis + 1:]
+            arr = np.asarray(leaf)
+            if tuple(arr.shape) != tuple(want):
+                raise _ShapeMismatch(
+                    f'adapter leaf shape {tuple(arr.shape)} != expected '
+                    f'{tuple(want)}')
+            return arr
+
+        try:
+            return jax.tree.map(check, self._adapter_shapes,
+                                self._adapter_axis, tree)
+        except _ShapeMismatch:
+            raise
+        except Exception as e:
+            raise ValueError(
+                f'adapter tree does not match the model\'s adapter '
+                f'layout (targets {self.cfg.lora_targets!r}, rank '
+                f'{self.cfg.lora_rank}): {e}') from e
+
+    def _ensure_resident(self, name: str, pin: bool) -> int:
+        """Make `name` resident (device write in the tick thread via
+        _run_in_tick — never racing the donation-cycled decode), with
+        `pin` taking a refcount for a request about to queue. Fast-path:
+        an already-resident adapter pins under the pool lock alone."""
+        pool = self._require_adapter_pool()
+        if pin:
+            slot = pool.pin_if_resident(name)
+            if slot is not None:
+                return slot
+
+        def load(gen):
+            t0 = tracing.now() if tracing.enabled() else 0.0
+            # Chaos seam: an armed fault here is a load dying between
+            # acquire and the device write (docs/resilience.md).
+            fault_injection.point('tenant.adapter_load')
+            slot, host, evicted = pool.acquire_for_load(name, pin=pin)
+            try:
+                if evicted is not None:
+                    # LRU victim left residency to free this slot.
+                    fault_injection.point('tenant.evict')
+                    _ADAPTER_EVICTIONS.inc()
+                if host is not None:
+                    one = jax.tree.map(
+                        lambda leaf: _upload(leaf, None, self._repl),
+                        host)
+                    new = self._adapter_write(
+                        self._adapters, one,
+                        _upload(slot, jnp.int32, self._repl))
+                    self._commit_gen(
+                        gen, lambda: setattr(self, '_adapters', new))
+                    _ADAPTER_LOADS.inc()
+            except BaseException:
+                # The residency map must never claim weights that did
+                # not land (and a failed load must not leak its pin):
+                # roll back, then surface the error. On a stale-
+                # generation abort `pool` may already be the OLD
+                # object — rolling it back is harmless.
+                if host is not None:
+                    pool.abort_load(name, pinned=pin)
+                raise
+            _ADAPTER_RESIDENT.set(len(pool.resident_names()))
+            if tracing.enabled():
+                tracing.record_span(
+                    'engine.adapter_load', t0, tracing.now(),
+                    attrs={'adapter': name, 'slot': slot,
+                           'evicted': evicted or '',
+                           'written': host is not None})
+            return slot
+
+        return self._run_in_tick(load)
+
+    def load_adapter(self, name: str, adapter_tree) -> int:
+        """Register one adapter's weight tree (lora_a/lora_b leaves in
+        models/lora layout — tenancy.adapter_tree_from_lora_params
+        extracts it from an unmerged LoRA param tree) and make it
+        resident. Returns the device slot. Raises
+        AdapterPoolExhaustedError when every slot is pinned (the server
+        sheds retryably)."""
+        pool = self._require_adapter_pool()
+        tenancy.validate_adapter_name(name)
+        host = self._validate_adapter_tree(adapter_tree)
+        pool.register(name, host)
+        try:
+            return self._ensure_resident(name, pin=False)
+        except exceptions.AdapterPoolExhaustedError:
+            _ADAPTER_SHED.inc()
+            self.tenancy_stats['adapter_sheds'] += 1
+            raise
+
+    def unload_adapter(self, name: str) -> None:
+        """Unregister an adapter. Refuses (AdapterInUseError → HTTP
+        409) while in-flight requests pin it. The vacated device slot
+        is NOT zeroed — nothing references it until a later load
+        overwrites it."""
+        pool = self._require_adapter_pool()
+
+        def drop(gen):
+            del gen
+            # The explicit-evict chaos seam (docs/resilience.md).
+            fault_injection.point('tenant.evict')
+            pool.unregister(name)
+            _ADAPTER_RESIDENT.set(len(pool.resident_names()))
+            return True
+
+        self._run_in_tick(drop)
+
+    def adapters_info(self) -> Dict[str, Any]:
+        """Registry/residency snapshot for GET /adapters, /health and
+        `serve status` (ADAPTERS column)."""
+        if self._adapter_pool is None:
+            return {'capacity': 0, 'resident': 0, 'adapters': []}
+        info = self._adapter_pool.info()
+        return {
+            'capacity': self.max_adapters,
+            'resident': sum(1 for a in info if a['resident']),
+            'adapters': info,
+            'stats': dict(self._adapter_pool.stats),
+        }
+
+    def tier_load(self) -> Dict[str, int]:
+        """Per-SLO-tier load (queued + slotted) — the X-SkyTPU-Tier-
+        Load header value the LB's tier-aware routing reads."""
+        depths = self._queue.tier_depths()
+        for req in self._slots:
+            if req is not None:
+                tier = req.tier if req.tier in depths else 'standard'
+                depths[tier] += 1
+        return depths
 
     def queue_load(self) -> int:
         """Requests this engine is holding right now: queued awaiting
@@ -2719,52 +3131,61 @@ class ContinuousBatchingEngine:
                 'key_tokens': len(key)}
 
     def _admit(self, slot: int, req: '_Request', gen: int = -1) -> None:
+        req.admit_mono = time_lib.monotonic()
         self._trace_admitted(req)
         if self.paged_block_size:
             self._admit_paged(slot, req, gen)
             return
-        true_len = len(req.ids)
-        plen, pcache = (self._longest_cached_prefix(req.ids)
-                        if self.prefix_cache else (0, None))
+        # `context` == ids, except for a preemption continuation where
+        # the already-generated tokens fold in (prefill resumes the
+        # stream exactly where the preempted slot stopped).
+        context = req.context
+        true_len = len(context)
+        # Adapter requests bypass the prefix cache: cached KV was
+        # computed under SOME adapter's k/v projections (v is a default
+        # LoRA target), so sharing it across adapter identities would
+        # silently break per-adapter bit-identity. Base-model requests
+        # (slot 0) keep the full prefix-cache behavior.
+        use_prefix = self.prefix_cache and req.adapter_slot == 0
+        plen, pcache = (self._longest_cached_prefix(context)
+                        if use_prefix else (0, None))
         if plen >= self._MIN_PREFIX and \
                 plen + self._bucket(true_len - plen) <= \
                 self.cfg.max_seq_len:
             # Continue from the cached prefix: only the suffix prefills.
-            suffix = req.ids[plen:]
+            suffix = context[plen:]
             bucket = self._bucket(len(suffix))
             tokens = _upload([suffix + [0] * (bucket - len(suffix))],
                              jnp.int32, self._repl)
             logits, cache1 = self._prefill_continue(
                 self.params, pcache, tokens,
                 _upload(plen, jnp.int32, self._repl),
-                _upload(len(suffix), jnp.int32, self._repl))
+                _upload(len(suffix), jnp.int32, self._repl),
+                self._adapters, self._aids_single(req))
             self.prefix_stats['hits'] += 1
             self.prefix_stats['tokens_reused'] += plen
             _PREFIX_HIT.inc()
             _PREFIX_TOKENS.inc(plen)
         else:
             bucket = self._bucket(true_len)
-            padded = req.ids + [0] * (bucket - true_len)
+            padded = context + [0] * (bucket - true_len)
             tokens = _upload([padded], jnp.int32, self._repl)
             logits, cache1 = self._prefill(
                 self.params, tokens,
-                _upload(true_len, jnp.int32, self._repl))
-            if self.prefix_cache:
+                _upload(true_len, jnp.int32, self._repl),
+                self._adapters, self._aids_single(req))
+            if use_prefix:
                 self.prefix_stats['misses'] += 1
                 _PREFIX_MISS.inc()
         if gen >= 0:
             self._check_gen(gen)
-        if self.prefix_cache:
+        if use_prefix:
             # The full prompt's KV is the entry future prompts extend
             # (chat turns append); cache1 is not donated anywhere, so
             # holding it is safe.
-            self._store_prefix(req.ids, cache1)
+            self._store_prefix(context, cache1)
         first = self._sample(logits, req.temperature)
-        req.first_token_time = time_lib.monotonic()
-        _TTFT_HIST.observe(req.first_token_time - req.submit_time,
-                           exemplar=req.trace.trace_id
-                           if req.trace is not None else None)
-        self._trace_first_token(req, slot)
+        self._note_first_token(req, slot)
         req.tokens.append(first)
         _TOKENS_TOTAL.inc()  # the first token lands here, not in _emit
         self._notify(req, first)
@@ -2799,6 +3220,9 @@ class ContinuousBatchingEngine:
         # Paged: return block refs; blocks shared with a prefix entry
         # stay alive (refcount > 0), private suffix blocks free now.
         self._release_blocks(req)
+        # The adapter pin drops with the request: a refcount-0 resident
+        # becomes an eviction candidate again.
+        self._release_adapter(req)
         now = time_lib.monotonic()
         stats = {
             'ttft_s': req.first_token_time - req.submit_time,
@@ -2893,6 +3317,18 @@ class ContinuousBatchingEngine:
                         _DISPATCH_AHEAD.set(0)
                         self._feed = None
                         self._last_ready = None
+                        self._aids_sig = None
+                        self._aids_cache = None
+                        if self.max_adapters:
+                            # Same wholesale reset as wedge recovery:
+                            # the failed tick's residency bookkeeping
+                            # is untrusted.
+                            self._adapter_pool = \
+                                self._adapter_pool.fresh()
+                            self._adapters = _zeros_from_shapes(
+                                self._adapter_boxed,
+                                self.mesh if self._tp > 1 else None)
+                            _ADAPTER_RESIDENT.set(0)
                         if self.paged_block_size:
                             # Fresh pool + prefix index: the failed
                             # tick's block bookkeeping is untrusted.
@@ -2947,6 +3383,7 @@ class ContinuousBatchingEngine:
             if req.future.cancelled():
                 slots[slot] = None
                 self._release_blocks(req)
+                self._release_adapter(req)
                 self._notify(req, None)
             elif req.deadline is not None and now > req.deadline:
                 slots[slot] = None
@@ -2982,6 +3419,7 @@ class ContinuousBatchingEngine:
                     queue.queue.extend(kept)
             for req in dead:
                 if req.future.cancelled():
+                    self._release_adapter(req)
                     self._notify(req, None)
                 else:
                     self._fail_request(
@@ -2989,6 +3427,47 @@ class ContinuousBatchingEngine:
                         exceptions.RequestDeadlineExceededError(
                             f'request expired in the admission queue '
                             f'after {mono_now - req.submit_time:.1f}s'))
+        # SLO preemption (docs/serving.md "Multi-tenant serving"): an
+        # interactive arrival that would otherwise wait takes a
+        # batch-tier slot NOW. The batch request re-queues RETRYABLY at
+        # the head of its tier — blocks released, context folded to
+        # ids+tokens — and CONTINUES from its generated tokens on
+        # re-admission, so greedy output is bit-identical to the
+        # uninterrupted stream and nothing is lost non-retryably.
+        if not queue.empty():
+            waiting = queue.tier_depths().get('interactive', 0)
+            if waiting:
+                free = sum(1 for r in slots if r is None)
+                need = waiting - free
+                for slot in range(self.num_slots - 1, -1, -1):
+                    if need <= 0:
+                        break
+                    req = slots[slot]
+                    if req is None or req.tier != 'batch':
+                        continue
+                    # Chaos seam: an armed fault here is the preemption
+                    # path itself failing — the tick-failure handler
+                    # fails in-flight work cleanly (docs/resilience.md).
+                    fault_injection.point('engine.slot_preempt')
+                    t_pre = (tracing.now() if req.trace is not None
+                             else 0.0)
+                    slots[slot] = None
+                    self._release_blocks(req)
+                    req.prefilling = False
+                    req.prefill_pos = 0
+                    req.next_pos = 0
+                    req.preemptions += 1
+                    req.context = req.ids + req.tokens
+                    self.tenancy_stats['slot_preempts'] += 1
+                    _SLOT_PREEMPTS.inc()
+                    if req.trace is not None:
+                        tracing.record_span(
+                            'engine.slot_preempt', t_pre, tracing.now(),
+                            parent=req.trace,
+                            attrs={'slot': slot,
+                                   'tokens_done': len(req.tokens)})
+                    queue.requeue_front(req)
+                    need -= 1
         # Admit new requests into free slots (between ticks — this is
         # the "continuous" in continuous batching). Requests that
         # expired or were cancelled while queued are dropped, not
@@ -3000,6 +3479,7 @@ class ContinuousBatchingEngine:
                 except Exception:  # pylint: disable=broad-except
                     break
                 if req.future.cancelled():
+                    self._release_adapter(req)
                     self._notify(req, None)
                     continue
                 if req.deadline is not None and now > req.deadline:
@@ -3075,6 +3555,14 @@ class ContinuousBatchingEngine:
         # behind the enabled-check).
         _ACTIVE_SLOTS.set(len(active))
         _QUEUE_DEPTH.set(queue.qsize())
+        if obs.enabled():
+            # Per-tier ADMISSION-QUEUE depth (matching the global
+            # skytpu_engine_queue_depth semantics — slotted requests
+            # are _ACTIVE_SLOTS' business); costs a queue scan, so
+            # behind the exporter check.
+            for tier_name, depth in \
+                    self._queue.tier_depths().items():
+                _TIER_QUEUE_DEPTH.labels(tier=tier_name).set(depth)
         # Re-set every tick, not only at construction/probe: the
         # exporter typically enables AFTER warmup, and a gauge set
         # while recording is disabled is a no-op. Unconditional so a
@@ -3295,16 +3783,17 @@ class ContinuousBatchingEngine:
                                   jnp.int32, self._repl)
             gap = (time_lib.monotonic() - self._last_ready
                    if self._last_ready is not None else None)
+        aids = self._aids_for(slots, active_set)
         self._rng, rng = jax.random.split(self._rng)
         if k == 1:
             out_cols, feed_next, cache = self._decode(
                 self.params, self._cache, tok_dev, pos_dev, temps, rng,
-                tables)
+                tables, self._adapters, aids)
         else:
             rngs = jax.random.split(rng, k)
             out_cols, feed_next, cache = self._decode_multi(
                 self.params, self._cache, tok_dev, pos_dev, temps,
-                rngs, tables)
+                rngs, tables, self._adapters, aids)
         self._commit_gen(gen, lambda: setattr(self, '_cache', cache))
         self._decode_steps += k
         self.step_log.append((self._decode_steps, frozenset(active)))
@@ -3431,7 +3920,9 @@ class ContinuousBatchingEngine:
                temperature: float = 0.0,
                eos_id: Optional[int] = None,
                on_token=None,
-               deadline: Optional[float] = None):
+               deadline: Optional[float] = None,
+               adapter: Optional[str] = None,
+               priority: str = 'standard'):
         """Enqueue one request; returns a concurrent.futures.Future that
         resolves to (token_ids, stats). `on_token` (optional) is called
         from the engine thread with each token as it lands and once with
@@ -3440,10 +3931,21 @@ class ContinuousBatchingEngine:
         RequestDeadlineExceededError once passed, whether it is still
         queued or mid-decode.
 
+        Multi-tenant serving (docs/serving.md): `adapter` names a
+        registered LoRA adapter — the request decodes through that
+        adapter's slot IN THE SAME dispatch as other adapters' and
+        base-model requests; the adapter is pinned (never evicted)
+        until the request resolves. `priority` is the SLO tier
+        ('interactive'/'standard'/'batch'): interactive admits first
+        and may preempt batch slots; with a `deadline` the request is
+        shed AT SUBMIT (TierDeadlineUnmeetableError → 429+Retry-After)
+        when the current queue depth makes the deadline unmeetable.
+
         Admission control: while draining, or with max_queue_depth
         exceeded, raises EngineDrainingError/EngineOverloadedError
         instead of queueing — callers shed load at the edge."""
         import concurrent.futures
+        tier = tenancy.validate_tier(priority)
         if self._draining:
             _REJECT_DRAINING.inc()
             raise exceptions.EngineDrainingError(
@@ -3468,9 +3970,50 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f'{len(ids)}+{max_new_tokens} exceeds max_seq_len '
                 f'{self.cfg.max_seq_len}')
+        # Deadline-aware admission (per tier): shed NOW when the queue
+        # ahead of this request makes its deadline unmeetable — a
+        # retryable 429 at submit beats occupying queue capacity only
+        # to be killed mid-wait. Estimate = waves of same-or-higher-
+        # priority backlog × the admission→first-token service EWMA
+        # (None until the first completion: never shed on a guess).
+        if deadline is not None and self.ttft_estimate:
+            ahead = self._queue.depth_at_or_above(tier)
+            free = sum(1 for r in self._slots if r is None)
+            backlog = ahead - free
+            # Only a real backlog sheds: an unmeetable deadline on an
+            # IDLE engine is the client's problem, not a load
+            # condition — it admits and fails 504 through the normal
+            # deadline machinery (pre-existing contract).
+            projected = (tenancy.projected_wait(
+                backlog, self.num_slots, self.ttft_estimate)
+                if backlog > 0 else 0.0)
+            if backlog > 0 and time_lib.time() + projected > deadline:
+                _TIER_DEADLINE_SHED.labels(tier=tier).inc()
+                self.tenancy_stats['deadline_sheds'] += 1
+                raise exceptions.TierDeadlineUnmeetableError(
+                    f'{tier} deadline unmeetable at current queue '
+                    f'depth ({ahead} ahead, projected '
+                    f'{projected:.2f}s); retry later')
+        if tier != 'standard':
+            # Flips the server's X-SkyTPU-Tier-Load header on: the
+            # per-response tier scan is only worth paying once tiered
+            # traffic actually exists (see server._fleet_intel_headers).
+            self._tiers_active = True
+        adapter_slot, pinned_pool = 0, None
+        if adapter is not None:
+            try:
+                adapter_slot = self._ensure_resident(adapter, pin=True)
+            except exceptions.AdapterPoolExhaustedError:
+                _ADAPTER_SHED.inc()
+                self.tenancy_stats['adapter_sheds'] += 1
+                raise
+            pinned_pool = self._adapter_pool
+        _TIER_REQUESTS.labels(tier=tier).inc()
         future: 'concurrent.futures.Future' = concurrent.futures.Future()
         req = _Request(ids, max_new_tokens, temperature, eos_id, future,
-                       on_token=on_token, deadline=deadline)
+                       on_token=on_token, deadline=deadline, tier=tier,
+                       adapter=adapter, adapter_slot=adapter_slot,
+                       adapter_pool=pinned_pool)
         if tracing.enabled():
             # One enabled-check; the ambient context (the server's
             # request span, or an activate()d handoff context) becomes
@@ -3487,6 +4030,7 @@ class ContinuousBatchingEngine:
         with self._thread_lock:
             if self._draining:
                 _REJECT_DRAINING.inc()
+                self._release_adapter(req)
                 raise exceptions.EngineDrainingError(
                     'engine is draining for shutdown; not accepting '
                     'new requests')
@@ -3499,10 +4043,13 @@ class ContinuousBatchingEngine:
     def generate(self, prompt_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0,
                  eos_id: Optional[int] = None,
-                 timeout: Optional[float] = 300.0):
+                 timeout: Optional[float] = 300.0,
+                 adapter: Optional[str] = None,
+                 priority: str = 'standard'):
         """Blocking convenience wrapper around submit()."""
         return self.submit(prompt_ids, max_new_tokens, temperature,
-                           eos_id).result(timeout=timeout)
+                           eos_id, adapter=adapter,
+                           priority=priority).result(timeout=timeout)
 
     def measure_ttft(self, num_requests: int, prompt,
                      max_new_tokens: int = 16,

@@ -161,6 +161,109 @@ class LoRADenseGeneral(nn.Module):
         return y
 
 
+class MultiLoRADenseGeneral(nn.Module):
+    """Multi-tenant serving twin of LoRADenseGeneral: one base matmul
+    plus a PER-ROW low-rank delta gathered from a resident adapter
+    stack — y[b] = W·x[b] + (alpha/r)·B[id_b](A[id_b](x[b])).
+
+    Base params keep nn.DenseGeneral's exact names/shapes in this
+    module's scope ('kernel'/'bias'), so plain (lora-free) checkpoints
+    line up unchanged. The adapter stacks live in the separate
+    'adapters' variable collection — NOT 'params' — as
+    (serve_adapters+1, *in, r) 'lora_a' and (serve_adapters+1, r, *out)
+    'lora_b' leaves (a leading scanned-layers axis stacks on top under
+    nn.scan). Slot 0 is the all-zero identity: a base-model request
+    contributes an exactly-zero delta and rides the same compiled
+    kernel as every adapter request — that is what lets one decode
+    dispatch batch requests for DIFFERENT adapters (the engine feeds a
+    per-slot adapter-index vector; models/inference.py owns slot
+    residency/LRU/refcounts via serve/tenancy.AdapterPool).
+
+    Numerics contract (pinned by tests/test_multitenant.py): the base
+    matmul and the two low-rank matmuls use EXACTLY LoRADenseGeneral's
+    op order — the gather only adds a batch dimension to the same
+    contractions — so each row's greedy output is bit-identical to a
+    dedicated single-adapter (or base) engine.
+    """
+    cfg: ModelConfig
+    features: Any                 # int or tuple
+    kernel_axes: Tuple[str, ...]
+    axis: Any = -1                # int or tuple: contracted input dims
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array,
+                 adapter_ids: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.cfg
+        features = (self.features if isinstance(self.features, tuple)
+                    else (self.features,))
+        axis = (self.axis if isinstance(self.axis, tuple)
+                else (self.axis,))
+        axis = tuple(a % x.ndim for a in axis)
+        in_shape = tuple(x.shape[a] for a in axis)
+        n_in = len(in_shape)
+        contract = ((axis, tuple(range(n_in))), ((), ()))
+        kernel = self.param(
+            'kernel',
+            nn.with_logical_partitioning(nn.initializers.lecun_normal(),
+                                         self.kernel_axes),
+            in_shape + features, _param_dtype(cfg))
+        y = jax.lax.dot_general(x, kernel.astype(_dtype(cfg)), contract)
+        r = cfg.lora_rank
+        slots = cfg.serve_adapters + 1
+        # Replicated on any mesh: adapters are tiny (rank·dims per
+        # slot) next to the weights; the per-row gather then needs no
+        # collectives.
+        lora_a = self.variable(
+            'adapters', 'lora_a',
+            lambda: nn.with_logical_partitioning(
+                jnp.zeros, (None,) * (n_in + 2))(
+                    (slots,) + in_shape + (r,), _param_dtype(cfg)))
+        lora_b = self.variable(
+            'adapters', 'lora_b',
+            lambda: nn.with_logical_partitioning(
+                jnp.zeros, (None,) * (len(features) + 2))(
+                    (slots, r) + features, _param_dtype(cfg)))
+
+        def unboxed(var):
+            box = var.value
+            return box.unbox() if hasattr(box, 'unbox') else box
+
+        a_arr = unboxed(lora_a)
+        b_arr = unboxed(lora_b)
+        if adapter_ids is None:
+            # init / adapter-less callers: every row is the identity.
+            adapter_ids = jnp.zeros((x.shape[0],), jnp.int32)
+        a_sel = jnp.take(a_arr, adapter_ids, axis=0)   # (B, *in, r)
+        b_sel = jnp.take(b_arr, adapter_ids, axis=0)   # (B, r, *out)
+        z = jax.lax.dot_general(
+            x, a_sel.astype(_dtype(cfg)),
+            ((axis, tuple(range(1, n_in + 1))), ((0,), (0,))))
+        z = jax.lax.dot_general(
+            z, b_sel.astype(_dtype(cfg)),
+            (((z.ndim - 1,), (1,)), ((0,), (0,))))
+        y = y + z * (cfg.lora_alpha / r)
+        if self.use_bias:
+            bias = self.param(
+                'bias',
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros,
+                    self.kernel_axes[len(in_shape):]),
+                features, _param_dtype(cfg))
+            y = y + bias.astype(_dtype(cfg))
+        return y
+
+
+def _apply_proj(module: nn.Module, x: jax.Array,
+                adapter_ids: Optional[jax.Array]) -> jax.Array:
+    """Call a dense_general-produced projection, routing the per-row
+    adapter indices only into the multi-LoRA variant (the other dense
+    flavors take just x)."""
+    if isinstance(module, MultiLoRADenseGeneral):
+        return module(x, adapter_ids)
+    return module(x)
+
+
 def lora_target_names(cfg: ModelConfig) -> Tuple[str, ...]:
     """'q,v' → ('q_proj', 'v_proj'); validates the token set."""
     valid = ('q', 'k', 'v', 'o', 'gate', 'up', 'down')
@@ -184,6 +287,19 @@ def dense_general(cfg: ModelConfig, features, kernel_axes, name: str,
     cfg.lora_rank > 0 targets this projection — same module name and
     base-param paths in every case, so checkpoints/from_hf line up and
     quantize_params stays a leaf rewrite."""
+    if cfg.serve_adapters > 0 and name in lora_target_names(cfg):
+        # Multi-tenant serving: base params stay nn.DenseGeneral's, the
+        # resident adapter stacks live in the 'adapters' collection.
+        if cfg.weight_quant == 'int8':
+            raise NotImplementedError(
+                'multi-LoRA serving composes with int8 KV, not int8 '
+                'WEIGHTS: the adapter delta applies to the float base '
+                'projection (serve unquantized, or merge+quantize a '
+                'single adapter)')
+        return MultiLoRADenseGeneral(cfg, features=features,
+                                     kernel_axes=tuple(kernel_axes),
+                                     axis=axis, use_bias=use_bias,
+                                     name=name)
     if cfg.lora_rank > 0 and name in lora_target_names(cfg):
         if cfg.weight_quant == 'int8':
             raise NotImplementedError(
@@ -304,16 +420,20 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
-                 block_tables: Optional[jax.Array] = None) -> jax.Array:
+                 block_tables: Optional[jax.Array] = None,
+                 adapter_ids: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         dense = lambda feats, axes, name: dense_general(
             cfg, feats, axes, name, use_bias=cfg.qkv_bias)
-        q = dense((cfg.num_heads, cfg.head_dim),
-                  ('embed', 'heads', 'qkv_dim'), 'q_proj')(x)
-        k = dense((cfg.num_kv_heads, cfg.head_dim),
-                  ('embed', 'kv_heads', 'qkv_dim'), 'k_proj')(x)
-        v = dense((cfg.num_kv_heads, cfg.head_dim),
-                  ('embed', 'kv_heads', 'qkv_dim'), 'v_proj')(x)
+        q = _apply_proj(dense((cfg.num_heads, cfg.head_dim),
+                              ('embed', 'heads', 'qkv_dim'), 'q_proj'),
+                        x, adapter_ids)
+        k = _apply_proj(dense((cfg.num_kv_heads, cfg.head_dim),
+                              ('embed', 'kv_heads', 'qkv_dim'),
+                              'k_proj'), x, adapter_ids)
+        v = _apply_proj(dense((cfg.num_kv_heads, cfg.head_dim),
+                              ('embed', 'kv_heads', 'qkv_dim'),
+                              'v_proj'), x, adapter_ids)
         if cfg.qkv_clip:
             # DBRX clip_qkv: clamp projections to ±clip (training
             # stability; must match at inference for logit parity).
@@ -349,9 +469,11 @@ class Attention(nn.Module):
                                   impl=cfg.attention_impl,
                                   logit_softcap=cfg.attn_logit_softcap,
                                   window=cfg.sliding_window, **block_kw)
-        out = dense_general(cfg, cfg.d_model,
-                            ('heads', 'qkv_dim', 'embed'), 'o_proj',
-                            axis=(-2, -1), use_bias=cfg.o_bias)(out)
+        out = _apply_proj(
+            dense_general(cfg, cfg.d_model,
+                          ('heads', 'qkv_dim', 'embed'), 'o_proj',
+                          axis=(-2, -1), use_bias=cfg.o_bias),
+            out, adapter_ids)
         return sharding.constrain(out, 'batch', 'seq', 'act_embed')
 
     def _decode_attention(self, q: jax.Array, k: jax.Array,
@@ -686,20 +808,25 @@ class SwiGLU(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array,
+                 adapter_ids: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         act = nn.silu if cfg.mlp_activation == 'silu' else (
             lambda y: nn.gelu(y, approximate=True))
         dense = lambda feats, axes, name: dense_general(
             cfg, feats, axes, name, use_bias=cfg.mlp_bias)
-        up = dense(cfg.d_mlp, ('embed', 'mlp'), 'up_proj')(x)
+        up = _apply_proj(dense(cfg.d_mlp, ('embed', 'mlp'), 'up_proj'),
+                         x, adapter_ids)
         if cfg.mlp_style == 'glu':
-            gate = dense(cfg.d_mlp, ('embed', 'mlp'), 'gate_proj')(x)
+            gate = _apply_proj(
+                dense(cfg.d_mlp, ('embed', 'mlp'), 'gate_proj'),
+                x, adapter_ids)
             h = act(gate) * up
         else:
             h = act(up)
         h = sharding.constrain(h, 'batch', 'seq', 'mlp')
-        out = dense(cfg.d_model, ('mlp', 'embed'), 'down_proj')(h)
+        out = _apply_proj(dense(cfg.d_model, ('mlp', 'embed'),
+                                'down_proj'), h, adapter_ids)
         return sharding.constrain(out, 'batch', 'seq', 'act_embed')
 
 
@@ -709,7 +836,8 @@ class DecoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array,
                  positions: jax.Array,
-                 block_tables: Optional[jax.Array] = None) -> jax.Array:
+                 block_tables: Optional[jax.Array] = None,
+                 adapter_ids: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         h = RMSNorm(cfg, name='attn_norm')(x)
         if cfg.parallel_block:
@@ -722,15 +850,17 @@ class DecoderLayer(nn.Module):
             # in a single step — the two matmul chains are independent,
             # so XLA overlaps them freely.
             return (x + Attention(cfg, name='attn')(h, positions,
-                                                    block_tables)
-                    + SwiGLU(cfg, name='mlp')(h))
-        x = x + Attention(cfg, name='attn')(h, positions, block_tables)
+                                                    block_tables,
+                                                    adapter_ids)
+                    + SwiGLU(cfg, name='mlp')(h, adapter_ids))
+        x = x + Attention(cfg, name='attn')(h, positions, block_tables,
+                                            adapter_ids)
         h = RMSNorm(cfg, name='mlp_norm')(x)
         if cfg.is_moe:
             from skypilot_tpu.models.moe import MoEBlock
             x = x + MoEBlock(cfg, name='moe')(h)
         else:
-            x = x + SwiGLU(cfg, name='mlp')(h)
+            x = x + SwiGLU(cfg, name='mlp')(h, adapter_ids)
         return x
 
 
@@ -741,10 +871,11 @@ class _ScannedLayer(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, positions, block_tables = carry
+        x, positions, block_tables, adapter_ids = carry
         x = DecoderLayer(self.cfg, name='layer')(x, positions,
-                                                 block_tables)
-        return (x, positions, block_tables), None
+                                                 block_tables,
+                                                 adapter_ids)
+        return (x, positions, block_tables, adapter_ids), None
 
 
 class Transformer(nn.Module):
@@ -754,7 +885,8 @@ class Transformer(nn.Module):
     def __call__(self, tokens: jax.Array,
                  positions: Optional[jax.Array] = None,
                  mode: str = 'full',
-                 block_tables: Optional[jax.Array] = None) -> jax.Array:
+                 block_tables: Optional[jax.Array] = None,
+                 adapter_ids: Optional[jax.Array] = None) -> jax.Array:
         """mode: 'full' (tokens → logits, the normal path), or the two
         halves the pipeline executor (parallel/pipeline.py) sandwiches
         around its microbatched layer schedule — 'embed' (tokens →
@@ -800,14 +932,19 @@ class Transformer(nn.Module):
             if cfg.remat:
                 layer_cls = nn.remat(layer_cls, prevent_cse=False,
                                      policy=checkpoint_policy_for(cfg))
+            variable_axes = {'params': 0, 'cache': 0}
+            if cfg.serve_adapters > 0:
+                # Per-layer adapter stacks scan exactly like params.
+                variable_axes['adapters'] = 0
             scanned = nn.scan(
                 layer_cls,
-                variable_axes={'params': 0, 'cache': 0},
+                variable_axes=variable_axes,
                 split_rngs={'params': True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: 'layers'},
             )(cfg, name='layers')
-            (x, _, _), _ = scanned((x, positions, block_tables), None)
+            (x, _, _, _), _ = scanned(
+                (x, positions, block_tables, adapter_ids), None)
         else:
             # Remat is an execution knob: the param tree keys must not
             # depend on it (checkpoint compatibility).
@@ -815,7 +952,8 @@ class Transformer(nn.Module):
                           if cfg.remat else DecoderLayer)
             for i in range(cfg.num_layers):
                 x = layer_ctor(cfg, name=f'layer_{i}')(x, positions,
-                                                       block_tables)
+                                                       block_tables,
+                                                       adapter_ids)
 
         return self._head(embed, x)
 

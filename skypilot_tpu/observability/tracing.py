@@ -87,6 +87,8 @@ KNOWN_SPANS = (
     'engine.wedge_recovery',  # watchdog recovery (flight-record trigger)
     'engine.tick_failure',   # tick exception recovery (flight-record trigger)
     'engine.preempt_export',  # preemption-notice prefix export
+    'engine.adapter_load',   # adapter made resident (tick thread, slot attr)
+    'engine.slot_preempt',   # batch slot yielded to an interactive arrival
 )
 
 # Tracing metrics (docs/observability.md).

@@ -361,6 +361,14 @@ class MetricsAutoscaler(RequestRateAutoscaler):
             is not None else constants.target_queue_depth_per_replica())
         self.target_ttft_s = getattr(spec, 'target_ttft_seconds', None)
         self.target_tpot_s = getattr(spec, 'target_tpot_seconds', None)
+        # Per-SLO-tier TTFT targets (docs/serving.md "Multi-tenant
+        # serving"): pressure is computed per tier from the replicas'
+        # skytpu_engine_tier_ttft_seconds signals (scrape key
+        # 'ttft_s_<tier>'), so an interactive SLO breach under a
+        # batch flood grows the fleet even while the global mean
+        # TTFT looks healthy.
+        self.tier_ttft_targets = dict(
+            getattr(spec, 'target_ttft_seconds_per_tier', None) or {})
 
     def update_spec(self, spec: 'spec_lib.SkyServiceSpec') -> None:
         super().update_spec(spec)
@@ -399,6 +407,10 @@ class MetricsAutoscaler(RequestRateAutoscaler):
         tpot = mean_of('tpot_s')
         if tpot is not None and self.target_tpot_s:
             ratios.append(tpot / self.target_tpot_s)
+        for tier, target in sorted(self.tier_ttft_targets.items()):
+            tier_ttft = mean_of(f'ttft_s_{tier}')
+            if tier_ttft is not None and target:
+                ratios.append(tier_ttft / target)
         return max(ratios) if ratios else None
 
     def evaluate_scaling(

@@ -394,8 +394,17 @@ class SkyServeLoadBalancer:
                 prompt_len = len(ids)
             if ids is None and prompt_len is None:
                 return None
-            return {'token_ids': ids, 'prompt_len': prompt_len,
+            hint = {'token_ids': ids, 'prompt_len': prompt_len,
                     'ids_exact': ids_exact}
+            # Multi-tenant fields ride the hint for adapter-affinity
+            # and tier-aware routing (advisory, like everything here).
+            adapter = data.get('adapter')
+            if isinstance(adapter, str) and adapter:
+                hint['adapter'] = adapter
+            priority = data.get('priority')
+            if priority in ('interactive', 'standard', 'batch'):
+                hint['tier'] = priority
+            return hint
         except Exception:  # pylint: disable=broad-except
             return None
 
@@ -481,7 +490,8 @@ class SkyServeLoadBalancer:
                                     parent=root.ctx, attrs=attrs)
             if replica_url is None:
                 break
-            if result in ('hit', 'miss', 'stale', 'fallback'):
+            if result in ('hit', 'miss', 'stale', 'fallback',
+                          'adapter_pin'):
                 _ROUTE_TOTAL.labels(result=result).inc()
             if route_info.get('phase'):
                 _PHASE_TOTAL.labels(phase=route_info['phase']).inc()

@@ -147,10 +147,17 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
         # Unknown defaults to byte (the in-tree default; an HF fleet
         # advertises itself on its first response).
         self._tokenizers: Dict[str, str] = {}
+        # Multi-tenant intel (docs/serving.md "Multi-tenant serving"):
+        # url → resident adapter names (X-SkyTPU-Adapters) for
+        # adapter-affinity routing, and url → per-tier queue depths
+        # (X-SkyTPU-Tier-Load) for tier-aware least-loaded.
+        self._adapters: Dict[str, Set[str]] = {}
+        self._tier_loads: Dict[str, Dict[str, int]] = {}
         self.stats = {'hit': 0, 'miss': 0, 'stale': 0, 'fallback': 0,
                       'digest_rejected': 0, 'phase_prefill': 0,
                       'phase_decode': 0, 'handoff': 0,
-                      'tier_decode': 0, 'handoff_skipped_tokenizer': 0}
+                      'tier_decode': 0, 'handoff_skipped_tokenizer': 0,
+                      'adapter_pin': 0, 'adapter_pool': 0}
 
     # ---------------- membership / phase partition ----------------
 
@@ -160,7 +167,8 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
             known = set(urls)
             for table in (self._digests, self._depths,
                           self._outstanding, self._tiers,
-                          self._tokenizers):
+                          self._tokenizers, self._adapters,
+                          self._tier_loads):
                 for url in list(table):
                     if url not in known:
                         del table[url]
@@ -194,16 +202,29 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
     # ---------------- in-band intel ----------------
 
     def observe_response(self, url: str, headers) -> Optional[str]:
+        from skypilot_tpu.serve import tenancy
         now = self._clock()
         depth = headers.get('X-SkyTPU-Queue-Depth')
         digest = headers.get('X-SkyTPU-Prefix-Digest')
         tier = headers.get('X-SkyTPU-Tier')
         tokenizer = headers.get('X-SkyTPU-Tokenizer')
+        adapters = headers.get('X-SkyTPU-Adapters')
+        tier_load = headers.get('X-SkyTPU-Tier-Load')
         with self._lock:
             if tier in ('prefill', 'decode', 'monolithic'):
                 self._tiers[url] = tier
             if tokenizer in ('byte', 'hf'):
                 self._tokenizers[url] = tokenizer
+            if adapters is not None:
+                # Advisory: the resident set at response time (absent
+                # header = none resident — an eviction must drop the
+                # stale affinity).
+                self._adapters[url] = {
+                    a.strip() for a in adapters.split(',') if a.strip()}
+            if tier_load is not None:
+                parsed = tenancy.parse_tier_load_header(tier_load)
+                if parsed is not None:
+                    self._tier_loads[url] = parsed
             if depth is not None:
                 try:
                     self._depths[url] = (max(0, int(depth)), now)
@@ -256,6 +277,21 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
             depth = 0
         return depth + self._outstanding.get(url, 0)
 
+    def _load_key(self, url: str, now: float,
+                  req_tier: Optional[str]) -> tuple:
+        """Least-loaded sort key: with a request tier and advertised
+        per-tier depths, the SAME-TIER backlog ranks first (an
+        interactive request prefers the replica whose interactive lane
+        is shortest even if its batch lane is deep), then total load,
+        then the deterministic url tie-break."""
+        total = self._load(url, now)
+        first = total
+        if req_tier:
+            tiers = self._tier_loads.get(url)
+            if tiers is not None:
+                first = tiers.get(req_tier, 0)
+        return (first, total, url)
+
     def replica_load(self, url: str) -> int:
         """Public load read for the LB's own tie-breaks (handoff
         re-dispatch picks the least-loaded surviving prefill
@@ -302,6 +338,27 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
                 prefill_tier = []
             tiered = bool(prefill_tier) and any(
                 self._tiers.get(u) == 'decode' for u in serve_pool)
+            req_tier = hint.get('tier')
+
+            # 0. Adapter affinity (docs/serving.md "Multi-tenant
+            # serving"): requests naming an adapter prefer replicas
+            # holding it RESIDENT (a non-holder pays a device load, or
+            # 400s when unregistered). A SOLE holder wins outright —
+            # adapter-affinity beats prefix-affinity only when the
+            # adapter is not resident elsewhere; with several holders
+            # the cache/least-loaded logic picks among them, and with
+            # none the pool is unrestricted (fail-open).
+            adapter = hint.get('adapter')
+            if adapter:
+                holders = [u for u in serve_pool
+                           if adapter in self._adapters.get(u, set())]
+                if len(holders) == 1:
+                    self.stats['adapter_pin'] += 1
+                    return holders[0], {'result': 'adapter_pin',
+                                        'adapter': adapter}
+                if holders:
+                    self.stats['adapter_pool'] += 1
+                    serve_pool = holders
 
             # 1. Cache-aware: deepest digest match wins; ties break by
             # (load, url) so the choice is deterministic. Restricted
@@ -357,8 +414,10 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
             # The decode TARGET is chosen here (least-loaded among
             # decode-tier replicas, falling back to any serveable one)
             # so the blocks land where the request will run; the LB
-            # orchestrates the actual /kv/prefill push.
-            if tiered and token_ids and prompt_len >= \
+            # orchestrates the actual /kv/prefill push. Adapter
+            # requests never hand off: the streamed KV is the BASE
+            # model's, not the adapter's (v_proj is a LoRA target).
+            if tiered and token_ids and not adapter and prompt_len >= \
                     constants.lb_disagg_prompt_threshold():
                 # Tokenizer gate: byte-encoded text/chat hints only
                 # hand off when every involved replica tokenizes the
@@ -406,8 +465,14 @@ class PrefixAwarePolicy(LoadBalancingPolicy):
             elif tiered:
                 self.stats['tier_decode'] += 1
 
-            # 3. Least-loaded with deterministic tie-break.
-            url = min(pool, key=lambda u: (self._load(u, now), u))
+            # 3. Least-loaded with deterministic tie-break — tier-aware
+            # only when EVERY candidate advertises X-SkyTPU-Tier-Load:
+            # comparing one replica's tier LANE against another's TOTAL
+            # load would invert the ordering in mixed/upgrading fleets.
+            use_tier = (req_tier if req_tier and all(
+                u in self._tier_loads for u in pool) else None)
+            url = min(pool, key=lambda u: self._load_key(u, now,
+                                                         use_tier))
             if saw_stale and not saw_fresh:
                 # ONLY expired digests were available (documented
                 # semantics): a fresh digest that simply missed is a

@@ -84,6 +84,11 @@ class ReplicaInfo:
         # (dict: status/key/imported/blocks), captured by the
         # readiness probe.
         self.last_prewarm: Optional[Dict[str, Any]] = None
+        # Multi-tenant surface from /health: resident/capacity adapter
+        # counts and the per-tier load snapshot — `serve status` shows
+        # ADAPTERS and TIER-MIX per replica (docs/serving.md).
+        self.adapters: Optional[Dict[str, Any]] = None
+        self.tier_load: Optional[Dict[str, int]] = None
 
     @property
     def url(self) -> Optional[str]:
@@ -106,6 +111,8 @@ class ReplicaInfo:
             'preemption_count': getattr(self, 'preemption_count', 0),
             'last_prewarm': getattr(self, 'last_prewarm', None),
             'tier': getattr(self, 'tier', 'monolithic'),
+            'adapters': getattr(self, 'adapters', None),
+            'tier_load': getattr(self, 'tier_load', None),
         }
 
     def __repr__(self) -> str:
@@ -143,6 +150,23 @@ def _signals_from_exposition(text: str) -> Dict[str, float]:
         count = scalar(family, family + '_count')
         if total is not None and count:
             signals[key] = total / count
+    # Per-SLO-tier TTFT means ('ttft_s_<tier>') for the per-tier
+    # autoscaler targets (docs/serving.md "Multi-tenant serving").
+    tier_fam = families.get('skytpu_engine_tier_ttft_seconds')
+    if tier_fam is not None:
+        sums: Dict[str, float] = {}
+        counts: Dict[str, float] = {}
+        for (name, labels), value in tier_fam['samples'].items():
+            tier = dict(labels).get('tier')
+            if tier is None:
+                continue
+            if name.endswith('_sum'):
+                sums[tier] = sums.get(tier, 0.0) + value
+            elif name.endswith('_count'):
+                counts[tier] = counts.get(tier, 0.0) + value
+        for tier, total in sums.items():
+            if counts.get(tier):
+                signals[f'ttft_s_{tier}'] = total / counts[tier]
     return signals
 
 
@@ -442,9 +466,16 @@ class SkyPilotReplicaManager:
                 # the health payload; record it so `serve status` can
                 # show whether the replacement came up warm.
                 try:
-                    prewarm = resp.json().get('prewarm')
+                    payload = resp.json()
+                    prewarm = payload.get('prewarm')
                     if prewarm is not None:
                         info.last_prewarm = prewarm
+                    # Multi-tenant surface (serve status ADAPTERS /
+                    # TIER-MIX columns).
+                    if payload.get('adapters') is not None:
+                        info.adapters = payload['adapters']
+                    if payload.get('tier_load') is not None:
+                        info.tier_load = payload['tier_load']
                 except (ValueError, AttributeError):
                     pass
                 return 'ready'

@@ -193,7 +193,11 @@ class InferenceServer:
                  prefix_store: Optional[str] = None,
                  preempt_drain_timeout: float = 10.0,
                  tp: int = 1,
-                 tier: str = 'monolithic') -> None:
+                 tier: str = 'monolithic',
+                 max_adapters: int = 0,
+                 adapter_rank: int = 0,
+                 adapter_alpha: float = 16.0,
+                 adapter_targets: str = '') -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
@@ -251,7 +255,11 @@ class InferenceServer:
                                                mesh=mesh,
                                                tier=tier,
                                                ingest_ttl=serve_constants
-                                               .ingest_session_ttl_seconds())
+                                               .ingest_session_ttl_seconds(),
+                                               max_adapters=max_adapters,
+                                               adapter_rank=adapter_rank,
+                                               adapter_alpha=adapter_alpha,
+                                               adapter_targets=adapter_targets)
         self.tier = tier
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
@@ -303,6 +311,18 @@ class InferenceServer:
         if not self.ready:
             return web.json_response({'status': 'warming'}, status=503)
         payload = {'status': 'ok', 'tier': self.tier}
+        engine = getattr(self, 'engine', None)
+        if engine is not None and getattr(engine, 'max_adapters', 0):
+            # Multi-tenant surface for the replica manager's probe →
+            # serve status ADAPTERS / TIER-MIX columns.
+            info = engine.adapters_info()
+            payload['adapters'] = {'capacity': info['capacity'],
+                                   'resident': info['resident']}
+        if engine is not None and hasattr(engine, 'tier_load'):
+            try:
+                payload['tier_load'] = engine.tier_load()
+            except Exception:  # pylint: disable=broad-except
+                pass
         if self.last_prewarm is not None:
             # Surfaced to the replica manager's readiness probe, which
             # records it on the ReplicaInfo (serve status shows it).
@@ -400,11 +420,20 @@ class InferenceServer:
                 max_new = int(data.get('max_new_tokens', 32))
                 temperature = float(data.get('temperature', 0.0))
                 deadline = self._deadline_for(data)
+                adapter, priority = self._tenant_fields(data)
                 tokens, future = self._token_stream(prompts[0], max_new,
                                                     temperature,
-                                                    deadline=deadline)
-            except (TypeError, ValueError) as e:
+                                                    deadline=deadline,
+                                                    adapter=adapter,
+                                                    priority=priority)
+            except (TypeError, ValueError,
+                    exceptions.UnknownAdapterError) as e:
                 return web.json_response({'error': str(e)}, status=400)
+            except exceptions.TierDeadlineUnmeetableError as e:
+                # Deadline-aware admission: shed with 429 BEFORE
+                # queueing (docs/serving.md "Multi-tenant serving").
+                return self._unavailable(str(e), status=429,
+                                         reason='deadline')
             except exceptions.EngineOverloadedError as e:
                 return self._unavailable(str(e))
             push, flush = self._delta_decoder()
@@ -441,13 +470,21 @@ class InferenceServer:
             max_new = int(data.get('max_new_tokens', 32))
             temperature = float(data.get('temperature', 0.0))
             deadline = self._deadline_for(data)
+            adapter, priority = self._tenant_fields(data)
             for ids in prompts:
                 futures.append(self._submit_one(ids, max_new,
                                                 temperature,
-                                                deadline=deadline))
-        except (TypeError, ValueError) as e:
+                                                deadline=deadline,
+                                                adapter=adapter,
+                                                priority=priority))
+        except (TypeError, ValueError,
+                exceptions.UnknownAdapterError) as e:
             self._cancel_all(futures)
             return web.json_response({'error': str(e)}, status=400)
+        except exceptions.TierDeadlineUnmeetableError as e:
+            self._cancel_all(futures)
+            return self._unavailable(str(e), status=429,
+                                     reason='deadline')
         except exceptions.EngineOverloadedError as e:
             # Shedding a PARTIALLY submitted batch must release the
             # queue slots its head already took, or the orphans keep
@@ -480,20 +517,42 @@ class InferenceServer:
 
     def _submit_one(self, ids: List[int], max_new: int,
                     temperature: float, on_token=None,
-                    deadline: Optional[float] = None):
+                    deadline: Optional[float] = None,
+                    adapter: Optional[str] = None,
+                    priority: str = 'standard'):
         max_seq = self.engine.cfg.max_seq_len
         if len(ids) + max_new > max_seq:
             ids = ids[-(max_seq - max_new):]
         return self.engine.submit(ids, max_new_tokens=max_new,
                                   temperature=temperature,
                                   on_token=on_token,
-                                  deadline=deadline)
+                                  deadline=deadline,
+                                  adapter=adapter,
+                                  priority=priority)
+
+    @staticmethod
+    def _tenant_fields(data: dict) -> tuple:
+        """(adapter, priority) from a request body — shared by
+        /generate and the OpenAI routes. Raises ValueError (→ 400) on
+        malformed values; unknown-adapter/unmeetable-deadline
+        verdicts come from the engine at submit."""
+        adapter = data.get('adapter')
+        if adapter is not None and not isinstance(adapter, str):
+            raise ValueError('adapter must be a string name')
+        priority = data.get('priority') or 'standard'
+        if not isinstance(priority, str):
+            raise ValueError('priority must be a string')
+        from skypilot_tpu.serve import tenancy
+        tenancy.validate_tier(priority)
+        return adapter, priority
 
     # -- streaming plumbing --
 
     def _token_stream(self, ids: List[int], max_new: int,
                       temperature: float,
-                      deadline: Optional[float] = None):
+                      deadline: Optional[float] = None,
+                      adapter: Optional[str] = None,
+                      priority: str = 'standard'):
         """(async-iterable of tokens, future): engine-thread tokens
         bridged onto this event loop; the iterable ends at the engine's
         None sentinel (sent after the future resolves)."""
@@ -504,7 +563,8 @@ class InferenceServer:
             loop.call_soon_threadsafe(queue.put_nowait, tok)
 
         future = self._submit_one(ids, max_new, temperature,
-                                  on_token=on_token, deadline=deadline)
+                                  on_token=on_token, deadline=deadline,
+                                  adapter=adapter, priority=priority)
 
         async def tokens():
             while True:
@@ -1045,6 +1105,76 @@ class InferenceServer:
         aborted = self.engine.abort_ingest(stream_id)
         return web.json_response({'ok': True, 'aborted': aborted})
 
+    # -- multi-tenant adapter registry (docs/serving.md) --
+    #
+    # POST /adapters/load   {"name": n, "path": p}  — register the npz
+    #   adapter archive at `p` (tenancy.save_adapter_npz format) and
+    #   make it RESIDENT in the device-side pool (the device write runs
+    #   in the engine tick thread, off the steady decode path).
+    # DELETE /adapters/{name} — unregister; 409 while in-flight
+    #   requests pin it, 404 when unknown.
+    # GET /adapters — registry/residency/refcount snapshot.
+
+    async def handle_adapter_load(self,
+                                  request: web.Request) -> web.Response:
+        if self.draining:
+            return self._unavailable('server is draining for shutdown',
+                                     retry_after=5, reason='draining')
+        try:
+            data = await request.json()
+        except Exception:  # pylint: disable=broad-except
+            return web.json_response({'error': 'body must be JSON'},
+                                     status=400)
+        name = data.get('name')
+        path = data.get('path')
+        if not isinstance(name, str) or not isinstance(path, str):
+            return web.json_response(
+                {'error': 'need name and path (npz adapter archive, '
+                          'tenancy.save_adapter_npz format)'},
+                status=400)
+        from skypilot_tpu.serve import tenancy
+        loop = asyncio.get_event_loop()
+
+        def load():
+            tree = tenancy.load_adapter_npz(os.path.expanduser(path))
+            return self.engine.load_adapter(name, tree)
+
+        try:
+            slot = await loop.run_in_executor(None, load)
+        except exceptions.AdapterPoolExhaustedError as e:
+            return self._unavailable(str(e), retry_after=2,
+                                     reason='adapter-pool')
+        except exceptions.UnknownAdapterError as e:
+            return web.json_response({'error': str(e)}, status=400)
+        except (ValueError, OSError) as e:
+            return web.json_response({'error': str(e)}, status=400)
+        except fault_injection.InjectedFault as e:
+            return web.json_response(
+                {'error': f'adapter load fault: {e}'}, status=500)
+        return web.json_response({'ok': True, 'name': name,
+                                  'slot': slot})
+
+    async def handle_adapter_delete(self,
+                                    request: web.Request) -> web.Response:
+        name = request.match_info['name']
+        loop = asyncio.get_event_loop()
+        try:
+            await loop.run_in_executor(
+                None, self.engine.unload_adapter, name)
+        except exceptions.AdapterInUseError as e:
+            return web.json_response({'error': str(e)}, status=409)
+        except exceptions.UnknownAdapterError as e:
+            return web.json_response({'error': str(e)}, status=404)
+        except fault_injection.InjectedFault as e:
+            return web.json_response(
+                {'error': f'adapter evict fault: {e}'}, status=500)
+        return web.json_response({'ok': True, 'name': name})
+
+    async def handle_adapters(self,
+                              request: web.Request) -> web.Response:
+        del request
+        return web.json_response(self.engine.adapters_info())
+
     async def handle_traces(self, request: web.Request) -> web.Response:
         """GET /traces — this process's span ring as JSON (the
         `skytpu trace --url` feed), plus the histogram exemplars that
@@ -1181,25 +1311,35 @@ class InferenceServer:
             max_new = int(data.get('max_tokens') or 16)
             temperature = float(data.get('temperature') or 0.0)
             deadline = self._deadline_for(data)
+            adapter, priority = self._tenant_fields(data)
             if data.get('stream'):
                 if len(prompt_ids) != 1:
                     return self._openai_error(
                         'stream=true takes exactly one prompt')
                 return await self._stream_completions(
                     request, data, prompt_ids[0], max_new, temperature,
-                    deadline=deadline)
+                    deadline=deadline, adapter=adapter,
+                    priority=priority)
             too_big = self._batch_capacity_error(len(prompt_ids))
             if too_big is not None:
                 return self._openai_error(too_big)
             for ids in prompt_ids:
                 futures.append(self._submit_one(ids, max_new,
                                                 temperature,
-                                                deadline=deadline))
-        except (TypeError, ValueError) as e:
-            # Bad shapes/values (empty prompt, non-numeric fields, ...)
-            # surface as OpenAI-format 400s, not aiohttp 500s.
+                                                deadline=deadline,
+                                                adapter=adapter,
+                                                priority=priority))
+        except (TypeError, ValueError,
+                exceptions.UnknownAdapterError) as e:
+            # Bad shapes/values (empty prompt, non-numeric fields,
+            # unregistered adapter, ...) surface as OpenAI-format 400s,
+            # not aiohttp 500s.
             self._cancel_all(futures)
             return self._openai_error(str(e))
+        except exceptions.TierDeadlineUnmeetableError as e:
+            self._cancel_all(futures)
+            return self._openai_error(str(e), status=429, retry_after=1,
+                                      shed_reason='deadline')
         except exceptions.EngineOverloadedError as e:
             # OpenAI clients back off on 429 (rate limit semantics);
             # cancel the already-submitted head of the batch so shed
@@ -1236,8 +1376,9 @@ class InferenceServer:
         })
 
     async def _stream_completions(self, request, data, ids, max_new,
-                                  temperature,
-                                  deadline=None) -> web.StreamResponse:
+                                  temperature, deadline=None,
+                                  adapter=None, priority='standard'
+                                  ) -> web.StreamResponse:
         """OpenAI text-completion SSE chunks, closed by `data: [DONE]`."""
         cmpl_id = f'cmpl-{int(time.time() * 1e3):x}'
         created = int(time.time())
@@ -1251,7 +1392,9 @@ class InferenceServer:
                                  'finish_reason': finish}]}
 
         tokens, future = self._token_stream(ids, max_new, temperature,
-                                            deadline=deadline)
+                                            deadline=deadline,
+                                            adapter=adapter,
+                                            priority=priority)
         push, flush = self._delta_decoder()
         try:
             # Inside the try: a client that disconnects during prepare
@@ -1278,8 +1421,8 @@ class InferenceServer:
         return resp
 
     async def _stream_chat(self, request, data, ids, max_new,
-                           temperature,
-                           deadline=None) -> web.StreamResponse:
+                           temperature, deadline=None, adapter=None,
+                           priority='standard') -> web.StreamResponse:
         """OpenAI chat-completion SSE chunks (delta objects), closed by
         `data: [DONE]`."""
         chat_id = f'chatcmpl-{int(time.time() * 1e3):x}'
@@ -1293,7 +1436,9 @@ class InferenceServer:
                                  'finish_reason': finish}]}
 
         tokens, future = self._token_stream(ids, max_new, temperature,
-                                            deadline=deadline)
+                                            deadline=deadline,
+                                            adapter=adapter,
+                                            priority=priority)
         try:
             resp = await self._sse_prepare(request)
             await self._sse_send(resp, chunk({'role': 'assistant'}))
@@ -1351,14 +1496,23 @@ class InferenceServer:
             max_new = int(data.get('max_tokens') or 16)
             temperature = float(data.get('temperature') or 0.0)
             deadline = self._deadline_for(data)
+            adapter, priority = self._tenant_fields(data)
             if data.get('stream'):
                 return await self._stream_chat(request, data, ids,
                                                max_new, temperature,
-                                               deadline=deadline)
+                                               deadline=deadline,
+                                               adapter=adapter,
+                                               priority=priority)
             future = self._submit_one(ids, max_new, temperature,
-                                      deadline=deadline)
-        except (TypeError, ValueError, AttributeError) as e:
+                                      deadline=deadline,
+                                      adapter=adapter,
+                                      priority=priority)
+        except (TypeError, ValueError, AttributeError,
+                exceptions.UnknownAdapterError) as e:
             return self._openai_error(str(e))
+        except exceptions.TierDeadlineUnmeetableError as e:
+            return self._openai_error(str(e), status=429, retry_after=1,
+                                      shed_reason='deadline')
         except exceptions.EngineOverloadedError as e:
             return self._openai_error(str(e), status=429, retry_after=1,
                                       shed_reason='overloaded')
@@ -1418,6 +1572,24 @@ class InferenceServer:
             digest = engine.prefix_digest()
             if digest:
                 headers['X-SkyTPU-Prefix-Digest'] = digest
+            # Multi-tenant intel: per-tier backlog for tier-aware
+            # least-loaded routing, and the resident adapter set for
+            # adapter-affinity routing (docs/serving.md). The tier
+            # header costs an O(queue) scan under the admission mutex,
+            # so it only turns on once tiered traffic (or an adapter
+            # pool) actually exists — the LB degrades gracefully
+            # without it.
+            if hasattr(engine, 'tier_load') and (
+                    getattr(engine, 'max_adapters', 0) or
+                    getattr(engine, '_tiers_active', False)):
+                from skypilot_tpu.serve import tenancy
+                headers['X-SkyTPU-Tier-Load'] = \
+                    tenancy.render_tier_load_header(engine.tier_load())
+            if getattr(engine, 'max_adapters', 0):
+                # Sent even when EMPTY: an eviction-to-none must clear
+                # the LB's stale affinity for this replica.
+                resident = engine._adapter_pool.resident_names()  # pylint: disable=protected-access
+                headers['X-SkyTPU-Adapters'] = ','.join(resident)
         except Exception:  # pylint: disable=broad-except
             logger.debug('fleet-intel headers unavailable', exc_info=True)
         return headers
@@ -1445,6 +1617,10 @@ class InferenceServer:
         app.router.add_get('/metrics', self.handle_metrics)
         app.router.add_get('/traces', self.handle_traces)
         app.router.add_post('/preempt', self.handle_preempt)
+        app.router.add_post('/adapters/load', self.handle_adapter_load)
+        app.router.add_delete('/adapters/{name}',
+                              self.handle_adapter_delete)
+        app.router.add_get('/adapters', self.handle_adapters)
         app.router.add_post('/kv/prefill', self.handle_kv_prefill)
         app.router.add_post('/kv/ingest', self.handle_kv_ingest)
         app.router.add_post('/kv/abort', self.handle_kv_abort)
@@ -1598,6 +1774,24 @@ def main(argv=None) -> int:
                              '--paged-block-size and --prefix-cache '
                              'for the specialized tiers. Default: '
                              '$SKYTPU_REPLICA_TIER or monolithic')
+    parser.add_argument('--max-adapters', type=int, default=0,
+                        help='multi-tenant serving: hold up to N LoRA '
+                             'adapters resident in a device-side pool '
+                             'and batch requests for DIFFERENT '
+                             'adapters (and the base model) into one '
+                             'decode dispatch. Adapters register via '
+                             'POST /adapters/load; requests pick one '
+                             'with the `adapter` field. 0 = off '
+                             '(docs/serving.md "Multi-tenant serving")')
+    parser.add_argument('--adapter-rank', type=int, default=0,
+                        help='uniform LoRA rank every resident adapter '
+                             'must share (required with --max-adapters)')
+    parser.add_argument('--adapter-alpha', type=float, default=16.0,
+                        help='LoRA alpha for the resident adapters')
+    parser.add_argument('--adapter-targets', default='',
+                        help='comma list of adapted projections from '
+                             '{q,k,v,o,gate,up,down} (default: the '
+                             "model config's lora_targets)")
     parser.add_argument('--preempt-drain-timeout', type=float,
                         default=serve_constants
                         .preempt_notice_budget_seconds(),
@@ -1633,7 +1827,11 @@ def main(argv=None) -> int:
                              prefix_store=args.prefix_store,
                              preempt_drain_timeout=args.preempt_drain_timeout,
                              tp=args.tp,
-                             tier=args.tier)
+                             tier=args.tier,
+                             max_adapters=args.max_adapters,
+                             adapter_rank=args.adapter_rank,
+                             adapter_alpha=args.adapter_alpha,
+                             adapter_targets=args.adapter_targets)
     logger.info('sampling filters: top_k=%s top_p=%s (0 = off)',
                 args.top_k, args.top_p)
     # Preemption pre-warm BEFORE ready: a replacement replica restores

@@ -36,6 +36,7 @@ class SkyServiceSpec:
         target_ttft_seconds: Optional[float] = None,
         target_tpot_seconds: Optional[float] = None,
         prefill_replicas: int = 0,
+        target_ttft_seconds_per_tier: Optional[Dict[str, float]] = None,
     ) -> None:
         if not readiness_path.startswith('/'):
             raise ValueError(
@@ -51,12 +52,41 @@ class SkyServiceSpec:
                 raise ValueError(
                     'max_replicas is required when autoscaling with '
                     'target_qps_per_replica')
+        # Per-SLO-tier TTFT targets (docs/serving.md "Multi-tenant
+        # serving"): {tier: seconds} — the MetricsAutoscaler computes
+        # pressure per tier from the replicas' per-tier TTFT
+        # histograms, so a batch-tier flood that leaves interactive
+        # TTFT over ITS target grows the fleet even while the global
+        # mean looks fine.
+        if target_ttft_seconds_per_tier is not None:
+            if not isinstance(target_ttft_seconds_per_tier, dict) or \
+                    not target_ttft_seconds_per_tier:
+                raise ValueError(
+                    'target_ttft_seconds_per_tier must be a non-empty '
+                    'dict of {tier: seconds}')
+            from skypilot_tpu.serve import tenancy
+            for tier_name, value in \
+                    target_ttft_seconds_per_tier.items():
+                if tier_name not in tenancy.TIERS:
+                    raise ValueError(
+                        f'unknown tier {tier_name!r} in '
+                        f'target_ttft_seconds_per_tier; expected one '
+                        f'of {tenancy.TIERS}')
+                if not isinstance(value, (int, float)) or value <= 0:
+                    raise ValueError(
+                        f'target_ttft_seconds_per_tier[{tier_name!r}] '
+                        f'must be > 0')
+            target_ttft_seconds_per_tier = {
+                k: float(v)
+                for k, v in target_ttft_seconds_per_tier.items()}
         metric_targets = [
             name for name, value in (
                 ('target_queue_depth_per_replica',
                  target_queue_depth_per_replica),
                 ('target_ttft_seconds', target_ttft_seconds),
-                ('target_tpot_seconds', target_tpot_seconds))
+                ('target_tpot_seconds', target_tpot_seconds),
+                ('target_ttft_seconds_per_tier',
+                 target_ttft_seconds_per_tier))
             if value is not None
         ]
         for name, value in (
@@ -71,6 +101,11 @@ class SkyServiceSpec:
                     raise ValueError(
                         f'max_replicas is required when autoscaling '
                         f'with {name}')
+        if target_ttft_seconds_per_tier is not None and \
+                max_replicas is None:
+            raise ValueError(
+                'max_replicas is required when autoscaling with '
+                'target_ttft_seconds_per_tier')
         if metric_targets and (use_ondemand_fallback or
                                base_ondemand_fallback_replicas or
                                dynamic_ondemand_fallback):
@@ -103,6 +138,7 @@ class SkyServiceSpec:
         self.target_queue_depth_per_replica = target_queue_depth_per_replica
         self.target_ttft_seconds = target_ttft_seconds
         self.target_tpot_seconds = target_tpot_seconds
+        self.target_ttft_seconds_per_tier = target_ttft_seconds_per_tier
         # Disaggregated serving (docs/serving.md): the first N of the
         # fleet's replicas launch as the dedicated prefill tier, the
         # rest as the decode tier; 0 = a classic monolithic fleet. The
@@ -127,7 +163,8 @@ class SkyServiceSpec:
     def metrics_autoscaling_enabled(self) -> bool:
         return any(v is not None for v in (
             self.target_queue_depth_per_replica,
-            self.target_ttft_seconds, self.target_tpot_seconds))
+            self.target_ttft_seconds, self.target_tpot_seconds,
+            self.target_ttft_seconds_per_tier))
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyServiceSpec':
@@ -175,6 +212,7 @@ class SkyServiceSpec:
                         'use_ondemand_fallback',
                         'target_queue_depth_per_replica',
                         'target_ttft_seconds', 'target_tpot_seconds',
+                        'target_ttft_seconds_per_tier',
                         'prefill_replicas'):
                 if key in policy:
                     kwargs[key] = policy[key]
@@ -205,7 +243,8 @@ class SkyServiceSpec:
                         'base_ondemand_fallback_replicas',
                         'dynamic_ondemand_fallback',
                         'target_queue_depth_per_replica',
-                        'target_ttft_seconds', 'target_tpot_seconds'):
+                        'target_ttft_seconds', 'target_tpot_seconds',
+                        'target_ttft_seconds_per_tier'):
                 value = getattr(self, key)
                 if value is not None:
                     policy[key] = value

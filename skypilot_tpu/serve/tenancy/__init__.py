@@ -1,0 +1,45 @@
+"""Multi-tenant serving substrate (docs/serving.md "Multi-tenant
+serving").
+
+Two legs, both HOST-side and jax-free (the controller/LB import this
+package without touching the device stack; the engine glues the device
+writes in models/inference.py):
+
+- adapter_pool: named LoRA adapters resident in a fixed-capacity
+  device-side stack — slot assignment, LRU eviction of idle residents,
+  refcount pinning while any request uses a slot, npz adapter I/O.
+- scheduling: SLO priority tiers (interactive/standard/batch) — the
+  tier-ordered admission queue with a deterministic starvation floor,
+  and the deadline-aware admission estimate.
+"""
+from skypilot_tpu.serve.tenancy.adapter_pool import (
+    AdapterPool,
+    adapter_tree_from_lora_params,
+    load_adapter_npz,
+    save_adapter_npz,
+    validate_adapter_name,
+)
+from skypilot_tpu.serve.tenancy.scheduling import (
+    TIERS,
+    TIER_RANK,
+    TierQueue,
+    parse_tier_load_header,
+    projected_wait,
+    render_tier_load_header,
+    validate_tier,
+)
+
+__all__ = [
+    'AdapterPool',
+    'adapter_tree_from_lora_params',
+    'load_adapter_npz',
+    'save_adapter_npz',
+    'validate_adapter_name',
+    'TIERS',
+    'TIER_RANK',
+    'TierQueue',
+    'parse_tier_load_header',
+    'projected_wait',
+    'render_tier_load_header',
+    'validate_tier',
+]

@@ -106,6 +106,9 @@ KNOWN_POINTS = (
     'train.step',
     'train.save',
     'train.notice',
+    'tenant.adapter_load',
+    'tenant.evict',
+    'engine.slot_preempt',
 )
 
 

@@ -198,6 +198,51 @@ class TestFleetDryrunDispatch:
         assert bench.main() == 0
         assert calls['dry'] == ['--dryrun-serve-disagg']
 
+    def test_dryrun_serve_multitenant_skips_tpu_preflight(
+            self, monkeypatch):
+        """--dryrun-serve-multitenant is the multi-LoRA + SLO-tier
+        proxy (CPU-only by design): the no-preflight dryrun
+        supervisor, never the TPU probe ladder."""
+        bench = _load_bench()
+        calls = {}
+
+        def fake_dryrun(argv):
+            calls['dry'] = argv
+            return 0
+
+        monkeypatch.setattr(bench, '_supervise_dryrun', fake_dryrun)
+        monkeypatch.setattr(
+            bench, '_supervise',
+            lambda argv: (_ for _ in ()).throw(
+                AssertionError('TPU preflight path taken')))
+        monkeypatch.setattr(sys, 'argv',
+                            ['bench.py', '--dryrun-serve-multitenant'])
+        assert bench.main() == 0
+        assert calls['dry'] == ['--dryrun-serve-multitenant']
+
+    def test_dryrun_serve_multitenant_skip_on_unconstructable_engine(
+            self, monkeypatch, capsys):
+        """An engine combination the constructor rejects emits the
+        structured {"skipped": true} line with the combo and rc=3 —
+        never the retry ladder."""
+        bench = _load_bench()
+        from skypilot_tpu.models import inference as inference_lib
+
+        def boom(*_a, **_kw):
+            raise ValueError('max_adapters requires adapter_rank')
+
+        monkeypatch.setattr(inference_lib, 'ContinuousBatchingEngine',
+                            boom)
+        rc = bench._dryrun_serve_multitenant(
+            bench._parse_args(['--dryrun-serve-multitenant',
+                               '--worker']))
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        row = json.loads(out)
+        assert rc == 3
+        assert row['skipped'] is True
+        assert 'adapter_rank' in row['reason']
+        assert row['combo']['max_adapters'] == 3
+
     def test_dryrun_serve_disagg_skip_on_unconstructable_engine(
             self, monkeypatch, capsys):
         """An engine combination the constructor rejects is a

@@ -200,7 +200,10 @@ class TestServeStatusPreemption:
                  'version': 1, 'preemption_count': 2,
                  'tier': 'prefill',
                  'last_prewarm': {'status': 'ok', 'imported': 3,
-                                  'partial': False}},
+                                  'partial': False},
+                 'adapters': {'capacity': 4, 'resident': 2},
+                 'tier_load': {'interactive': 1, 'standard': 0,
+                               'batch': 7}},
                 # A row from an older build (no lifecycle keys) still
                 # renders.
                 {'replica_id': 3, 'status': 'READY',
@@ -225,6 +228,13 @@ class TestServeStatusPreemption:
         line3 = [l for l in result.output.splitlines()
                  if l.strip().startswith('3')][0]
         assert 'monolithic' in line3
+        # Multi-tenant columns (docs/serving.md "Multi-tenant
+        # serving"): resident/capacity + per-tier load mix; rows from
+        # older builds (no fields) render '-'.
+        assert 'ADAPTERS' in result.output
+        assert 'TIER-MIX' in result.output
+        assert '2/4' in line2 and 'i1/s0/b7' in line2
+        assert line3.rstrip().endswith('-')
 
 
 @pytest.mark.slow

@@ -198,6 +198,48 @@ def test_export_tool_rejects_conflicting_lora_flags(tmp_path, capsys):
     assert 'disagrees' in capsys.readouterr().err
 
 
+def test_to_hf_lora_guard_round_trip():
+    """models/convert.to_hf export guard, pinned before the adapter
+    pool (serve/tenancy) starts moving lora_a/lora_b leaves around:
+
+    1. an UNMERGED adapter tree under a plain (lora_rank=0) config is
+       REFUSED — a silent export would drop the fine-tune;
+    2. a merge_lora-folded tree exports BIT-IDENTICALLY to the
+       never-LoRA checkpoint (same kernels, no adapter leaves): at
+       init lora_b == 0, so the fold is exactly W + 0.
+    """
+    from skypilot_tpu.models.convert import to_hf
+    cfg = _cfg(**LORA)
+    plain_cfg = _cfg()
+    tokens = jnp.ones((1, 8), jnp.int32)
+    params = Transformer(cfg).init(jax.random.PRNGKey(0),
+                                   tokens)['params']
+    from flax import linen as nn
+    params = nn.unbox(params)
+    assert has_lora(params)
+
+    # 1. Unmerged tree + plain config: refuse loudly.
+    with pytest.raises(ValueError, match='lora_a/lora_b'):
+        to_hf(params, plain_cfg)
+
+    # 2. The never-LoRA checkpoint: the same tree with the adapter
+    # leaves stripped.
+    def strip(node):
+        if not isinstance(node, dict):
+            return node
+        return {k: strip(v) for k, v in node.items()
+                if k not in ('lora_a', 'lora_b')}
+
+    never_lora = strip(params)
+    assert not has_lora(never_lora)
+    merged_sd = to_hf(params, cfg)           # folds via merge_lora
+    plain_sd = to_hf(never_lora, plain_cfg)
+    assert set(merged_sd) == set(plain_sd)
+    for key in merged_sd:
+        np.testing.assert_array_equal(merged_sd[key], plain_sd[key],
+                                      err_msg=key)
+
+
 def test_overlay_base_params_keeps_adapters():
     full = {'layers': {'q_proj': {'kernel': np.zeros(2),
                                   'lora_a': np.ones(2),
