@@ -204,6 +204,27 @@ def _parse_args(argv=None):
                              'the row reports the host-gap fraction '
                              'the pipeline removes and the chained-'
                              'dispatch count (0 = synchronous ticks)')
+    parser.add_argument('--decode-kernel', default='xla',
+                        choices=['xla', 'pallas', 'pallas_interpret'],
+                        help='serve row: paged decode attention kernel '
+                             '— xla (gather + einsum) or pallas (fused '
+                             'VMEM block-table walk; requires '
+                             '--paged-block-size). The kernel-vs-XLA '
+                             'tok/s + MFU diff on a real chip is the '
+                             'standing BASELINE.md action')
+    parser.add_argument('--dryrun-serve-kernel', action='store_true',
+                        help='emit the KERNEL_serve proxy row on CPU '
+                             '(no chip needed): the fused pallas '
+                             'decode kernel (interpreter mode) next '
+                             'to its XLA twin — greedy streams across '
+                             'the composition cells, the compiled-'
+                             'HLO gather-count diff (the pool-window '
+                             'gather the in-kernel table walk '
+                             'deletes), the fused HBM bytes-per-step '
+                             'accounting, and the fused multi-LoRA '
+                             'pays/does-not-pay verdict '
+                             '(docs/performance.md "Fused decode '
+                             'kernel")')
     parser.add_argument('--tune-attn', action='store_true',
                         help='sweep flash-attention block sizes per '
                              'sequence length (fwd+bwd wall time) and '
@@ -418,7 +439,8 @@ class _UnsupportedServeCombo(Exception):
 
 def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
                   kv_quant=None, speculative=0, prefix_cache=0,
-                  paged_block_size=0, async_depth=0) -> dict:
+                  paged_block_size=0, async_depth=0,
+                  decode_kernel='xla') -> dict:
     """p50/p99 time-to-first-token + aggregate decode throughput under
     concurrent requests on the local chip(s) via the continuous-batching
     engine (models/inference.py) — the BASELINE.md serving row.
@@ -434,7 +456,8 @@ def _measure_ttft(cfg, mesh, quantize=None, decode_chunk=1,
             cfg, num_slots=4, mesh=mesh, quantize=quantize,
             decode_chunk=decode_chunk, kv_quant=kv_quant,
             speculative=speculative, prefix_cache=prefix_cache,
-            paged_block_size=paged_block_size, async_depth=async_depth)
+            paged_block_size=paged_block_size, async_depth=async_depth,
+            decode_kernel=decode_kernel)
     except (ValueError, NotImplementedError) as e:
         raise _UnsupportedServeCombo(str(e)) from e
     prompt = list(range(1, 33))
@@ -605,6 +628,128 @@ def _dryrun_serve_sharded(args) -> int:
         'allreduce_bytes_per_step': hlo['all_reduce_bytes'],
         'pool_blocks_capacity': occupancy['blocks_capacity'],
         'pool_bytes_per_device': occupancy.get('pool_bytes_per_device'),
+    }
+    print(json.dumps(row))
+    return 0 if ok else 1
+
+
+def _dryrun_serve_kernel(args) -> int:  # pylint: disable=unused-argument
+    """KERNEL_serve: the fused pallas paged-decode proxy row on CPU
+    (interpreter mode — the chip-unreachable compile-proxy pattern).
+
+    Pins, against the XLA twin sharing every knob: greedy streams
+    across the composition cells (paged / +int8 / +spec / +async3),
+    the compiled-HLO gather-count diff (the pool-window gather the
+    in-kernel block-table walk deletes — pinned on 'gather'
+    specifically, since interpreter emulation inflates dynamic-slice
+    counts on CPU), the fused HBM bytes-per-step accounting, and the
+    fused multi-LoRA kernel's bit-exactness + pays/does-not-pay
+    verdict. The kernel-vs-XLA tok/s + MFU measurement on a real chip
+    is the standing BASELINE.md action this row proxies. Single-chip
+    by design (no fake-device forcing — the DISAGG/MULTITENANT
+    pattern); the supervisor pins JAX_PLATFORMS=cpu."""
+    import dataclasses
+
+    from skypilot_tpu.models import get_config
+    from skypilot_tpu.models import inference as inference_lib
+
+    cfg = dataclasses.replace(
+        get_config('test-tiny'), dtype='float32', param_dtype='float32',
+        max_seq_len=64, remat=False)
+    prompt = list(range(1, 17))
+    cells = [
+        ('paged', dict(paged_block_size=8)),
+        ('paged-int8', dict(paged_block_size=8, kv_quant='int8')),
+        ('paged-spec', dict(paged_block_size=8, speculative=3)),
+        ('paged-int8-async3', dict(paged_block_size=8, kv_quant='int8',
+                                   async_depth=3)),
+    ]
+
+    def _engine(**kw):
+        return inference_lib.ContinuousBatchingEngine(cfg, num_slots=2,
+                                                      **kw)
+
+    cell_rows = {}
+    try:
+        for name, kw in cells:
+            xla = _engine(**kw)
+            ref, _ = xla.generate(prompt, max_new_tokens=12)
+            xla.stop()
+            pal = _engine(decode_kernel='pallas', **kw)
+            got, _ = pal.generate(prompt, max_new_tokens=12)
+            cell_rows[name] = {'match': got == ref,
+                               'decode_kernel': pal.decode_kernel}
+            pal.stop()
+
+        xla = _engine(paged_block_size=8)
+        xla_stats = xla.decode_kernel_hlo_stats()
+        xla.stop()
+        pal = _engine(paged_block_size=8, decode_kernel='pallas')
+        pal_stats = pal.decode_kernel_hlo_stats()
+        pal.stop()
+    except (ValueError, NotImplementedError) as e:
+        _emit_skip(f'unsupported serve-kernel combination: {e}',
+                   combo={'decode_kernel': 'pallas',
+                          'paged_block_size': 8})
+        return 3
+
+    # Fused multi-LoRA leg: the kernel is bit-exact vs the XLA
+    # take+dot path (same accumulation order), so the proxy checks
+    # exactness and reports the analytical verdict — it removes the
+    # per-step B*(in*r + r*out) adapter-gather HBM round trip, but the
+    # LoRA delta is a sliver of the base matmul at decode shapes, so
+    # it rides the same knob rather than earning its own.
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.ops.fused_lora import fused_multi_lora
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(keys[0], (4, 1, cfg.d_model), jnp.float32)
+    a_stack = jax.random.normal(keys[1], (3, cfg.d_model, 4),
+                                jnp.float32)
+    b_stack = jax.random.normal(keys[2], (3, 4, cfg.d_model),
+                                jnp.float32)
+    ids = jnp.asarray([0, 2, 1, 0], jnp.int32)
+    fused = fused_multi_lora(x, a_stack, b_stack, ids, interpret=True)
+    ref_lora = jnp.einsum(
+        'bsr,bro->bso', jnp.einsum('bsi,bir->bsr', x, a_stack[ids]),
+        b_stack[ids])
+    lora_exact = bool(jnp.max(jnp.abs(fused - ref_lora)) == 0.0)
+    gather_bytes = int(ids.shape[0] * 4 *
+                       (cfg.d_model * 4 + 4 * cfg.d_model))
+
+    gathers_removed = xla_stats['gather'] - pal_stats['gather']
+    ok = bool(all(c['match'] for c in cell_rows.values())
+              and all(c['decode_kernel'] == 'pallas_interpret'
+                      for c in cell_rows.values())
+              and gathers_removed > 0
+              and pal_stats['fused_bytes_per_step'] > 0
+              and xla_stats['fused_bytes_per_step'] == 0
+              and lora_exact)
+    row = {
+        'metric': 'KERNEL_serve dryrun fused paged-decode',
+        'value': float(gathers_removed),
+        'unit': 'gathers_removed_per_decode_step',
+        'vs_baseline': (pal_stats['gather'] /
+                        max(1, xla_stats['gather'])),
+        'ok': ok,
+        'skipped': False,
+        'cells': cell_rows,
+        'xla_gather': xla_stats['gather'],
+        'pallas_gather': pal_stats['gather'],
+        'xla_hlo': {k: v for k, v in xla_stats.items()
+                    if isinstance(v, int)},
+        'pallas_hlo': {k: v for k, v in pal_stats.items()
+                      if isinstance(v, int)},
+        'fused_bytes_per_step': pal_stats['fused_bytes_per_step'],
+        'lora_fusion': {
+            'bit_exact': lora_exact,
+            'adapter_gather_bytes_removed_per_step': gather_bytes,
+            'verdict': 'does-not-pay-standalone: delta matmul is a '
+                       'sliver of the base projection at decode '
+                       'shapes; carried behind decode_kernel=pallas '
+                       'since fusing costs nothing',
+        },
     }
     print(json.dumps(row))
     return 0 if ok else 1
@@ -1794,6 +1939,8 @@ def _worker(args) -> int:
         # CPU-only by design; forces its own fake-device backend
         # BEFORE any jax.devices() call.
         return _dryrun_serve_sharded(args)
+    if args.dryrun_serve_kernel:
+        return _dryrun_serve_kernel(args)
     if args.dryrun_serve_fleet:
         return _dryrun_serve_fleet(args)
     if args.dryrun_serve_disagg:
@@ -1871,7 +2018,8 @@ def _worker(args) -> int:
                                  speculative=args.speculative,
                                  prefix_cache=args.prefix_cache,
                                  paged_block_size=args.paged_block_size,
-                                 async_depth=args.async_depth)
+                                 async_depth=args.async_depth,
+                                 decode_kernel=args.decode_kernel)
         except _UnsupportedServeCombo as e:
             # An unrunnable flag combination (block size not dividing
             # the window, an unknown quant mode, ...) must still honor
@@ -1885,7 +2033,8 @@ def _worker(args) -> int:
                 combo={'kv_quant': args.kv_quant or 'none',
                        'speculative': args.speculative,
                        'paged_block_size': args.paged_block_size,
-                       'async_depth': args.async_depth})
+                       'async_depth': args.async_depth,
+                       'decode_kernel': args.decode_kernel})
             return 3
         print(f'serve: {ttft}', file=sys.stderr)
         tags = [t for t in (args.quantize,
@@ -1900,7 +2049,10 @@ def _worker(args) -> int:
                             f'paged-{args.paged_block_size}'
                             if args.paged_block_size else None,
                             f'async-{args.async_depth}'
-                            if args.async_depth else None) if t]
+                            if args.async_depth else None,
+                            f'kernel-{args.decode_kernel}'
+                            if args.decode_kernel != 'xla'
+                            else None) if t]
         result = {
             'metric': f'{serve_cfg.name} serve p50 TTFT'
                       + (f' ({"+".join(tags)})' if tags else ''),
@@ -1913,6 +2065,7 @@ def _worker(args) -> int:
             'speculative': args.speculative,
             'prefix_cache': args.prefix_cache,
             'paged_block_size': args.paged_block_size,
+            'decode_kernel': args.decode_kernel,
             **ttft,
         }
         print(json.dumps(result))
@@ -1981,7 +2134,7 @@ def main() -> int:
         return _dryrun_lint(args)
     if (args.dryrun_serve_sharded or args.dryrun_serve_fleet or
             args.dryrun_serve_disagg or args.dryrun_serve_multitenant or
-            args.dryrun_trace or
+            args.dryrun_trace or args.dryrun_serve_kernel or
             args.dryrun_train_zero1 or args.dryrun_train_elastic):
         return _supervise_dryrun(argv)
     return _supervise(argv)

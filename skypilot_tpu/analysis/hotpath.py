@@ -27,6 +27,16 @@ Allowlist: the documented funnels `_upload` and `_land` (their bodies
 are not descended into, and a value passing through them launders to
 host for the dataflow rule) and `copy_to_host_async` (the async
 transfer the ring protocol is built on).
+
+Pallas kernel launches (`pl.pallas_call(kernel, ...)` — the fused
+paged-decode attention the tick dispatches through, ops/
+paged_attention.py) are DEVICE dispatches, not host syncs: the launch
+is as asynchronous as any jax op, so it is explicitly allowed
+(ALLOWED_DEVICE_DISPATCH) — while its RESULT stays a device value for
+the float()/int() taint rule, exactly like a jnp call's. Kernel
+bodies themselves (Ref-typed functions passed INTO pallas_call) trace
+on device and are never host code; they are not descended into
+because only ast.Call edges enter the call graph.
 """
 from __future__ import annotations
 
@@ -42,6 +52,12 @@ HOT_ROOTS = ('ContinuousBatchingEngine._tick', 'make_train_step',
              'make_elastic_train_step')
 ALLOWED_FUNNELS = ('_upload', '_land')
 ALLOWED_METHODS = ('copy_to_host_async',)
+# Async device dispatches that LOOK like they could move data but
+# never block the host: pallas kernel launches (the fused decode
+# kernel rides the tick). Checked before the flag rules so a future
+# broadening of _RAW_TRANSFERS cannot regress them; their results
+# remain device-tainted for the float()/int() rule.
+ALLOWED_DEVICE_DISPATCH = ('jax.experimental.pallas.pallas_call',)
 _BLOCKING_METHODS = ('block_until_ready', 'item')
 _RAW_TRANSFERS = ('jax.device_get', 'jax.device_put',
                   'jax.numpy.asarray', 'jax.numpy.array',
@@ -167,6 +183,9 @@ class HotPathHostSyncChecker(Checker):
                          'use copy_to_host_async at dispatch and land '
                          'through _land')
                     continue
+            if resolves_to(imports, func, ALLOWED_DEVICE_DISPATCH):
+                # Kernel launch: async device dispatch, never a sync.
+                continue
             if resolves_to(imports, func, _RAW_TRANSFERS):
                 flag(node, f'raw device transfer '
                      f'{dotted_of(func)}(...)',

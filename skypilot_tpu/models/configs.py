@@ -165,6 +165,18 @@ class ModelConfig:
     # logical window through the same block-table gather).
     paged_block_size: int = 0
     paged_num_blocks: int = 0
+    # Paged-decode attention implementation. 'xla' (default): scatter
+    # writes + a gathered-window read feeding the shared attention
+    # math (transformer._attend_window). 'pallas': the fused
+    # ops/paged_attention kernel — the block-table walk happens in
+    # kernel and dequant+score+streaming-softmax+weighted-sum run in
+    # one VMEM pass per live block (multi-LoRA engines also route the
+    # adapter gather+dot through ops/fused_lora under this knob).
+    # 'pallas_interpret': the same kernels under the Pallas
+    # interpreter (CPU tier-1 pinning). Engines validate the knob at
+    # construction (paged-only; softcap rejected) — see
+    # models/inference.py _resolve_decode_kernel.
+    decode_kernel: str = 'xla'
 
     @property
     def head_dim(self) -> int:

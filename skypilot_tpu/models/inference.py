@@ -33,6 +33,7 @@ from skypilot_tpu.models.configs import ModelConfig, get_config
 from skypilot_tpu.models.transformer import Transformer
 from skypilot_tpu.observability import metrics as obs
 from skypilot_tpu.observability import tracing
+from skypilot_tpu.ops import paged_attention as paged_attention_lib
 from skypilot_tpu.parallel import sharding as sharding_lib
 from skypilot_tpu.serve import tenancy
 from skypilot_tpu.utils import fault_injection
@@ -226,6 +227,22 @@ _SLOT_PREEMPTS = obs.counter(
     'skytpu_engine_slot_preempts_total',
     'batch-tier requests preempted out of a decode slot by an '
     'interactive arrival and re-queued retryably')
+_DECODE_KERNEL = obs.gauge(
+    'skytpu_engine_decode_kernel',
+    'Decode attention implementation in effect: 0 = xla '
+    '(scatter/gather through the block pool), 1 = pallas (fused '
+    'block-table-walk kernel, ops/paged_attention), 2 = '
+    'pallas_interpret (the same kernel under the Pallas interpreter '
+    'on CPU)')
+_DECODE_FUSED_BYTES = obs.gauge(
+    'skytpu_engine_decode_fused_bytes',
+    'HBM bytes ONE fused decode step streams through the pallas '
+    'kernel: live pool blocks x (K+V payload + int8 scale rows) x '
+    'layers, each read exactly once per step '
+    '(ops/paged_attention.fused_hbm_bytes_per_step; 0 on the XLA '
+    'path, where the gathered-window intermediate adds a further '
+    'write+read on top of this floor)')
+_DECODE_KERNEL_CODE = {'xla': 0, 'pallas': 1, 'pallas_interpret': 2}
 
 # step_log cap: enough history for any interleaving assertion while
 # bounding a serve replica that decodes for weeks (the old unbounded
@@ -514,6 +531,44 @@ def temperature_sample(logits: jax.Array, rng: jax.Array,
     return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
 
 
+def _resolve_decode_kernel(decode_kernel: str, cfg) -> str:
+    """Validate + normalize the decode_kernel knob AT CONSTRUCTION —
+    unsupported combinations raise here with an actionable message,
+    never mid-dispatch inside a traced decode step.
+
+    'xla' (default) always works. 'pallas' requires the paged pool
+    (the kernel IS the block-table walk; contiguous decode has no
+    tables to prefetch) and no attention logit softcap (XLA-only, the
+    ops/flash_attention policy); off-TPU it degrades to
+    'pallas_interpret' so the same knob drives CPU tier-1 pinning and
+    real-chip serving. 'pallas_interpret' forces the interpreter
+    explicitly (tests)."""
+    if decode_kernel not in _DECODE_KERNEL_CODE:
+        raise ValueError(
+            f'unknown decode_kernel {decode_kernel!r}; expected one '
+            f"of {tuple(_DECODE_KERNEL_CODE)}")
+    if decode_kernel == 'xla':
+        return 'xla'
+    if not cfg.paged_block_size:
+        raise NotImplementedError(
+            "decode_kernel='pallas' requires the paged KV cache "
+            '(paged_block_size > 0): the fused kernel walks per-row '
+            'block tables in kernel — the contiguous layout has none. '
+            "Use decode_kernel='xla' or enable paging.")
+    if cfg.attn_logit_softcap:
+        raise NotImplementedError(
+            "decode_kernel='pallas' does not support attn_logit_"
+            'softcap (the tanh cap runs on the XLA path only — the '
+            'ops/flash_attention policy); use decode_kernel=\'xla\' '
+            'for softcapped models')
+    if decode_kernel == 'pallas' and jax.default_backend() != 'tpu':
+        # No chip: run the SAME kernel under the Pallas interpreter —
+        # slower but numerically the kernel, which is what lets tier-1
+        # and CPU smoke runs exercise the fused path.
+        return 'pallas_interpret'
+    return decode_kernel
+
+
 def _resolve_cfg_and_params(cfg: 'ModelConfig | str',
                             params: Optional[Any],
                             max_seq_len: Optional[int],
@@ -605,10 +660,21 @@ class InferenceEngine:
                  kv_quant: Optional[str] = None,
                  top_k: int = 0,
                  top_p: float = 0.0,
-                 mesh: Optional[Any] = None) -> None:
+                 mesh: Optional[Any] = None,
+                 decode_kernel: str = 'xla') -> None:
         self.cfg, self.params = _resolve_cfg_and_params(
             cfg, params, max_seq_len, rng_seed, quantize, kv_quant,
             mesh=mesh)
+        # Fused-vs-XLA decode attention (docs/performance.md "Fused
+        # decode kernel"): validated here, consumed inside
+        # Attention._paged_decode_attention. This engine is paged only
+        # when the caller's ModelConfig already carries pool geometry
+        # (ContinuousBatchingEngine owns the usual paged bring-up).
+        self.decode_kernel = _resolve_decode_kernel(decode_kernel,
+                                                    self.cfg)
+        self.cfg = dataclasses.replace(self.cfg,
+                                       decode_kernel=self.decode_kernel)
+        _DECODE_KERNEL.set(_DECODE_KERNEL_CODE[self.decode_kernel])
         self.batch_size = batch_size
         # Engine-level sampling filters (jit-static: one compile).
         self.top_k, self.top_p = top_k, top_p
@@ -944,7 +1010,8 @@ class ContinuousBatchingEngine:
                  max_adapters: int = 0,
                  adapter_rank: int = 0,
                  adapter_alpha: float = 16.0,
-                 adapter_targets: str = '') -> None:
+                 adapter_targets: str = '',
+                 decode_kernel: str = 'xla') -> None:
         import queue as queue_lib  # noqa: F401 (historical import)
         import threading
         # -------- multi-LoRA serving (docs/serving.md) --------
@@ -1047,6 +1114,21 @@ class ContinuousBatchingEngine:
         self.paged_stats = {'cow_copies': 0, 'blocks_reused': 0,
                             'prefill_chunks': 0, 'prefix_evictions': 0,
                             'spec_trimmed_blocks': 0}
+        # -------- fused decode kernel (docs/performance.md) --------
+        # decode_kernel='pallas' routes paged attention (and, on
+        # multi-LoRA engines, the adapter gather+dot) through the
+        # fused ops/ kernels. Validated HERE — after the paged-config
+        # replace, so the paged requirement checks the effective
+        # geometry — and stored into cfg so the model dispatches on
+        # it. XLA stays the default and the automatic fallback
+        # recommendation in every rejection message.
+        self.decode_kernel = _resolve_decode_kernel(decode_kernel,
+                                                    self.cfg)
+        self.cfg = dataclasses.replace(self.cfg,
+                                       decode_kernel=self.decode_kernel)
+        _DECODE_KERNEL.set(_DECODE_KERNEL_CODE[self.decode_kernel])
+        # Probe cache for decode_kernel_hlo_stats (one AOT compile).
+        self._kernel_probe_cache: Optional[Dict[str, Any]] = None
         # int8 block pool (the paged x int8-KV composition): the HBM
         # win multiplies — the pool holds ~(fp_bytes x head_dim) /
         # (head_dim + 4) times the tokens per byte on top of paged's
@@ -2505,6 +2587,51 @@ class ContinuousBatchingEngine:
         _TP_ALLREDUCE_BYTES.set(stats['all_reduce_bytes'])
         return stats
 
+    def fused_bytes_per_step(self) -> int:
+        """HBM bytes one fused decode step streams through the pallas
+        kernel at the CURRENT pool occupancy (0 on the XLA path /
+        contiguous engines) — the skytpu_engine_decode_fused_bytes
+        gauge value, re-published per tick."""
+        if self.decode_kernel == 'xla' or self._pool is None:
+            return 0
+        kv_quant = self.cfg.kv_cache_quant == 'int8'
+        return paged_attention_lib.fused_hbm_bytes_per_step(
+            self._pool.used, self.paged_block_size,
+            self.cfg.num_kv_heads, self.cfg.head_dim,
+            self.cfg.num_layers,
+            1 if kv_quant else jnp.dtype(self.cfg.dtype).itemsize,
+            kv_quant)
+
+    def decode_kernel_hlo_stats(self) -> Dict[str, Any]:
+        """Compile the all-slots decode step and count the
+        scatter/gather op cluster in its optimized HLO
+        (parallel/hlo_probe.gather_stats) — the compile-time proxy
+        showing the fused pallas call REPLACES the gathered-window
+        cluster: a decode_kernel='pallas' engine's program carries
+        fewer gather ops than its XLA twin's (the bench
+        --dryrun-serve-kernel row builds both and diffs the counts).
+        Same AOT-compile cost caveat as decode_hlo_stats; cached."""
+        from skypilot_tpu.parallel import hlo_probe
+        if self._kernel_probe_cache is not None:
+            return self._kernel_probe_cache
+        if self._cache is None:
+            self._cache = self._init_cache_for_mode()
+        tok = _upload([0] * self.num_slots, jnp.int32, self._repl)
+        pos = _upload([0] * self.num_slots, jnp.int32, self._repl)
+        temps = _upload([0.0] * self.num_slots, jnp.float32, self._repl)
+        tables = (self._table_array([None] * self.num_slots)
+                  if self.paged_block_size else None)
+        with (self.mesh if self.mesh is not None
+              else contextlib.nullcontext()):
+            compiled = self._decode.lower(
+                self.params, self._cache, tok, pos, temps,
+                jax.random.PRNGKey(0), tables).compile()
+        stats = hlo_probe.gather_stats(compiled.as_text())
+        stats['decode_kernel'] = self.decode_kernel
+        stats['fused_bytes_per_step'] = self.fused_bytes_per_step()
+        self._kernel_probe_cache = stats
+        return stats
+
     # ---------------- prefix export / pre-warm (preemption path) -----
     #
     # docs/resilience.md "Preemption lifecycle". Both methods touch the
@@ -3568,6 +3695,12 @@ class ContinuousBatchingEngine:
         # while recording is disabled is a no-op. Unconditional so a
         # single-chip engine reads the documented 1, not an unset 0.
         _TP_SIZE.set(self._tp)
+        _DECODE_KERNEL.set(_DECODE_KERNEL_CODE[self.decode_kernel])
+        if self.decode_kernel != 'xla' and self._pool is not None:
+            # Per-step fused-bytes gauge, recomputed per tick from live
+            # pool occupancy (re-set here, not only at construction —
+            # exporters usually enable after warmup, the PR-5 lesson).
+            _DECODE_FUSED_BYTES.set(self.fused_bytes_per_step())
         if self._tp > 1 and self._hlo_probe_cache is not None:
             _TP_COLLECTIVES.set(self._hlo_probe_cache['total'])
             _TP_ALLREDUCE_BYTES.set(

@@ -26,6 +26,8 @@ from flax import linen as nn
 
 from skypilot_tpu.models.configs import ModelConfig
 from skypilot_tpu.ops.flash_attention import flash_attention
+from skypilot_tpu.ops.fused_lora import fused_multi_lora
+from skypilot_tpu.ops.paged_attention import paged_decode_attention
 from skypilot_tpu.parallel import sharding
 
 Dtype = Any
@@ -234,14 +236,41 @@ class MultiLoRADenseGeneral(nn.Module):
         if adapter_ids is None:
             # init / adapter-less callers: every row is the identity.
             adapter_ids = jnp.zeros((x.shape[0],), jnp.int32)
-        a_sel = jnp.take(a_arr, adapter_ids, axis=0)   # (B, *in, r)
-        b_sel = jnp.take(b_arr, adapter_ids, axis=0)   # (B, r, *out)
-        z = jax.lax.dot_general(
-            x, a_sel.astype(_dtype(cfg)),
-            ((axis, tuple(range(1, n_in + 1))), ((0,), (0,))))
-        z = jax.lax.dot_general(
-            z, b_sel.astype(_dtype(cfg)),
-            (((z.ndim - 1,), (1,)), ((0,), (0,))))
+        if cfg.decode_kernel in ('pallas', 'pallas_interpret'):
+            # Fused gather+dot (ops/fused_lora): the per-row A/B tiles
+            # stream straight from the resident stack through a
+            # scalar-prefetched index map — no materialized
+            # a_sel/b_sel intermediates through HBM. Contracted input
+            # dims and feature dims flatten to one axis each (the dots
+            # are identical under the reshape); x's batch-leading
+            # layout is guaranteed because axis 0 is never contracted
+            # (projections contract trailing dims only).
+            slots_n = a_arr.shape[0]
+            in_elems = 1
+            for d in in_shape:
+                in_elems *= d
+            out_elems = 1
+            for d in features:
+                out_elems *= d
+            keep_shape = tuple(x.shape[i] for i in range(x.ndim)
+                               if i not in axis)
+            x_flat = x.reshape(keep_shape[0], -1, in_elems)
+            z = fused_multi_lora(
+                x_flat.astype(_dtype(cfg)),
+                a_arr.reshape(slots_n, in_elems, r).astype(_dtype(cfg)),
+                b_arr.reshape(slots_n, r, out_elems).astype(_dtype(cfg)),
+                adapter_ids,
+                interpret=cfg.decode_kernel == 'pallas_interpret')
+            z = z.reshape(keep_shape + features)
+        else:
+            a_sel = jnp.take(a_arr, adapter_ids, axis=0)  # (B, *in, r)
+            b_sel = jnp.take(b_arr, adapter_ids, axis=0)  # (B, r, *out)
+            z = jax.lax.dot_general(
+                x, a_sel.astype(_dtype(cfg)),
+                ((axis, tuple(range(1, n_in + 1))), ((0,), (0,))))
+            z = jax.lax.dot_general(
+                z, b_sel.astype(_dtype(cfg)),
+                (((z.ndim - 1,), (1,)), ((0,), (0,))))
         y = y + z * (cfg.lora_alpha / r)
         if self.use_bias:
             bias = self.param(
@@ -413,6 +442,76 @@ def _int8_quantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     q8 = jnp.round(x.astype(jnp.float32) / scale[..., None]).astype(
         jnp.int8)
     return q8, scale
+
+
+def _attend_window(cfg: ModelConfig, q: jax.Array, k_win: jax.Array,
+                   v_win: jax.Array, k_scale: Optional[jax.Array],
+                   v_scale: Optional[jax.Array],
+                   positions: jax.Array) -> jax.Array:
+    """Score/softmax/weighted-sum over one gathered-or-contiguous KV
+    window — the single XLA definition of the decode attention math,
+    and in particular of the int8 DEQUANT op order (`_int8_quantize`'s
+    consumer side). The contiguous path, the XLA paged path, and the
+    fused Pallas kernel's reference twin all run THIS function, so the
+    bit-identity contract between layouts (and the kernel's
+    tolerance/greedy contract against them) cannot drift — the PR-5
+    quantize-hoist lesson applied to dequant.
+
+    int8 op order (mirrored exactly by ops/paged_attention's kernels):
+    K/V convert int8 → compute dtype at the matmul read; the per-token
+    K scale applies to the fp32-accumulated scores AFTER the matmul
+    (it factors out of the contracted head_dim); the per-token V scale
+    folds into the probabilities (it cannot factor out of the summed
+    sequence dim), which then cast to the compute dtype before the V
+    matmul.
+
+    q: (B, T, H, D); k_win/v_win: (B, S, KV, D) (int8 when scales are
+    given); k_scale/v_scale: (B, S, KV) fp32 or None (together);
+    positions: (B, T). Returns (B, T, H, D).
+    """
+    batch, cur_len = q.shape[:2]
+    seq_len, kv_heads = k_win.shape[1], k_win.shape[2]
+    kv_quant = k_scale is not None
+    # Grouped-query attention directly against the unrepeated KV
+    # window: repeating kv→num_heads over the whole window would 4x
+    # (n_rep x) the HBM traffic of the op that dominates decode cost.
+    n_rep = cfg.num_heads // kv_heads
+    q_grouped = q.reshape(batch, cur_len, kv_heads, n_rep, cfg.head_dim)
+    # int8: the matmul reads int8 (the astype fuses into the HBM
+    # read); the per-token scale factors out of the contracted
+    # head_dim and is applied to the scores afterwards.
+    key_in = (k_win.astype(q.dtype) if kv_quant else k_win)
+    scores = jnp.einsum('bqkrd,bskd->bkrqs', q_grouped, key_in,
+                        preferred_element_type=jnp.float32)
+    if kv_quant:
+        scores = scores * k_scale.transpose(0, 2, 1)[:, :, None,
+                                                     None, :]
+    scores = scores * (cfg.head_dim**-0.5)
+    if cfg.attn_logit_softcap:
+        cap = cfg.attn_logit_softcap
+        scores = cap * jnp.tanh(scores / cap)
+    q_pos = positions[:, :, None]                          # (b, q, 1)
+    k_pos = jnp.arange(seq_len)[None, None, :]             # (1, 1, s)
+    mask = k_pos <= q_pos                                  # causal+fill
+    if cfg.sliding_window:
+        mask &= q_pos - k_pos < cfg.sliding_window
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if kv_quant:
+        # V's per-token scale cannot factor out of the summed s dim;
+        # fold it into the probabilities instead (elementwise, tiny
+        # next to the cache-streaming matmul it enables). Masked
+        # positions carry exactly-zero probs, so stale scale rows in
+        # scratch/freed blocks contribute exactly 0.
+        probs = probs * v_scale.transpose(0, 2, 1)[:, :, None,
+                                                   None, :]
+        probs = probs.astype(_dtype(cfg))
+        out = jnp.einsum('bkrqs,bskd->bqkrd', probs,
+                         v_win.astype(_dtype(cfg)))
+    else:
+        probs = probs.astype(v_win.dtype)
+        out = jnp.einsum('bkrqs,bskd->bqkrd', probs, v_win)
+    return out.reshape(batch, cur_len, cfg.num_heads, cfg.head_dim)
 
 
 class Attention(nn.Module):
@@ -589,46 +688,13 @@ class Attention(nn.Module):
         rebox(cached_key, key_box, key_arr)
         rebox(cached_value, value_box, value_arr)
 
-        # Grouped-query attention directly against the unrepeated KV
-        # cache: repeating kv→num_heads over the whole window would 4x
-        # (n_rep x) the HBM traffic of the op that dominates decode cost.
-        # q groups as (B, Q, KV, rep, D).
-        n_rep = cfg.num_heads // kv_heads
-        q_grouped = q.reshape(batch, cur_len, kv_heads, n_rep,
-                              cfg.head_dim)
-        # int8 cache: the matmul reads int8 (the astype fuses into the
-        # HBM read); the per-token scale factors out of the contracted
-        # head_dim and is applied to the scores afterwards.
-        key_in = (key_arr.astype(q.dtype) if kv_quant else key_arr)
-        scores = jnp.einsum('bqkrd,bskd->bkrqs', q_grouped, key_in,
-                            preferred_element_type=jnp.float32)
-        if kv_quant:
-            scores = scores * ks_arr.transpose(0, 2, 1)[:, :, None,
-                                                        None, :]
-        scores = scores * (cfg.head_dim**-0.5)
-        if cfg.attn_logit_softcap:
-            cap = cfg.attn_logit_softcap
-            scores = cap * jnp.tanh(scores / cap)
-        q_pos = positions[:, :, None]                          # (b, q, 1)
-        k_pos = jnp.arange(cfg.max_seq_len)[None, None, :]     # (1, 1, s)
-        mask = k_pos <= q_pos                                  # causal+fill
-        if cfg.sliding_window:
-            mask &= q_pos - k_pos < cfg.sliding_window
-        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        if kv_quant:
-            # V's per-token scale cannot factor out of the summed s dim;
-            # fold it into the probabilities instead (elementwise, tiny
-            # next to the cache-streaming matmul it enables).
-            probs = probs * vs_arr.transpose(0, 2, 1)[:, :, None,
-                                                      None, :]
-            probs = probs.astype(_dtype(cfg))
-            out = jnp.einsum('bkrqs,bskd->bqkrd', probs,
-                             value_arr.astype(_dtype(cfg)))
-        else:
-            probs = probs.astype(value_arr.dtype)
-            out = jnp.einsum('bkrqs,bskd->bqkrd', probs, value_arr)
-        return out.reshape(batch, cur_len, cfg.num_heads, cfg.head_dim)
+        # Score/softmax/weighted-sum over the full contiguous window:
+        # ONE shared op-order definition with the paged path
+        # (_attend_window), so the layouts' bit-identity contract holds
+        # by construction.
+        return _attend_window(cfg, q, key_arr, value_arr,
+                              ks_arr if kv_quant else None,
+                              vs_arr if kv_quant else None, positions)
 
     def _paged_decode_attention(self, q: jax.Array, k: jax.Array,
                                 v: jax.Array, positions: jax.Array,
@@ -754,51 +820,38 @@ class Attention(nn.Module):
                 v.reshape(-1, kv_heads, cfg.head_dim))
         rebox(cached_key, key_box, kf.reshape(cache_shape))
         rebox(cached_value, value_box, vf.reshape(cache_shape))
-        # ---- gather each row's logical window and attend ----
+        if cfg.decode_kernel in ('pallas', 'pallas_interpret'):
+            # Fused kernel: the block-table walk happens IN KERNEL
+            # (scalar-prefetched indices drive the K/V tile fetches),
+            # dequant+score+streaming-softmax+weighted-sum run in one
+            # VMEM pass per live block — no gathered (B, S, KV, D)
+            # intermediate through HBM. Streaming softmax reorders the
+            # reduction, so this path pins tolerance + greedy-token
+            # equivalence against the XLA twin below, not bit identity
+            # (tests/test_paged_attention.py, test_composition_matrix).
+            # Unsupported combos (softcap; non-paged) were refused at
+            # engine construction, never here mid-trace.
+            return paged_decode_attention(
+                q, kf.reshape(cache_shape), vf.reshape(cache_shape),
+                block_tables[:, :bps], positions,
+                k_scale=ksf.reshape(scale_shape) if kv_quant else None,
+                v_scale=vsf.reshape(scale_shape) if kv_quant else None,
+                window=cfg.sliding_window,
+                logit_softcap=cfg.attn_logit_softcap,
+                interpret=cfg.decode_kernel == 'pallas_interpret')
+        # ---- gather each row's logical window and attend (XLA) ----
         gidx = (block_tables[:, :bps, None] * bs +
                 jnp.arange(bs)[None, None, :]).reshape(batch, bps * bs)
         k_full = kf[gidx]                              # (B, S, KV, D)
         v_full = vf[gidx]
-        n_rep = cfg.num_heads // kv_heads
-        q_grouped = q.reshape(batch, cur_len, kv_heads, n_rep,
-                              cfg.head_dim)
-        # int8 pool: the matmul reads the gathered int8 (astype fuses
-        # into the read); per-token scales factor out of the contracted
-        # head_dim and apply to the scores — exactly the contiguous
-        # int8 math over the gathered window.
-        key_in = (k_full.astype(q.dtype) if kv_quant else k_full)
-        scores = jnp.einsum('bqkrd,bskd->bkrqs', q_grouped, key_in,
-                            preferred_element_type=jnp.float32)
-        if kv_quant:
-            ks_full = ksf[gidx][..., 0]                # (B, S, KV)
-            scores = scores * ks_full.transpose(0, 2, 1)[:, :, None,
-                                                         None, :]
-        scores = scores * (cfg.head_dim**-0.5)
-        if cfg.attn_logit_softcap:
-            cap = cfg.attn_logit_softcap
-            scores = cap * jnp.tanh(scores / cap)
-        q_pos = positions[:, :, None]                          # (b, q, 1)
-        k_pos = jnp.arange(bps * bs)[None, None, :]            # (1, 1, s)
-        mask = k_pos <= q_pos
-        if cfg.sliding_window:
-            mask &= q_pos - k_pos < cfg.sliding_window
-        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        if kv_quant:
-            # V's per-token scale folds into the probabilities (it
-            # cannot factor out of the summed s dim) — masked
-            # positions carry exactly-zero probs, so stale scale rows
-            # in scratch/freed blocks contribute exactly 0.
-            vs_full = vsf[gidx][..., 0]                # (B, S, KV)
-            probs = probs * vs_full.transpose(0, 2, 1)[:, :, None,
-                                                       None, :]
-            probs = probs.astype(_dtype(cfg))
-            out = jnp.einsum('bkrqs,bskd->bqkrd', probs,
-                             v_full.astype(_dtype(cfg)))
-        else:
-            probs = probs.astype(v_full.dtype)
-            out = jnp.einsum('bkrqs,bskd->bqkrd', probs, v_full)
-        return out.reshape(batch, cur_len, cfg.num_heads, cfg.head_dim)
+        # Score/softmax/weighted-sum over the gathered window: ONE
+        # shared op-order definition with the contiguous path
+        # (_attend_window) — exactly the contiguous (int8) math, so
+        # the layouts stay bit-identical by construction.
+        return _attend_window(cfg, q, k_full, v_full,
+                              ksf[gidx][..., 0] if kv_quant else None,
+                              vsf[gidx][..., 0] if kv_quant else None,
+                              positions)
 
 
 class SwiGLU(nn.Module):

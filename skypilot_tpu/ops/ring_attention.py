@@ -33,11 +33,15 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh
 
 from skypilot_tpu.parallel import sharding as sharding_lib
 
 _NEG_INF = -1e30
+
+_IMPLS = ('xla', 'pallas', 'pallas_interpret')
 
 
 def _chunk_update(q, k, v, o, m, l, *, sm_scale, mask_mode, q_offset,
@@ -64,20 +68,106 @@ def _chunk_update(q, k, v, o, m, l, *, sm_scale, mask_mode, q_offset,
     return o_new, m_new, l_new
 
 
+def _chunk_update_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
+                         l_ref, o_out, m_out, l_out, *, sm_scale,
+                         mask_mode):
+    """Pallas body for one (batch, head) tile of `_chunk_update`: the
+    score matmul, online-softmax rescale and weighted V-sum run in one
+    VMEM pass instead of XLA materializing the (B,H,Sq,Sk) score tensor
+    in HBM between ring hops. Op order mirrors `_chunk_update` exactly
+    (fp32 score accumulation; probs cast to v.dtype for the V matmul,
+    then widened back) so the two impls stay numerically twinned.
+    offs_ref is scalar-prefetched [q_offset, k_offset] — traced values
+    inside the fori_loop ring step, so they ride in SMEM rather than
+    being baked into the kernel."""
+    q = q_ref[0, :, 0, :]                             # (Sq, D)
+    k = k_ref[0, :, 0, :]                             # (Sk, D)
+    v = v_ref[0, :, 0, :]
+    m = m_ref[0, 0]                                   # (Sq,)
+    l = l_ref[0, 0]
+    o = o_ref[0, :, 0, :]                             # (Sq, D) f32
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    if mask_mode == 1:
+        s_q, s_k = s.shape
+        rows = offs_ref[0] + jax.lax.broadcasted_iota(
+            jnp.int32, (s_q, s_k), 0)
+        cols = offs_ref[1] + jax.lax.broadcasted_iota(
+            jnp.int32, (s_q, s_k), 1)
+        s = jnp.where(cols <= rows, s, _NEG_INF)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_cur)
+    p = jnp.exp(s - m_new[:, None])
+    alpha = jnp.exp(m - m_new)
+    l_out[0, 0] = l * alpha + jnp.sum(p, axis=-1)
+    m_out[0, 0] = m_new
+    o_out[0, :, 0, :] = (
+        o * alpha[:, None] +
+        jax.lax.dot_general(p.astype(v.dtype), v,
+                            (((1,), (0,)), ((), ()))).astype(jnp.float32))
+
+
+def _chunk_update_pallas(q, k, v, o, m, l, *, sm_scale, mask_mode,
+                         q_offset, k_offset, interpret):
+    """`_chunk_update` with the per-(batch, head) tile running as a
+    pallas kernel. Same signature/semantics; `interpret` threads through
+    to `pl.pallas_call` the way ops/flash_attention.py does, so the ring
+    composes with CPU fake-device shard_map tests."""
+    batch, s_q, heads, head_dim = q.shape
+    s_k = k.shape[1]
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(k_offset, jnp.int32)])
+    grid = (batch, heads)
+    qo_spec = pl.BlockSpec((1, s_q, 1, head_dim),
+                           lambda b, h, offs: (b, 0, h, 0))
+    kv_spec = pl.BlockSpec((1, s_k, 1, head_dim),
+                           lambda b, h, offs: (b, 0, h, 0))
+    ml_spec = pl.BlockSpec((1, 1, s_q), lambda b, h, offs: (b, h, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[qo_spec, kv_spec, kv_spec, qo_spec, ml_spec, ml_spec],
+        out_specs=[qo_spec, ml_spec, ml_spec],
+    )
+    kernel = functools.partial(_chunk_update_kernel, sm_scale=sm_scale,
+                               mask_mode=mask_mode)
+    o_new, m_new, l_new = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(o.shape, jnp.float32),
+            jax.ShapeDtypeStruct(m.shape, jnp.float32),
+            jax.ShapeDtypeStruct(l.shape, jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, q, k, v, o, m, l)
+    # Tuple, not list: the lax.cond skip branch in the ring step passes
+    # its carry through unchanged, and branch pytrees must match.
+    return o_new, m_new, l_new
+
+
 def ring_attention(q: jax.Array,
                    k: jax.Array,
                    v: jax.Array,
                    *,
                    axis_name: str = 'sp',
                    causal: bool = True,
-                   sm_scale: Optional[float] = None) -> jax.Array:
+                   sm_scale: Optional[float] = None,
+                   impl: str = 'xla') -> jax.Array:
     """Exact attention over a sequence-sharded ring. Call inside
     shard_map/SPMD with `axis_name` bound.
 
     Args: q/k/v (B, S_local, H, D) — the local sequence chunk, kv heads
     already folded to match q heads (GQA folding happens in the caller,
-    like ops/flash_attention.py). Returns (B, S_local, H, D) in q.dtype.
+    like ops/flash_attention.py). `impl` selects the per-hop chunk
+    update: 'xla' (default, einsum), 'pallas' (fused VMEM kernel) or
+    'pallas_interpret' (same kernel, interpreter mode — CPU tests).
+    Returns (B, S_local, H, D) in q.dtype.
     """
+    if impl not in _IMPLS:
+        raise ValueError(
+            f'ring_attention impl={impl!r}; expected one of {_IMPLS}')
     if sm_scale is None:
         sm_scale = q.shape[-1]**-0.5
     axis_size = jax.lax.psum(1, axis_name)
@@ -90,6 +180,12 @@ def ring_attention(q: jax.Array,
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
+    if impl == 'xla':
+        update = _chunk_update
+    else:
+        update = functools.partial(_chunk_update_pallas,
+                                   interpret=impl == 'pallas_interpret')
+
     def step(i, carry):
         o, m, l, k_cur, v_cur = carry
         # After i rotations, this device holds the K/V chunk originally on
@@ -100,15 +196,15 @@ def ring_attention(q: jax.Array,
 
         def attend_full(args):
             o, m, l = args
-            return _chunk_update(q, k_cur, v_cur, o, m, l,
-                                 sm_scale=sm_scale, mask_mode=0,
-                                 q_offset=q_offset, k_offset=k_offset)
+            return update(q, k_cur, v_cur, o, m, l,
+                          sm_scale=sm_scale, mask_mode=0,
+                          q_offset=q_offset, k_offset=k_offset)
 
         def attend_causal(args):
             o, m, l = args
-            return _chunk_update(q, k_cur, v_cur, o, m, l,
-                                 sm_scale=sm_scale, mask_mode=1,
-                                 q_offset=q_offset, k_offset=k_offset)
+            return update(q, k_cur, v_cur, o, m, l,
+                          sm_scale=sm_scale, mask_mode=1,
+                          q_offset=q_offset, k_offset=k_offset)
 
         def skip(args):
             return args
@@ -143,7 +239,8 @@ def ring_attention_ambient(q: jax.Array,
                            v: jax.Array,
                            *,
                            causal: bool = True,
-                           sm_scale: Optional[float] = None) -> jax.Array:
+                           sm_scale: Optional[float] = None,
+                           impl: str = 'xla') -> jax.Array:
     """Ring attention over the ambient mesh (callers enter it with
     `jax.set_mesh(mesh)`): the form model code uses, so Flax modules don't
     thread Mesh objects. Specs follow the canonical activation layout."""
@@ -151,9 +248,9 @@ def ring_attention_ambient(q: jax.Array,
     # table (parallel/sharding.py) — no local copy of the mapping.
     spec = sharding_lib.spec_for('batch', 'seq', 'act_heads', None)
     fn = functools.partial(ring_attention, axis_name='sp', causal=causal,
-                           sm_scale=sm_scale)
-    return jax.shard_map(fn, in_specs=(spec, spec, spec), out_specs=spec,
-                         check_vma=False)(q, k, v)
+                           sm_scale=sm_scale, impl=impl)
+    return sharding_lib.shard_map(fn, in_specs=(spec, spec, spec),
+                                  out_specs=spec)(q, k, v)
 
 
 def ring_attention_sharded(mesh: Mesh,
@@ -162,17 +259,18 @@ def ring_attention_sharded(mesh: Mesh,
                            v: jax.Array,
                            *,
                            causal: bool = True,
-                           sm_scale: Optional[float] = None) -> jax.Array:
+                           sm_scale: Optional[float] = None,
+                           impl: str = 'xla') -> jax.Array:
     """Convenience wrapper: shard_map over the framework mesh with the
     canonical activation layout (batch on dp/fsdp, sequence on sp, heads
     on tp). Inputs are global arrays; XLA inserts the resharding."""
     spec = sharding_lib.spec_for('batch', 'seq', 'act_heads', None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
-        in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+        sharding_lib.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec)
     def _sharded(q, k, v):
         return ring_attention(q, k, v, axis_name='sp', causal=causal,
-                              sm_scale=sm_scale)
+                              sm_scale=sm_scale, impl=impl)
 
     return _sharded(q, k, v)

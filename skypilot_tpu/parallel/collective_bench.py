@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from skypilot_tpu.parallel import sharding as sharding_lib
+
 COLLECTIVES = ('psum', 'all_gather', 'reduce_scatter', 'ppermute')
 
 
@@ -59,15 +61,15 @@ def _build_op(name: str, mesh: Mesh):
         perm = [(i, (i + 1) % n) for i in range(n)]
         return jax.lax.ppermute(x, axis, perm)
 
-    # check_vma off: all_gather's output is bytewise-replicated but JAX's
-    # varying-axis inference can't prove it; the check is about sharding
-    # hygiene, irrelevant to a timing kernel.
+    # Replication checking off (sharding_lib.shard_map disables it):
+    # all_gather's output is bytewise-replicated but JAX's varying-axis
+    # inference can't prove it; the check is about sharding hygiene,
+    # irrelevant to a timing kernel.
     return jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P(axis),
-                      out_specs=P(axis) if name in ('reduce_scatter',
-                                                    'ppermute')
-                      else (P() if name == 'psum' else P(None)),
-                      check_vma=False))
+        sharding_lib.shard_map(
+            body, mesh=mesh, in_specs=P(axis),
+            out_specs=P(axis) if name in ('reduce_scatter', 'ppermute')
+            else (P() if name == 'psum' else P(None))))
 
 
 def run_bench(size_mb: float = 64.0,

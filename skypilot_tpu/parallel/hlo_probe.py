@@ -101,6 +101,43 @@ def collective_stats(hlo_text: str) -> Dict[str, Any]:
     return stats
 
 
+# Memory-layout op mnemonics for gather_stats. Matched with a
+# lookahead '(' and a (?<![\w-]) guard so collective names never
+# alias in ('all-gather(' must not count as 'gather(', 'reduce-
+# scatter(' not as 'scatter('); 'dynamic-update-slice(' never
+# contains 'dynamic-slice(' so the pair needs no ordering.
+_GATHER_OPS = ('gather', 'scatter', 'dynamic-slice',
+               'dynamic-update-slice')
+
+
+def gather_stats(hlo_text: str) -> Dict[str, Any]:
+    """Count the scatter/gather op cluster in optimized HLO text —
+    the ops the XLA paged decode path spends on materializing each
+    row's gathered KV window (and scattering the chunk writes), which
+    the fused pallas kernel replaces with in-kernel block-table walks.
+
+    Returns {'gather': n, 'scatter': n, 'dynamic_slice': n,
+    'dynamic_update_slice': n, 'total': n}. Counts instruction heads
+    only (after the '=' like collective_stats), so fused-computation
+    BODIES still count their ops — on CPU the interpreter-mode pallas
+    program and the XLA program both print flat entry computations and
+    the diff is what the bench row pins."""
+    stats: Dict[str, Any] = {op.replace('-', '_'): 0
+                             for op in _GATHER_OPS}
+    patterns = [(op, re.compile(r'(?<![\w-])' + re.escape(op) + r'\('))
+                for op in _GATHER_OPS]
+    for line in hlo_text.splitlines():
+        if '=' not in line:
+            continue
+        rhs = line.partition('=')[2]
+        for op, pat in patterns:
+            if pat.search(rhs):
+                stats[op.replace('-', '_')] += 1
+    stats['total'] = sum(stats[op.replace('-', '_')]
+                         for op in _GATHER_OPS)
+    return stats
+
+
 def partition_scatter_count(hlo_text: str,
                             shards: Optional[int] = None) -> int:
     """Count partition-addressed scatter slices: ops whose result is an

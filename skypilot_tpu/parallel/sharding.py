@@ -41,6 +41,43 @@ def logical_axis_rules() -> List[Tuple[str, object]]:
     return list(LOGICAL_AXIS_RULES)
 
 
+def shard_map(fn, *, mesh: Optional[Mesh] = None, in_specs, out_specs):
+    """`shard_map` across jax versions, the single call site for the
+    whole framework. Newer jax exposes `jax.shard_map` (ambient-mesh
+    capable, `check_vma=` kwarg); 0.4.x ships it as
+    `jax.experimental.shard_map.shard_map` (explicit mesh required,
+    `check_rep=` kwarg). `mesh=None` uses the ambient mesh — on 0.4.x
+    that resolves the `with mesh:` context at trace time. Replication
+    checking is disabled either way: callers here wrap collectives whose
+    variance the checker can't infer (same rationale as the check_vma
+    note in collective_bench)."""
+    if hasattr(jax, 'shard_map'):
+        kwargs = dict(in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False)
+        if mesh is not None:
+            kwargs['mesh'] = mesh
+        return jax.shard_map(fn, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    if mesh is None:
+        from jax._src import mesh as _mesh_lib
+        mesh = _mesh_lib.thread_resources.env.physical_mesh
+        if mesh.empty:
+            raise ValueError(
+                'shard_map with mesh=None needs an ambient mesh: pass '
+                'mesh= or enter a `with mesh:` / use_mesh(mesh) context')
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
+def use_mesh(mesh: Mesh):
+    """Ambient-mesh context manager across jax versions: `jax.set_mesh`
+    where it exists, else the Mesh object itself (the 0.4.x context
+    manager that sets thread_resources for pjit and `shard_map` above)."""
+    if hasattr(jax, 'set_mesh'):
+        return jax.set_mesh(mesh)
+    return mesh
+
+
 def spec_for(*logical_axes: Optional[str]) -> PartitionSpec:
     """PartitionSpec for a tuple of logical axis names."""
     rules = dict((k, v) for k, v in LOGICAL_AXIS_RULES if k is not None)

@@ -197,7 +197,8 @@ class InferenceServer:
                  max_adapters: int = 0,
                  adapter_rank: int = 0,
                  adapter_alpha: float = 16.0,
-                 adapter_targets: str = '') -> None:
+                 adapter_targets: str = '',
+                 decode_kernel: str = 'xla') -> None:
         from skypilot_tpu.models.inference import (
             ContinuousBatchingEngine, load_params_from_checkpoint)
         from skypilot_tpu.models import get_config
@@ -259,7 +260,8 @@ class InferenceServer:
                                                max_adapters=max_adapters,
                                                adapter_rank=adapter_rank,
                                                adapter_alpha=adapter_alpha,
-                                               adapter_targets=adapter_targets)
+                                               adapter_targets=adapter_targets,
+                                               decode_kernel=decode_kernel)
         self.tier = tier
         self.tokenizer_kind = tokenizer
         self._hf_tokenizer = None
@@ -1792,6 +1794,17 @@ def main(argv=None) -> int:
                         help='comma list of adapted projections from '
                              '{q,k,v,o,gate,up,down} (default: the '
                              "model config's lora_targets)")
+    parser.add_argument('--decode-kernel', default='xla',
+                        choices=['xla', 'pallas', 'pallas_interpret'],
+                        help='paged decode attention kernel: xla '
+                             '(default; gather + einsum) or pallas '
+                             '(fused VMEM block-table walk — dequant, '
+                             'score, softmax and weighted sum in one '
+                             'pass; also fuses resident multi-LoRA '
+                             'gather+dot). Requires --paged-block-size; '
+                             'off-TPU, pallas degrades to the '
+                             'interpreter twin (docs/performance.md '
+                             '"Fused decode kernel")')
     parser.add_argument('--preempt-drain-timeout', type=float,
                         default=serve_constants
                         .preempt_notice_budget_seconds(),
@@ -1831,7 +1844,8 @@ def main(argv=None) -> int:
                              max_adapters=args.max_adapters,
                              adapter_rank=args.adapter_rank,
                              adapter_alpha=args.adapter_alpha,
-                             adapter_targets=args.adapter_targets)
+                             adapter_targets=args.adapter_targets,
+                             decode_kernel=args.decode_kernel)
     logger.info('sampling filters: top_k=%s top_p=%s (0 = off)',
                 args.top_k, args.top_p)
     # Preemption pre-warm BEFORE ready: a replacement replica restores

@@ -44,6 +44,12 @@ CELLS = [
     ('async3', dict(paged_block_size=8, kv_quant='int8',
                     async_depth=3)),
     ('chunkedprefill', dict(paged_block_size=8, prefill_chunk=4)),
+    # Fused pallas decode kernel under tp (ISSUE 18): GSPMD runs the
+    # interpreter kernel over gathered inputs on fake devices (the
+    # replication note in docs/performance.md), so correctness — the
+    # greedy stream vs the single-chip pallas engine — is what the tp
+    # cell pins.
+    ('pallas-paged', dict(paged_block_size=8, decode_kernel='pallas')),
 ]
 
 

@@ -107,7 +107,8 @@ class TestStructuredSkip:
         assert result['combo'] == {'kv_quant': 'int8',
                                    'speculative': 0,
                                    'paged_block_size': 7,
-                                   'async_depth': 3}
+                                   'async_depth': 3,
+                                   'decode_kernel': 'xla'}
         # Deterministic skip ⇒ exactly one worker attempt.
         assert 'attempt 2/' not in proc.stderr
 
@@ -266,6 +267,52 @@ class TestFleetDryrunDispatch:
         assert 'paged_block_size' in row['reason']
         assert row['combo'] == {'paged_block_size': 8,
                                 'prefix_cache': 8}
+
+    def test_dryrun_serve_kernel_skips_tpu_preflight(self, monkeypatch):
+        """--dryrun-serve-kernel is the fused-pallas-decode proxy
+        (interpreter mode, CPU-only by design): the no-preflight
+        dryrun supervisor, never the TPU probe ladder."""
+        bench = _load_bench()
+        calls = {}
+
+        def fake_dryrun(argv):
+            calls['dry'] = argv
+            return 0
+
+        monkeypatch.setattr(bench, '_supervise_dryrun', fake_dryrun)
+        monkeypatch.setattr(
+            bench, '_supervise',
+            lambda argv: (_ for _ in ()).throw(
+                AssertionError('TPU preflight path taken')))
+        monkeypatch.setattr(sys, 'argv',
+                            ['bench.py', '--dryrun-serve-kernel'])
+        assert bench.main() == 0
+        assert calls['dry'] == ['--dryrun-serve-kernel']
+
+    def test_dryrun_serve_kernel_skip_on_unconstructable_engine(
+            self, monkeypatch, capsys):
+        """An engine combination the constructor rejects (e.g. the
+        pallas knob on a config the kernel gates out) is a
+        deterministic verdict: the structured {"skipped": true} line
+        with the combo and rc=3, never the retry ladder."""
+        bench = _load_bench()
+        from skypilot_tpu.models import inference as inference_lib
+
+        def boom(*_a, **_kw):
+            raise NotImplementedError(
+                "decode_kernel='pallas' requires a paged KV pool")
+
+        monkeypatch.setattr(inference_lib, 'ContinuousBatchingEngine',
+                            boom)
+        rc = bench._dryrun_serve_kernel(
+            bench._parse_args(['--dryrun-serve-kernel', '--worker']))
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        row = json.loads(out)
+        assert rc == 3
+        assert row['skipped'] is True
+        assert 'serve-kernel' in row['reason']
+        assert row['combo'] == {'decode_kernel': 'pallas',
+                                'paged_block_size': 8}
 
     def test_dryrun_trace_skips_tpu_preflight(self, monkeypatch):
         """--dryrun-trace is the end-to-end tracing proxy (CPU-only by

@@ -422,6 +422,129 @@ class TestDeepRingWedgeRecovery:
 
 
 # ---------------------------------------------------------------------
+# Fused pallas decode kernel cells (ISSUE 18): decode_kernel='pallas'
+# across the matrix. On CPU the knob auto-degrades to the Pallas
+# INTERPRETER ('pallas_interpret') — same kernel program, interpreted —
+# which is what makes these cells tier-1. The pin is greedy-token
+# equivalence to the same-knobs XLA engine via the shared reference
+# streams: streaming softmax reorders reductions, so bit identity of
+# logits is NOT the contract (ops/paged_attention.py docstring);
+# identical greedy streams over the full window are.
+# ---------------------------------------------------------------------
+
+_PALLAS_CELLS = [
+    ('pallas-paged', dict(paged_block_size=8)),
+    ('pallas-paged-int8', dict(paged_block_size=8, kv_quant='int8')),
+    ('pallas-paged-spec', dict(paged_block_size=8, speculative=3)),
+    ('pallas-paged-int8-async3',
+     dict(paged_block_size=8, kv_quant='int8', async_depth=3)),
+    ('pallas-paged-chunkedprefill',
+     dict(paged_block_size=8, prefill_chunk=4)),
+]
+
+
+class TestPallasDecodeKernel:
+
+    @pytest.mark.parametrize('name,kw', _PALLAS_CELLS,
+                             ids=[c[0] for c in _PALLAS_CELLS])
+    def test_cell_matches_xla_stream(self, refs, name, kw):
+        ref = refs['int8' if 'int8' in name else '']
+        engine = _engine(decode_kernel='pallas', **kw)
+        try:
+            # CPU run: 'pallas' resolved to the interpreter twin.
+            assert engine.decode_kernel == 'pallas_interpret'
+            assert engine.cfg.decode_kernel == 'pallas_interpret'
+            got, stats = engine.generate(PROMPT, max_new_tokens=16)
+            assert got == ref[:16], (name, got)
+            assert stats['new_tokens'] == 16
+            engine._pool.check()  # pylint: disable=protected-access
+        finally:
+            engine.stop()
+
+    def test_multi_lora_cell_matches_xla_twin(self):
+        """decode_kernel='pallas' also swaps MultiLoRADenseGeneral onto
+        the fused gather+dot kernel; a mixed base+adapter batch must
+        stream identically to the XLA engine sharing its params."""
+        import jax.numpy as jnp
+        import numpy as np
+        from flax import linen as nn
+        from skypilot_tpu.models.transformer import Transformer
+        from skypilot_tpu.serve import tenancy
+        lora_kw = dict(adapter_rank=4, adapter_alpha=8.0,
+                       adapter_targets='q,v')
+        lora_cfg = _cfg(lora_rank=4, lora_alpha=8.0, lora_targets='q,v',
+                        decode=True)
+        variables = nn.unbox(Transformer(lora_cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32),
+            jnp.zeros((1, 8), jnp.int32)))
+        template = tenancy.adapter_tree_from_lora_params(
+            variables['params'])
+        leaves, treedef = jax.tree.flatten(template)
+        keys = jax.random.split(jax.random.PRNGKey(42), len(leaves))
+        tree = jax.tree.unflatten(treedef, [
+            np.asarray(jax.random.normal(k, leaf.shape, jnp.float32))
+            * 0.05 for k, leaf in zip(keys, leaves)])
+
+        xla = _engine(paged_block_size=8, max_adapters=2, **lora_kw)
+        pal = _engine(paged_block_size=8, max_adapters=2,
+                      decode_kernel='pallas', params=xla.params,
+                      **lora_kw)
+        try:
+            for engine in (xla, pal):
+                engine.load_adapter('ad0', tree)
+            for adapter in (None, 'ad0'):
+                ref, _ = xla.generate(PROMPT, max_new_tokens=12,
+                                      adapter=adapter)
+                got, _ = pal.generate(PROMPT, max_new_tokens=12,
+                                      adapter=adapter)
+                assert got == ref, (adapter, got, ref)
+        finally:
+            xla.stop()
+            pal.stop()
+
+    def test_rejects_non_paged_engine(self):
+        with pytest.raises(NotImplementedError, match='paged'):
+            _engine(decode_kernel='pallas')
+
+    def test_rejects_unknown_kernel(self):
+        with pytest.raises(ValueError, match='decode_kernel'):
+            _engine(paged_block_size=8, decode_kernel='fused')
+
+    def test_rejects_softcap(self):
+        from skypilot_tpu.models.inference import ContinuousBatchingEngine
+        with pytest.raises(NotImplementedError, match='softcap'):
+            ContinuousBatchingEngine(
+                _cfg(attn_logit_softcap=30.0), num_slots=2,
+                paged_block_size=8, decode_kernel='pallas')
+
+    def test_kernel_probe_eliminates_pool_window_gathers(self, refs):
+        """The compile-time perf proxy (chip unreachable): the fused
+        kernel's compiled decode step must carry strictly FEWER gather
+        ops than the XLA twin's — the pool-window gather
+        (`kf[gidx]`/`vf[gidx]`) is what the in-kernel table walk
+        deletes. Pinned on 'gather' specifically: interpreter-mode
+        emulation adds dynamic-slices on CPU, so 'total' is not
+        comparable across kernels."""
+        xla = _engine(paged_block_size=8)
+        pal = _engine(paged_block_size=8, decode_kernel='pallas')
+        try:
+            xs = xla.decode_kernel_hlo_stats()
+            ps = pal.decode_kernel_hlo_stats()
+            assert xs['decode_kernel'] == 'xla'
+            assert ps['decode_kernel'] == 'pallas_interpret'
+            assert ps['gather'] < xs['gather'], (ps, xs)
+            assert ps['fused_bytes_per_step'] > 0
+            assert xs['fused_bytes_per_step'] == 0
+            # Gauge parity: the engine's public accounting agrees with
+            # the probe's snapshot.
+            assert pal.fused_bytes_per_step() == \
+                ps['fused_bytes_per_step']
+        finally:
+            xla.stop()
+            pal.stop()
+
+
+# ---------------------------------------------------------------------
 # Tensor-parallel sharded cells (ISSUE 8): every composition must also
 # survive SHARDING. tests/sharded_driver.py runs the whole tp=2 matrix
 # once in a subprocess on 8 fake CPU devices (the sharded_subprocess
@@ -430,7 +553,7 @@ class TestDeepRingWedgeRecovery:
 # ---------------------------------------------------------------------
 
 _SHARDED_CELLS = ['contig', 'paged', 'int8', 'paged-int8', 'spec',
-                  'async3', 'chunkedprefill']
+                  'async3', 'chunkedprefill', 'pallas-paged']
 
 
 @pytest.mark.sharded

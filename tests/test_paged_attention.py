@@ -1,0 +1,240 @@
+"""Unit numerics for the fused paged-decode pallas kernel (interpreter
+mode) against a dense reference built straight from the pool + block
+tables, plus the fused stacked-LoRA kernel and the ring-attention pallas
+chunk update. Engine-level greedy-equivalence lives in
+tests/test_composition_matrix.py; this file pins the kernels themselves:
+tolerances, masking, int8 dequant op order, GQA folding, rejection
+surfaces and the HBM-bytes accounting helper.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.ops.fused_lora import fused_multi_lora
+from skypilot_tpu.ops.paged_attention import (fused_hbm_bytes_per_step,
+                                              paged_decode_attention)
+
+# Kernel-vs-reference tolerance: streaming softmax reorders the
+# reduction vs the one-shot reference softmax, so equality is
+# tolerance-level (measured ~2.4e-7 fp / ~1.8e-7 int8 on these shapes);
+# 2e-6 pins the contract with headroom for BLAS variation.
+_ATOL = 2e-6
+
+
+def _pool_setup(batch=2, block_size=8, blocks_per_seq=4, kv_heads=2,
+                n_rep=2, head_dim=16, cur_len=1, seed=0):
+    """A tiny pool with per-row block tables and positions. Unused table
+    tail entries deliberately alias block 0 (the engine's scratch
+    block), so any leak of masked/stale blocks shows up as a numeric
+    mismatch."""
+    num_blocks = batch * blocks_per_seq + 3
+    heads = kv_heads * n_rep
+    keys = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(keys[0], (batch, cur_len, heads, head_dim),
+                          jnp.float32)
+    k_pool = jax.random.normal(
+        keys[1], (num_blocks, block_size, kv_heads, head_dim),
+        jnp.float32)
+    v_pool = jax.random.normal(
+        keys[2], (num_blocks, block_size, kv_heads, head_dim),
+        jnp.float32)
+    # Distinct physical blocks per row, shuffled so logical order !=
+    # physical order (the table walk is what's under test).
+    perm = np.random.RandomState(seed).permutation(num_blocks - 1) + 1
+    tables = perm[:batch * blocks_per_seq].reshape(batch, blocks_per_seq)
+    positions = np.stack([
+        np.arange(cur_len) + 13,
+        np.arange(cur_len) + (blocks_per_seq * block_size - cur_len - 1),
+    ])[:batch]
+    # Zero out table entries wholly past each row's last position: the
+    # engine never hands the kernel ids for never-written blocks.
+    for b in range(batch):
+        last = positions[b].max()
+        for i in range(blocks_per_seq):
+            if i * block_size > last:
+                tables[b, i] = 0
+    return (q, k_pool, v_pool, jnp.asarray(tables, jnp.int32),
+            jnp.asarray(positions, jnp.int32))
+
+
+def _dense_reference(q, k_pool, v_pool, tables, positions, k_scale=None,
+                     v_scale=None, window=0):
+    """One-shot-softmax reference with the documented int8 op order:
+    dequant on read, K scale on fp32 scores after the matmul, V scale
+    folded into probs before the (compute-dtype) V matmul."""
+    batch, cur_len, heads, head_dim = q.shape
+    _, block_size, kv_heads, _ = k_pool.shape
+    n_rep = heads // kv_heads
+    seq = tables.shape[1] * block_size
+    k_full = k_pool[tables].reshape(batch, seq, kv_heads, head_dim)
+    v_full = v_pool[tables].reshape(batch, seq, kv_heads, head_dim)
+    s = jnp.einsum('btkrd,bskd->bkrts',
+                   q.reshape(batch, cur_len, kv_heads, n_rep, head_dim),
+                   k_full.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    if k_scale is not None:
+        ks = k_scale[tables].reshape(batch, seq, kv_heads)
+        s = s * ks.transpose(0, 2, 1)[:, :, None, None, :]
+    s = s * head_dim ** -0.5
+    rows = positions[:, None, None, :, None]
+    cols = jnp.arange(seq)[None, None, None, None, :]
+    keep = cols <= rows
+    if window:
+        keep &= rows - cols < window
+    s = jnp.where(keep, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    if v_scale is not None:
+        vs = v_scale[tables].reshape(batch, seq, kv_heads)
+        p = p * vs.transpose(0, 2, 1)[:, :, None, None, :]
+    o = jnp.einsum('bkrts,bskd->btkrd', p.astype(q.dtype),
+                   v_full.astype(q.dtype))
+    return o.reshape(batch, cur_len, heads, head_dim)
+
+
+def _quantize_pool(pool):
+    """Per-(block, token, kv-head) symmetric int8, the pool layout the
+    engine stores (`_int8_quantize` writ small)."""
+    amax = jnp.max(jnp.abs(pool), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(pool / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+class TestFusedPagedDecode:
+
+    @pytest.mark.parametrize('cur_len', [1, 4])
+    def test_matches_dense_reference_fp(self, cur_len):
+        q, kp, vp, tables, pos = _pool_setup(cur_len=cur_len)
+        out = paged_decode_attention(q, kp, vp, tables, pos,
+                                     interpret=True)
+        ref = _dense_reference(q, kp, vp, tables, pos)
+        assert out.shape == q.shape and out.dtype == q.dtype
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=_ATOL, rtol=_ATOL)
+
+    @pytest.mark.parametrize('cur_len', [1, 3])
+    def test_matches_dense_reference_int8(self, cur_len):
+        q, kp, vp, tables, pos = _pool_setup(cur_len=cur_len, seed=1)
+        kq, ks = _quantize_pool(kp)
+        vq, vs = _quantize_pool(vp)
+        out = paged_decode_attention(q, kq, vq, tables, pos,
+                                     k_scale=ks, v_scale=vs,
+                                     interpret=True)
+        ref = _dense_reference(q, kq, vq, tables, pos,
+                               k_scale=ks[..., 0], v_scale=vs[..., 0])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=_ATOL, rtol=_ATOL)
+
+    def test_sliding_window(self):
+        q, kp, vp, tables, pos = _pool_setup(seed=2)
+        out = paged_decode_attention(q, kp, vp, tables, pos, window=10,
+                                     interpret=True)
+        ref = _dense_reference(q, kp, vp, tables, pos, window=10)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=_ATOL, rtol=_ATOL)
+        # And the window actually changes the answer vs full causal.
+        full = paged_decode_attention(q, kp, vp, tables, pos,
+                                      interpret=True)
+        assert float(jnp.max(jnp.abs(out - full))) > 1e-3
+
+    def test_stale_block_ids_are_inert(self):
+        # Redirect every table entry past the row's position at a
+        # garbage block full of huge values: the causal mask must keep
+        # it out of the recurrence (the wash-out property the module
+        # docstring proves).
+        q, kp, vp, tables, pos = _pool_setup(seed=3)
+        ref = paged_decode_attention(q, kp, vp, tables, pos,
+                                     interpret=True)
+        kp2 = kp.at[0].set(100.0)
+        vp2 = vp.at[0].set(100.0)
+        out = paged_decode_attention(q, kp2, vp2, tables, pos,
+                                     interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=_ATOL, rtol=_ATOL)
+
+    def test_rejects_softcap(self):
+        q, kp, vp, tables, pos = _pool_setup()
+        with pytest.raises(NotImplementedError, match='softcap'):
+            paged_decode_attention(q, kp, vp, tables, pos,
+                                   logit_softcap=30.0, interpret=True)
+
+    def test_rejects_lone_scale(self):
+        q, kp, vp, tables, pos = _pool_setup()
+        _, ks = _quantize_pool(kp)
+        with pytest.raises(ValueError, match='together'):
+            paged_decode_attention(q, kp, vp, tables, pos, k_scale=ks,
+                                   interpret=True)
+
+    def test_rejects_indivisible_heads(self):
+        q, kp, vp, tables, pos = _pool_setup()
+        with pytest.raises(ValueError, match='divisible'):
+            paged_decode_attention(q[:, :, :3], kp, vp, tables, pos,
+                                   interpret=True)
+
+    def test_fused_hbm_bytes_accounting(self):
+        # fp16 pool: 2 payloads × bs·KV·D·2 bytes per block per layer.
+        assert fused_hbm_bytes_per_step(
+            live_blocks=10, block_size=16, kv_heads=2, head_dim=64,
+            num_layers=4, payload_itemsize=2, kv_quant=False) == \
+            10 * (2 * 16 * 2 * 64 * 2) * 4
+        # int8: 1-byte payloads plus fp32 scale rows.
+        assert fused_hbm_bytes_per_step(
+            live_blocks=3, block_size=8, kv_heads=2, head_dim=32,
+            num_layers=2, payload_itemsize=1, kv_quant=True) == \
+            3 * (2 * 8 * 2 * 32 + 2 * 8 * 2 * 4) * 2
+
+
+class TestFusedMultiLoRA:
+
+    def test_bit_exact_vs_gather_path(self):
+        """The fused kernel computes x@A@B per row with A/B selected by
+        adapter id — same accumulation order as the XLA take +
+        dot_general path, so equality is BIT-exact, not tolerance."""
+        slots, d_in, rank, d_out, batch, seq = 3, 16, 4, 24, 5, 2
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        x = jax.random.normal(keys[0], (batch, seq, d_in), jnp.float32)
+        a = jax.random.normal(keys[1], (slots, d_in, rank), jnp.float32)
+        b = jax.random.normal(keys[2], (slots, rank, d_out), jnp.float32)
+        ids = jnp.asarray([0, 2, 1, 2, 0], jnp.int32)
+        out = fused_multi_lora(x, a, b, ids, interpret=True)
+        ref = jnp.einsum('bsr,bro->bso',
+                         jnp.einsum('bsi,bir->bsr', x, a[ids]), b[ids])
+        assert out.shape == (batch, seq, d_out)
+        assert float(jnp.max(jnp.abs(out - ref))) == 0.0
+
+    def test_slot_zero_identity_delta(self):
+        # Engines zero-init slot 0 adapters; the fused path must return
+        # an exactly-zero delta for base traffic.
+        x = jnp.ones((2, 1, 8), jnp.float32)
+        a = jnp.zeros((2, 8, 2), jnp.float32)
+        b = jnp.zeros((2, 2, 8), jnp.float32)
+        out = fused_multi_lora(x, a, b, jnp.zeros((2,), jnp.int32),
+                               interpret=True)
+        assert float(jnp.max(jnp.abs(out))) == 0.0
+
+
+class TestRingPallasChunkUpdate:
+
+    @pytest.mark.parametrize('causal', [False, True])
+    def test_pallas_impl_bit_matches_xla(self, causal):
+        from skypilot_tpu.parallel.mesh import MeshConfig, build_mesh
+        from skypilot_tpu.ops.ring_attention import ring_attention_sharded
+        mesh = build_mesh(MeshConfig(sp=4), jax.devices()[:4])
+        ks = jax.random.split(jax.random.PRNGKey(7), 3)
+        q, k, v = (jax.random.normal(kk, (2, 64, 4, 8), jnp.float32)
+                   for kk in ks)
+        ref = ring_attention_sharded(mesh, q, k, v, causal=causal)
+        pal = ring_attention_sharded(mesh, q, k, v, causal=causal,
+                                     impl='pallas_interpret')
+        # The pallas chunk update mirrors the XLA einsum op-for-op
+        # inside the same ring recurrence → bit-identical.
+        assert float(jnp.max(jnp.abs(ref - pal))) == 0.0
+
+    def test_rejects_unknown_impl(self):
+        from skypilot_tpu.parallel.mesh import MeshConfig, build_mesh
+        from skypilot_tpu.ops.ring_attention import ring_attention_sharded
+        mesh = build_mesh(MeshConfig(sp=2), jax.devices()[:2])
+        q = jnp.zeros((1, 8, 2, 4), jnp.float32)
+        with pytest.raises(ValueError, match='impl'):
+            ring_attention_sharded(mesh, q, q, q, impl='fused')

@@ -111,7 +111,8 @@ class TestRingInModel:
 
         ref = Transformer(cfg_x).apply(params, tokens)
         mesh = build_mesh(MeshConfig(sp=4), jax.devices()[:4])
-        with jax.set_mesh(mesh):
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        with sharding_lib.use_mesh(mesh):
             out = jax.jit(
                 lambda p, t: Transformer(cfg_r).apply(p, t))(params, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),

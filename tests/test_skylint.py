@@ -143,6 +143,47 @@ class TestHotPathHostSync:
         result = lint(make_tree(tmp_path, good), 'hot-path-host-sync')
         assert not result.unwaived, [str(f) for f in result.unwaived]
 
+    def test_pallas_launch_is_device_dispatch_not_host_sync(
+            self, tmp_path):
+        """A `pl.pallas_call` on the hot path (the fused decode
+        kernel) must NOT be flagged — the launch is as async as any
+        jax op (ALLOWED_DEVICE_DISPATCH) — while its result stays
+        device-tainted: float()ing it without _land is still a
+        finding."""
+        tree = {
+            'models/inference.py': '''
+                import jax.numpy as jnp
+                import numpy as np
+                from jax.experimental import pallas as pl
+
+
+                def _upload(value):
+                    return jnp.asarray(value)
+
+
+                def _land(value):
+                    return np.asarray(value)
+
+
+                def _kernel(x_ref, o_ref):
+                    o_ref[...] = x_ref[...] * 2
+
+
+                class ContinuousBatchingEngine:
+
+                    def _tick(self, gen):
+                        feed = _upload([1, 2])
+                        out = pl.pallas_call(
+                            _kernel,
+                            out_shape=feed)(feed)   # launch: allowed
+                        return float(out)           # BAD: device value
+            ''',
+        }
+        result = lint(make_tree(tmp_path, tree), 'hot-path-host-sync')
+        msgs = [str(f) for f in result.unwaived]
+        assert not any('pallas_call' in m for m in msgs), msgs
+        assert any('float() on a device value' in m for m in msgs), msgs
+
     def test_relative_imports_are_followed(self, tmp_path):
         """`from . import sibling` inside a package __init__ resolves
         against the package itself (not its parent) — a device_get
